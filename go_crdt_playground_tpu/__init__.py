@@ -1,0 +1,27 @@
+"""go_crdt_playground_tpu — a TPU-native CRDT framework.
+
+A ground-up re-design of the capabilities of ``rsms/go-crdt-playground``
+(mounted read-only at /root/reference) for TPU hardware:
+
+* ``models/``   — CRDT families.  ``models.spec`` is the executable
+  pure-Python specification (the conformance oracle mirroring the Go
+  semantics); the other modules hold packed-tensor replica states
+  (AWSet, δ-AWSet, GCounter, PNCounter, 2P-Set, LWW, MV-Register, OR-Map).
+* ``ops/``      — the compute path: vmapped lattice-join kernels (JAX/XLA)
+  and fused Pallas kernels for the hot merge loop.
+* ``parallel/`` — SPMD layer: device meshes, gossip schedules (ring /
+  butterfly anti-entropy), XLA collectives over ICI/DCN, convergence
+  detection, fault injection.
+* ``utils/``    — host runtime: string dictionary codec, pack/unpack,
+  canonical rendering, checkpointing, tracing, config.
+
+Reference semantics anchors are cited throughout as ``file:line`` into
+/root/reference (e.g. awset.go:107-161 for the two-phase merge).
+"""
+
+from go_crdt_playground_tpu.config import Config
+from go_crdt_playground_tpu.models import spec
+
+__version__ = "0.1.0"
+
+__all__ = ["Config", "spec", "__version__"]

@@ -1,0 +1,10 @@
+"""CRDT model families.
+
+``spec`` is the executable specification (pure Python, conformance oracle).
+The sibling modules define packed-tensor replica states and host-level APIs
+for each CRDT family.
+"""
+
+from go_crdt_playground_tpu.models import spec
+
+__all__ = ["spec"]

@@ -1,0 +1,1 @@
+"""Compute path: vmapped lattice-join kernels (JAX/XLA) and Pallas kernels."""

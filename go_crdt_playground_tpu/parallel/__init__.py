@@ -1,0 +1,1 @@
+"""SPMD layer: meshes, gossip schedules, collectives, convergence detection."""

@@ -1,0 +1,1 @@
+"""Host runtime: codec, rendering, checkpointing, tracing, guards."""

@@ -1,0 +1,18 @@
+"""Test harness configuration.
+
+Tests run on CPU with 8 virtual XLA devices so the multi-chip sharding path
+(parallel/) is exercised without TPU hardware, per the driver contract.
+Real-TPU execution is covered by bench.py and __graft_entry__.entry().
+
+This must run before anything imports jax, which pytest guarantees for a
+root conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
