@@ -1,0 +1,150 @@
+"""Packed-tensor AWSet replica state + host-driven local ops.
+
+The central design decision (SURVEY §7.1): one Go ``AWSet`` struct per
+replica (awset.go:55-59) becomes a batch of replicas packed along axis
+``R`` of four dense arrays.  The merge hot loop (awset.go:107-161) then
+runs as elementwise boolean algebra over axis ``E`` (ops/merge.py), vmapped
+over ``R`` and sharded over a device mesh (parallel/).
+
+State arrays:
+  vv:          uint32[R, A]  version vectors (crdt-misc.go:23)
+  present:     bool[R, E]    set membership (keys of Entries, awset.go:58)
+  dot_actor:   uint32[R, E]  birth-dot actor (awset.go:92)
+  dot_counter: uint32[R, E]  birth-dot counter
+  actor:       uint32[R]     each replica's own actor id (awset.go:56)
+
+Canonical form: dot arrays are zero where ``present`` is false, so states
+are bitwise-comparable (the dict model has no dot at all for absent keys).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class AWSetState(NamedTuple):
+    """A batch of R replica states (a pytree of arrays)."""
+
+    vv: jnp.ndarray          # uint32[R, A]
+    present: jnp.ndarray     # bool[R, E]
+    dot_actor: jnp.ndarray   # uint32[R, E]
+    dot_counter: jnp.ndarray # uint32[R, E]
+    actor: jnp.ndarray       # uint32[R]
+
+    @property
+    def num_replicas(self) -> int:
+        return self.vv.shape[0]
+
+    @property
+    def num_actors(self) -> int:
+        return self.vv.shape[-1]
+
+    @property
+    def num_elements(self) -> int:
+        return self.present.shape[-1]
+
+
+def init(num_replicas: int, num_elements: int, num_actors: int,
+         actors=None) -> AWSetState:
+    """Fresh empty replicas (the testAWSetInit fixture shape,
+    awset_test.go:159-168: replica r is actor r unless given)."""
+    if actors is None:
+        actors = jnp.arange(num_replicas, dtype=jnp.uint32) % num_actors
+    else:
+        actors = jnp.asarray(actors, jnp.uint32)
+    return AWSetState(
+        vv=jnp.zeros((num_replicas, num_actors), jnp.uint32),
+        present=jnp.zeros((num_replicas, num_elements), bool),
+        dot_actor=jnp.zeros((num_replicas, num_elements), jnp.uint32),
+        dot_counter=jnp.zeros((num_replicas, num_elements), jnp.uint32),
+        actor=actors,
+    )
+
+
+def from_arrays(arrays: Dict[str, np.ndarray]) -> AWSetState:
+    """Lift a utils.codec.pack_awsets result onto device."""
+    return AWSetState(
+        vv=jnp.asarray(arrays["vv"], jnp.uint32),
+        present=jnp.asarray(arrays["present"], bool),
+        dot_actor=jnp.asarray(arrays["dot_actor"], jnp.uint32),
+        dot_counter=jnp.asarray(arrays["dot_counter"], jnp.uint32),
+        actor=jnp.asarray(arrays["actor"], jnp.uint32),
+    )
+
+
+def to_arrays(state: AWSetState) -> Dict[str, np.ndarray]:
+    return {
+        "vv": np.asarray(state.vv),
+        "present": np.asarray(state.present),
+        "dot_actor": np.asarray(state.dot_actor),
+        "dot_counter": np.asarray(state.dot_counter),
+        "actor": np.asarray(state.actor),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Local mutations (host-driven scenario ops; the bulk path is ops/merge.py)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def add_element(state: AWSetState, replica: jnp.ndarray,
+                element: jnp.ndarray) -> AWSetState:
+    """``AWSet.Add`` for one key on one replica (awset.go:89-94): tick own
+    clock, stamp the birth dot (re-add = dot update)."""
+    r = replica.astype(jnp.int32)
+    e = element.astype(jnp.int32)
+    a = state.actor[r].astype(jnp.int32)
+    new_counter = state.vv[r, a] + 1
+    return AWSetState(
+        vv=state.vv.at[r, a].set(new_counter),
+        present=state.present.at[r, e].set(True),
+        dot_actor=state.dot_actor.at[r, e].set(state.actor[r]),
+        dot_counter=state.dot_counter.at[r, e].set(new_counter),
+        actor=state.actor,
+    )
+
+
+@jax.jit
+def del_element(state: AWSetState, replica: jnp.ndarray,
+                element: jnp.ndarray) -> AWSetState:
+    """``AWSet.Del`` (awset.go:96-101): pure removal, NO clock tick (the
+    increment is commented out at awset.go:97).  Dots are zeroed to keep
+    the canonical form."""
+    r = replica.astype(jnp.int32)
+    e = element.astype(jnp.int32)
+    return AWSetState(
+        vv=state.vv,
+        present=state.present.at[r, e].set(False),
+        dot_actor=state.dot_actor.at[r, e].set(0),
+        dot_counter=state.dot_counter.at[r, e].set(0),
+        actor=state.actor,
+    )
+
+
+def has_element(state: AWSetState, replica: int, element: int) -> bool:
+    """``AWSet.Has`` (awset.go:87)."""
+    return bool(state.present[replica, element])
+
+
+@jax.jit
+def reset(state: AWSetState) -> AWSetState:
+    """``AWSet.Reset`` (awset.go:72-75) — with the VV keeping its actor
+    axis rather than collapsing to length 1 (reference's latent bug)."""
+    return AWSetState(
+        vv=jnp.zeros_like(state.vv),
+        present=jnp.zeros_like(state.present),
+        dot_actor=jnp.zeros_like(state.dot_actor),
+        dot_counter=jnp.zeros_like(state.dot_counter),
+        actor=state.actor,
+    )
+
+
+def clone(state: AWSetState) -> AWSetState:
+    """``AWSet.Clone`` (awset.go:77-85).  Arrays are immutable in JAX, so a
+    clone is the state itself; provided for API parity."""
+    return state

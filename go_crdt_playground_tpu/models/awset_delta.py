@@ -1,0 +1,96 @@
+"""Packed-tensor δ-AWSet replica state.
+
+Extends the AWSet arrays (models/awset.py) with the δ-state machinery of
+the reference prototype (awset-delta_test.go:9-12) and this framework's v2
+extensions (models/spec.py AWSetDelta docstring):
+
+  deleted:         bool[R, E]    deletion log membership (Deleted map keys)
+  del_dot_actor:   uint32[R, E]  deletion dots (Deleted map values)
+  del_dot_counter: uint32[R, E]
+  processed:       uint32[R, A]  v2 causal-stability vector: per origin
+                                 actor, the highest deletion counter whose
+                                 effects this replica's state reflects
+
+The reference's per-peer ack bookkeeping (spec ``peer_processed``) is NOT
+materialized on device: in the batched SPMD world the GC frontier is an
+exact global snapshot — ``min`` over the replica axis of ``processed`` —
+computed with one collective (ops/delta.py:gc_frontier), which is the
+TPU-native replacement for gossiping VV matrices (SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from go_crdt_playground_tpu.models import awset as awset_mod
+from go_crdt_playground_tpu.models.awset import AWSetState
+
+
+class AWSetDeltaState(NamedTuple):
+    vv: jnp.ndarray              # uint32[R, A]
+    present: jnp.ndarray         # bool[R, E]
+    dot_actor: jnp.ndarray       # uint32[R, E]
+    dot_counter: jnp.ndarray     # uint32[R, E]
+    actor: jnp.ndarray           # uint32[R]
+    deleted: jnp.ndarray         # bool[R, E]
+    del_dot_actor: jnp.ndarray   # uint32[R, E]
+    del_dot_counter: jnp.ndarray # uint32[R, E]
+    processed: jnp.ndarray       # uint32[R, A]
+
+    @property
+    def num_replicas(self) -> int:
+        return self.vv.shape[0]
+
+    @property
+    def num_actors(self) -> int:
+        return self.vv.shape[-1]
+
+    @property
+    def num_elements(self) -> int:
+        return self.present.shape[-1]
+
+    def base(self) -> AWSetState:
+        return AWSetState(vv=self.vv, present=self.present,
+                          dot_actor=self.dot_actor,
+                          dot_counter=self.dot_counter, actor=self.actor)
+
+
+def _extend(base: awset_mod.AWSetState, deleted, del_da, del_dc,
+            processed) -> AWSetDeltaState:
+    return AWSetDeltaState(
+        vv=base.vv, present=base.present, dot_actor=base.dot_actor,
+        dot_counter=base.dot_counter, actor=base.actor,
+        deleted=deleted, del_dot_actor=del_da, del_dot_counter=del_dc,
+        processed=processed,
+    )
+
+
+def init(num_replicas: int, num_elements: int, num_actors: int,
+         actors=None) -> AWSetDeltaState:
+    base = awset_mod.init(num_replicas, num_elements, num_actors, actors)
+    zE = jnp.zeros((num_replicas, num_elements), jnp.uint32)
+    return _extend(
+        base,
+        deleted=jnp.zeros((num_replicas, num_elements), bool),
+        del_da=zE, del_dc=zE,
+        processed=jnp.zeros((num_replicas, num_actors), jnp.uint32),
+    )
+
+
+def from_arrays(arrays: Dict[str, np.ndarray]) -> AWSetDeltaState:
+    """Lift a utils.codec.pack_awset_deltas result onto device."""
+    base = awset_mod.from_arrays(arrays)
+    return _extend(
+        base,
+        deleted=jnp.asarray(arrays["deleted"], bool),
+        del_da=jnp.asarray(arrays["del_dot_actor"], jnp.uint32),
+        del_dc=jnp.asarray(arrays["del_dot_counter"], jnp.uint32),
+        processed=jnp.asarray(arrays["processed"], jnp.uint32),
+    )
+
+
+def to_arrays(state: AWSetDeltaState) -> Dict[str, np.ndarray]:
+    return {name: np.asarray(getattr(state, name)) for name in state._fields}

@@ -1,0 +1,154 @@
+"""The AWSet merge kernel — tensorization of the reference's hot loop.
+
+``AWSet.merge`` (awset.go:107-161) is two sequential map loops plus a VV
+join.  On TPU it becomes branch-free boolean algebra over the element axis
+``E`` (SURVEY §7.2): every per-key decision in the Go code is a mask, the
+two phases compose into closed-form expressions, and ``HasDot`` is a
+gather + compare.  ``vmap`` batches replica pairs along ``R``; parallel/
+shards ``R``/``E`` over the device mesh.
+
+Phase-order note [verified in SURVEY §3.2]: tensor-form phase composition
+is exact because Go's phase 2 reads only (a) src-absence, (b) the entry's
+current dot — which for dst-only keys is untouched by phase 1 — and
+phase 1 never creates dst-only keys.
+
+Semantics preserved exactly, including the quirks:
+  * unconditional dot overwrite when present on both sides (awset.go:142),
+    even when the src dot is OLDER — see the stale-dot-overwrite pin in
+    tests/test_spec_conformance.py;
+  * ``skip`` when dst's clock covers an absent entry's dot (awset.go:133);
+  * removal only when the SRC clock covers dst's live dot (awset.go:152).
+
+Canonical form: dot lanes are zeroed where absent so merged states are
+bitwise-comparable with packed spec states.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from go_crdt_playground_tpu.models.awset import AWSetState
+from go_crdt_playground_tpu.ops.vv import has_dot, vv_join
+
+# Merge-decision outcome labels — the five labels of the reference's
+# logOutcome tracing (awset.go:126-156), as tensor codes (SURVEY §5.1).
+OUTCOME_NONE = 0
+OUTCOME_UPDATE = 1   # present both sides, dots differ (awset.go:126)
+OUTCOME_KEEP = 2     # awset.go:128, 148, 156
+OUTCOME_SKIP = 3     # dst clock covers unseen entry (awset.go:134)
+OUTCOME_ADD = 4      # genuinely new to dst (awset.go:139)
+OUTCOME_REMOVE = 5   # src witnessed and dropped (awset.go:153)
+
+
+class MergeTrace(NamedTuple):
+    """Per-element decision tensors (uint8[..., E]) for the two phases.
+    Array-comparable replacement for the reference's stdout tracing, whose
+    line order is nondeterministic Go map iteration (SURVEY §5.1)."""
+
+    phase1: jnp.ndarray
+    phase2: jnp.ndarray
+
+
+def merge_kernel(
+    dst_vv: jnp.ndarray,       # uint32[A]
+    dst_present: jnp.ndarray,  # bool[E]
+    dst_da: jnp.ndarray,       # uint32[E]
+    dst_dc: jnp.ndarray,       # uint32[E]
+    src_vv: jnp.ndarray,
+    src_present: jnp.ndarray,
+    src_da: jnp.ndarray,
+    src_dc: jnp.ndarray,
+    with_trace: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray,
+           Optional[MergeTrace]]:
+    """One replica-pair merge ``dst <- src`` as closed-form masks."""
+    # HasDot gathers (awset.go:133 / :152 via crdt-misc.go:28-34)
+    seen_by_dst = has_dot(dst_vv, src_da, src_dc)   # dst clock covers src dot
+    seen_by_src = has_dot(src_vv, dst_da, dst_dc)   # src clock covers dst dot
+
+    # phase 1: lanes that end up carrying the src dot — present on both
+    # (unconditional overwrite, awset.go:142) or src-only-and-unseen (add).
+    take_src = src_present & (dst_present | ~seen_by_dst)
+    # phase 2: dst-only lanes removed iff src witnessed them (awset.go:152-154)
+    remove = dst_present & ~src_present & seen_by_src
+
+    present = take_src | (dst_present & ~src_present & ~seen_by_src)
+    da = jnp.where(take_src, src_da, dst_da)
+    dc = jnp.where(take_src, src_dc, dst_dc)
+    # canonical form: zero dots on absent lanes
+    da = jnp.where(present, da, 0)
+    dc = jnp.where(present, dc, 0)
+    vv = vv_join(dst_vv, src_vv)  # awset.go:160
+
+    trace = None
+    if with_trace:
+        both = dst_present & src_present
+        p1 = jnp.where(
+            both & (dst_da != src_da) | both & (dst_dc != src_dc),
+            OUTCOME_UPDATE,
+            jnp.where(
+                both,
+                OUTCOME_KEEP,
+                jnp.where(
+                    src_present & seen_by_dst,
+                    OUTCOME_SKIP,
+                    jnp.where(src_present, OUTCOME_ADD, OUTCOME_NONE),
+                ),
+            ),
+        ).astype(jnp.uint8)
+        present1 = dst_present | (src_present & ~seen_by_dst)
+        p2 = jnp.where(
+            present1 & remove,
+            OUTCOME_REMOVE,
+            jnp.where(present1, OUTCOME_KEEP, OUTCOME_NONE),
+        ).astype(jnp.uint8)
+        trace = MergeTrace(phase1=p1, phase2=p2)
+    return vv, present, da, dc, trace
+
+
+def _merge_state_arrays(dst: AWSetState, src: AWSetState, with_trace: bool):
+    vv, present, da, dc, trace = merge_kernel(
+        dst.vv, dst.present, dst.dot_actor, dst.dot_counter,
+        src.vv, src.present, src.dot_actor, src.dot_counter,
+        with_trace=with_trace,
+    )
+    return AWSetState(vv=vv, present=present, dot_actor=da, dot_counter=dc,
+                      actor=dst.actor), trace
+
+
+def merge_pairwise(dst: AWSetState, src: AWSetState,
+                   with_trace: bool = False):
+    """Batched ``dst[r] <- src[r]`` for every replica r (vmapped pair
+    merge).  ``src`` is typically a permuted view of the same batch — the
+    gossip pattern of parallel/gossip.py — or an independent batch.
+
+    Returns (merged AWSetState, Optional[MergeTrace])."""
+    merged, trace = jax.vmap(
+        lambda d, s: _merge_state_arrays(d, s, with_trace),
+        in_axes=(0, 0),
+    )(dst, src)
+    return merged, trace
+
+
+merge_pairwise_jit = jax.jit(merge_pairwise, static_argnames=("with_trace",))
+
+
+def merge_one_into(dst: AWSetState, r_dst, src: AWSetState, r_src,
+                   with_trace: bool = False):
+    """Scenario-style single merge: replica ``r_dst`` of ``dst`` absorbs
+    replica ``r_src`` of ``src`` (the direct method call of the reference's
+    simulation harness, awset_test.go:16-17)."""
+    d = jax.tree.map(lambda x: x[r_dst], dst)
+    s = jax.tree.map(lambda x: x[r_src], src)
+    merged, trace = _merge_state_arrays(d, s, with_trace)
+    out = AWSetState(
+        vv=dst.vv.at[r_dst].set(merged.vv),
+        present=dst.present.at[r_dst].set(merged.present),
+        dot_actor=dst.dot_actor.at[r_dst].set(merged.dot_actor),
+        dot_counter=dst.dot_counter.at[r_dst].set(merged.dot_counter),
+        actor=dst.actor,
+    )
+    return out, trace

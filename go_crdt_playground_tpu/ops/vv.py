@@ -1,0 +1,34 @@
+"""Version-vector primitives, tensorized.
+
+Reference semantics: crdt-misc.go:23-74.  The packed representation is
+``vv: uint32[..., A]`` with a fixed actor axis; zero-padding is exact
+because counter 0 means "never seen" (crdt-misc.go:29-41 — and fixes the
+reference's latent OOB panic for ``d.Actor == len(vv)``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def has_dot(vv: jnp.ndarray, dot_actor: jnp.ndarray,
+            dot_counter: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized ``VersionVector.HasDot`` (crdt-misc.go:28-34).
+
+    vv: uint32[A]; dot_actor/dot_counter: uint32[...] element-shaped.
+    Returns bool[...]: vv[dot_actor] >= dot_counter.
+
+    A gather + compare (SURVEY §7.1).  Callers guarantee dot_actor < A by
+    construction (packed dots are produced from in-range actors; absent
+    lanes are zeroed and masked out by the caller's boolean algebra).
+    ``mode="clip"`` semantics of jnp.take keep even garbage indices safe.
+    """
+    counters = jnp.take(vv, dot_actor.astype(jnp.int32), mode="clip")
+    return counters >= dot_counter
+
+
+def vv_join(vv_dst: jnp.ndarray, vv_src: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise-max lattice join (``VersionVector.Merge``,
+    crdt-misc.go:43-55).  With a fixed actor axis the append-extension
+    branch (crdt-misc.go:50-52) is subsumed by zero padding."""
+    return jnp.maximum(vv_dst, vv_src)

@@ -1,0 +1,207 @@
+"""Host-side codec: string dictionary + pack/unpack between the dict-model
+spec and packed tensor states.
+
+The reference keys entries by Go strings in a map (awset.go:58).  Tensors
+need a fixed element universe, so elements are dictionary-encoded once on
+host to ids ``0..E-1`` (SURVEY §7.1); the dictionary is append-only and
+grow-and-repack handles overflow.  Version vectors are padded to a fixed
+actor axis ``A`` — semantically exact, since a zero counter means "never
+seen" (crdt-misc.go:29-41).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from go_crdt_playground_tpu.models.spec import AWSet, AWSetDelta, Dot, VersionVector
+
+
+class ElementDict:
+    """Append-only string<->id dictionary for the element universe.
+
+    ``encode`` assigns the next free id on first sight.  ``capacity`` is the
+    packed element axis ``E``; ``grow`` doubles it (callers then re-pack
+    states to the larger universe — the overflow policy of SURVEY §7.5.1).
+    """
+
+    def __init__(self, capacity: int = 16,
+                 values: Optional[Iterable[str]] = None):
+        self.capacity = capacity
+        self._to_id: Dict[str, int] = {}
+        self._to_str: List[str] = []
+        if values:
+            for v in values:
+                self.encode(v)
+
+    def __len__(self) -> int:
+        return len(self._to_str)
+
+    def __contains__(self, value: str) -> bool:
+        return value in self._to_id
+
+    def encode(self, value: str) -> int:
+        eid = self._to_id.get(value)
+        if eid is None:
+            if len(self._to_str) >= self.capacity:
+                raise OverflowError(
+                    f"element dictionary full (capacity {self.capacity}); "
+                    "grow() and re-pack"
+                )
+            eid = len(self._to_str)
+            self._to_id[value] = eid
+            self._to_str.append(value)
+        return eid
+
+    def encode_many(self, values: Iterable[str]) -> List[int]:
+        return [self.encode(v) for v in values]
+
+    def decode(self, eid: int) -> str:
+        return self._to_str[eid]
+
+    def grow(self, factor: int = 2) -> None:
+        self.capacity *= factor
+
+    def state_dict(self) -> dict:
+        return {"capacity": self.capacity, "values": list(self._to_str)}
+
+    @classmethod
+    def from_state_dict(cls, d: dict) -> "ElementDict":
+        return cls(capacity=d["capacity"], values=d["values"])
+
+
+def pack_awsets(
+    replicas: Sequence[AWSet],
+    dictionary: ElementDict,
+    num_actors: int,
+) -> Dict[str, np.ndarray]:
+    """Pack spec replicas into the canonical dense arrays.
+
+    Returns numpy arrays (host-side; callers jnp.asarray as needed):
+      vv:          uint32[R, A]
+      present:     bool[R, E]
+      dot_actor:   uint32[R, E]   (0 where absent — canonical form)
+      dot_counter: uint32[R, E]
+      actor:       uint32[R]      (each replica's own actor id, awset.go:56)
+    """
+    R, E, A = len(replicas), dictionary.capacity, num_actors
+    vv = np.zeros((R, A), np.uint32)
+    present = np.zeros((R, E), bool)
+    dot_actor = np.zeros((R, E), np.uint32)
+    dot_counter = np.zeros((R, E), np.uint32)
+    actor = np.zeros((R,), np.uint32)
+    for r, rep in enumerate(replicas):
+        if len(rep.version_vector) > A:
+            raise ValueError(f"replica {r} VV length {len(rep.version_vector)} > A={A}")
+        if rep.actor >= A:
+            raise ValueError(f"replica {r} actor {rep.actor} >= A={A}")
+        actor[r] = rep.actor
+        for a, c in enumerate(rep.version_vector.v):
+            vv[r, a] = c
+        for k, d in rep.entries.items():
+            e = dictionary.encode(k)
+            present[r, e] = True
+            dot_actor[r, e] = d.actor
+            dot_counter[r, e] = d.counter
+    return {
+        "vv": vv,
+        "present": present,
+        "dot_actor": dot_actor,
+        "dot_counter": dot_counter,
+        "actor": actor,
+    }
+
+
+def unpack_awsets(
+    arrays: Dict[str, np.ndarray],
+    dictionary: ElementDict,
+) -> List[AWSet]:
+    """Inverse of pack_awsets (up to VV length: unpacked VVs carry the full
+    fixed actor axis, zero-padded — an exact representation per
+    crdt-misc.go:29-41)."""
+    vv = np.asarray(arrays["vv"])
+    present = np.asarray(arrays["present"])
+    dot_actor = np.asarray(arrays["dot_actor"])
+    dot_counter = np.asarray(arrays["dot_counter"])
+    actor = np.asarray(arrays["actor"])
+    out: List[AWSet] = []
+    for r in range(vv.shape[0]):
+        rep = AWSet(
+            actor=int(actor[r]),
+            version_vector=VersionVector([int(c) for c in vv[r]]),
+        )
+        for e in np.nonzero(present[r])[0]:
+            rep.entries[dictionary.decode(int(e))] = Dot(
+                int(dot_actor[r, e]), int(dot_counter[r, e])
+            )
+        out.append(rep)
+    return out
+
+
+def render_packed(arrays: Dict[str, np.ndarray], dictionary: ElementDict) -> List[str]:
+    """Canonical per-replica rendering of a packed state, byte-identical to
+    the reference's ``AWSet.String`` format (awset.go:163-171) — the
+    conformance serialization."""
+    return [str(rep) for rep in unpack_awsets(arrays, dictionary)]
+
+
+def pack_awset_deltas(
+    replicas: Sequence[AWSetDelta],
+    dictionary: ElementDict,
+    num_actors: int,
+) -> Dict[str, np.ndarray]:
+    """Pack δ-state replicas: the AWSet arrays plus the deletion log
+    (``Deleted`` map, awset-delta_test.go:11) and the v2 ``processed``
+    vector (zeroed for reference-mode replicas)."""
+    base = pack_awsets(replicas, dictionary, num_actors)
+    R, E, A = base["present"].shape[0], dictionary.capacity, num_actors
+    deleted = np.zeros((R, E), bool)
+    del_dot_actor = np.zeros((R, E), np.uint32)
+    del_dot_counter = np.zeros((R, E), np.uint32)
+    processed = np.zeros((R, A), np.uint32)
+    for r, rep in enumerate(replicas):
+        for k, d in rep.deleted.items():
+            e = dictionary.encode(k)
+            deleted[r, e] = True
+            del_dot_actor[r, e] = d.actor
+            del_dot_counter[r, e] = d.counter
+        for a, c in rep.processed.items():
+            if a < A:
+                processed[r, a] = c
+    base.update(
+        deleted=deleted,
+        del_dot_actor=del_dot_actor,
+        del_dot_counter=del_dot_counter,
+        processed=processed,
+    )
+    return base
+
+
+def unpack_awset_deltas(
+    arrays: Dict[str, np.ndarray],
+    dictionary: ElementDict,
+    delta_semantics: str = "v2",
+) -> List[AWSetDelta]:
+    out: List[AWSetDelta] = []
+    base = unpack_awsets(arrays, dictionary)
+    deleted = np.asarray(arrays["deleted"])
+    del_dot_actor = np.asarray(arrays["del_dot_actor"])
+    del_dot_counter = np.asarray(arrays["del_dot_counter"])
+    processed = np.asarray(arrays["processed"])
+    for r, rep in enumerate(base):
+        drep = AWSetDelta(
+            actor=rep.actor,
+            version_vector=rep.version_vector,
+            entries=rep.entries,
+            delta_semantics=delta_semantics,
+        )
+        for e in np.nonzero(deleted[r])[0]:
+            drep.deleted[dictionary.decode(int(e))] = Dot(
+                int(del_dot_actor[r, e]), int(del_dot_counter[r, e])
+            )
+        for a in range(processed.shape[1]):
+            if processed[r, a]:
+                drep.processed[int(a)] = int(processed[r, a])
+        out.append(drep)
+    return out

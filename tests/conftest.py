@@ -10,9 +10,21 @@ root conftest.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests run on CPU regardless of JAX_PLATFORMS: this image globally exports
+# JAX_PLATFORMS=axon (the TPU tunnel), under which every host transfer costs
+# ~100ms of network round-trip and the suite takes minutes instead of
+# seconds.  A deliberate on-TPU test run opts in with
+# CRDT_TPU_TEST_PLATFORM=axon pytest tests/.
+_platform = os.environ.get("CRDT_TPU_TEST_PLATFORM", "cpu")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon plugin ignores the JAX_PLATFORMS env var; the config knob is
+# authoritative and must be set before any device initialization.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)

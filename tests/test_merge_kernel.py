@@ -1,0 +1,255 @@
+"""Tensor merge-kernel conformance: the JAX path must match the executable
+spec bit-for-bit — same membership, same VVs, same per-entry dots, and the
+same canonical rendering — on the reference's own scenarios and on
+randomized op soups (the first conformance gate of SURVEY §7.2).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from go_crdt_playground_tpu.models import awset
+from go_crdt_playground_tpu.models.spec import AWSet, Dot, VersionVector
+from go_crdt_playground_tpu.ops import merge as merge_ops
+from go_crdt_playground_tpu.utils.codec import (
+    ElementDict,
+    pack_awsets,
+    render_packed,
+)
+
+
+class DualWorld:
+    """Runs the same op sequence on the spec dict model and the packed
+    tensor path, asserting bitwise equality after every step."""
+
+    def __init__(self, num_replicas=2, num_elements=16, num_actors=None):
+        A = num_actors if num_actors is not None else num_replicas
+        self.A = A
+        self.spec = [
+            AWSet(actor=i, version_vector=VersionVector([0] * A))
+            for i in range(num_replicas)
+        ]
+        self.state = awset.init(num_replicas, num_elements, A)
+        self.dictionary = ElementDict(capacity=num_elements)
+
+    def add(self, r, *keys):
+        self.spec[r].add(*keys)
+        for k in keys:
+            e = self.dictionary.encode(k)
+            self.state = awset.add_element(
+                self.state, np.uint32(r), np.uint32(e))
+
+    def del_(self, r, *keys):
+        self.spec[r].del_(*keys)
+        for k in keys:
+            if k in self.dictionary:
+                e = self.dictionary.encode(k)
+                self.state = awset.del_element(
+                    self.state, np.uint32(r), np.uint32(e))
+
+    def merge(self, dst, src):
+        self.spec[dst].merge(self.spec[src])
+        self.state, _ = merge_ops.merge_one_into(
+            self.state, dst, self.state, src)
+
+    def check(self, context=""):
+        packed = pack_awsets(self.spec, self.dictionary, self.A)
+        actual = awset.to_arrays(self.state)
+        for name in ("vv", "present", "dot_actor", "dot_counter", "actor"):
+            assert np.array_equal(packed[name], np.asarray(actual[name])), (
+                context, name, packed[name], np.asarray(actual[name]))
+        # byte-identical canonical rendering (awset.go:163-171 format)
+        assert render_packed(actual, self.dictionary) == [
+            str(s) for s in self.spec
+        ], context
+
+    def members(self, r):
+        arr = awset.to_arrays(self.state)
+        return sorted(
+            self.dictionary.decode(int(e))
+            for e in np.nonzero(arr["present"][r])[0]
+        )
+
+
+def test_kernel_awset_xxx():
+    """TestAWSetXXX (awset_test.go:10-29) on the tensor path."""
+    w = DualWorld()
+    w.add(0, "A", "B", "C"); w.add(1, "A", "B", "C"); w.check()
+    w.merge(0, 1); w.check()
+    w.merge(1, 0); w.check()
+    w.del_(0, "B"); w.add(1, "B"); w.check()
+    w.merge(1, 0); w.check()
+    w.merge(0, 1); w.check()
+    assert w.members(0) == ["A", "B", "C"]
+    assert w.members(1) == ["A", "B", "C"]
+
+
+def test_kernel_awset_long_scenario():
+    """TestAWSet (awset_test.go:31-83) on the tensor path, checking bitwise
+    state equality after every op."""
+    w = DualWorld()
+    w.add(0, "Shelly"); w.check("add Shelly")
+    w.merge(1, 0); w.check("B<-A")
+    w.add(1, "Bob", "Phil", "Pete"); w.check()
+    w.merge(0, 1); w.check("A<-B")
+    w.del_(0, "Phil"); w.add(0, "Bob"); w.add(0, "Anna"); w.check()
+    w.merge(1, 0); w.check("B<-A 2")
+    w.del_(0, "Bob", "Pete"); w.del_(1, "Bob", "Shelly"); w.check()
+    w.merge(0, 1); w.check("A<-B 2")
+    w.merge(1, 0); w.check("B<-A 3")
+    assert w.members(0) == ["Anna"]
+    w.add(0, "A", "B", "C"); w.del_(0, "A"); w.add(0, "A"); w.check()
+    w.merge(1, 0); w.check("B<-A 4")
+    assert w.members(1) == ["A", "Anna", "B", "C"]
+
+
+def test_kernel_concurrent_add_wins():
+    """TestAWSetConcurrentAddWinsOverDelete fork scenario
+    (awset_test.go:101-112): state forking is trivial on the tensor path —
+    arrays are immutable values."""
+    w = DualWorld()
+    w.add(0, "Anne", "Bob"); w.add(1, "Anne"); w.check()
+    # fork (Clone, awset_test.go:104): tensor state is a value; spec clones
+    fork_spec = [s.clone() for s in w.spec]
+    fork_state = w.state
+    w.add(1, "Bob"); w.del_(0, "Bob")
+    w.merge(1, 0); w.merge(0, 1); w.check()
+    assert w.members(0) == ["Anne", "Bob"]  # writer wins
+    # restore fork and run the non-concurrent variant (awset_test.go:113-121)
+    w.spec, w.state = fork_spec, fork_state
+    w.add(1, "Bob"); w.merge(1, 0); w.del_(0, "Bob")
+    w.merge(1, 0); w.merge(0, 1); w.check()
+    assert w.members(0) == ["Anne"]
+    assert w.members(1) == ["Anne"]
+
+
+def test_kernel_commutativity():
+    """TestAWSetCommutativity (awset_test.go:124-154)."""
+    w = DualWorld()
+    w.add(0, "Shelly", "Bob", "Pete", "Anna")
+    w.add(1, "Shelly", "Bob", "Pete", "Anna")
+    w.del_(0, "Anna"); w.add(1, "Anna"); w.check()
+    fork_spec = [s.clone() for s in w.spec]
+    fork_state = w.state
+    w.merge(1, 0); w.merge(0, 1); w.check()
+    expected = ["Anna", "Bob", "Pete", "Shelly"]
+    assert w.members(0) == expected and w.members(1) == expected
+    w.spec, w.state = fork_spec, fork_state
+    w.merge(0, 1); w.merge(1, 0); w.check()
+    assert w.members(0) == expected and w.members(1) == expected
+
+
+def test_kernel_stale_dot_overwrite_quirk():
+    """The kernel must reproduce the unconditional dot overwrite
+    (awset.go:142) including the stale-dot case that loses a concurrent
+    re-add (pinned in test_spec_conformance)."""
+    w = DualWorld(num_replicas=3, num_elements=8, num_actors=3)
+    w.add(2, "x"); w.merge(1, 2); w.merge(0, 1)
+    w.del_(2, "x"); w.add(0, "x"); w.check()
+    w.merge(0, 1); w.check("stale overwrite")
+    arr = awset.to_arrays(w.state)
+    e = w.dictionary.encode("x")
+    assert arr["dot_actor"][0][e] == 2 and arr["dot_counter"][0][e] == 1
+    w.merge(0, 2); w.check("removal after stale overwrite")
+    assert w.members(0) == []
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_kernel_randomized_conformance(seed):
+    """Randomized op soups over 3 replicas / 3 actors: bitwise agreement
+    with the spec after every single op (the strongest conformance mode)."""
+    rng = random.Random(seed)
+    universe = [f"k{i}" for i in range(10)]
+    w = DualWorld(num_replicas=3, num_elements=12, num_actors=3)
+    for step in range(120):
+        p = rng.random()
+        r = rng.randrange(3)
+        if p < 0.45:
+            w.add(r, rng.choice(universe))
+        elif p < 0.7:
+            w.del_(r, rng.choice(universe))
+        else:
+            s = rng.randrange(3)
+            if s != r:
+                w.merge(r, s)
+        w.check(f"seed={seed} step={step}")
+
+
+def test_kernel_batched_pairwise_matches_sequential():
+    """merge_pairwise (vmapped) must equal R independent single merges."""
+    rng = random.Random(42)
+    R, E, A = 8, 16, 8
+    dst = awset.init(R, E, A)
+    src = awset.init(R, E, A)
+    # random independent histories
+    for _ in range(60):
+        which = rng.random() < 0.5
+        st = dst if which else src
+        r, e = rng.randrange(R), rng.randrange(E)
+        if rng.random() < 0.7:
+            st = awset.add_element(st, np.uint32(r), np.uint32(e))
+        else:
+            st = awset.del_element(st, np.uint32(r), np.uint32(e))
+        if which:
+            dst = st
+        else:
+            src = st
+    batched, _ = merge_ops.merge_pairwise_jit(dst, src)
+    for r in range(R):
+        single, _ = merge_ops.merge_one_into(dst, r, src, r)
+        for name in ("vv", "present", "dot_actor", "dot_counter"):
+            assert np.array_equal(
+                np.asarray(getattr(batched, name)[r]),
+                np.asarray(getattr(single, name)[r]),
+            ), (r, name)
+
+
+def test_kernel_trace_matches_spec_outcomes():
+    """The decision tensors must reproduce the reference's five logOutcome
+    labels (awset.go:126-156) as recorded by the spec's trace hook."""
+    events = []
+    A_spec = AWSet(actor=0, version_vector=VersionVector([0, 0]),
+                   trace=events.append)
+    B_spec = AWSet(actor=1, version_vector=VersionVector([0, 0]))
+    dictionary = ElementDict(capacity=8)
+    # build divergent states: shared, dst-only-seen, src-only-new, deleted...
+    A_spec.add("both_same")
+    B_spec.merge(A_spec)          # B now has both_same with A's dot
+    A_spec.add("both_diff")       # A re-adds so dots will differ after B add
+    B_spec.add("both_diff")
+    A_spec.add("dst_only_unseen")
+    B_spec.add("src_only_new")
+    A_spec.merge(B_spec)          # A sees src_only_new
+    A_spec.del_("src_only_new")   # now A's clock covers it but absent -> skip
+    events.clear()
+    # tensor states mirroring the spec pair
+    state = awset.from_arrays(pack_awsets([A_spec, B_spec], dictionary, 2))
+    dst = {k: v[0] for k, v in awset.to_arrays(state).items()}
+    src = {k: v[1] for k, v in awset.to_arrays(state).items()}
+    _, _, _, _, trace = merge_ops.merge_kernel(
+        dst["vv"], dst["present"], dst["dot_actor"], dst["dot_counter"],
+        src["vv"], src["present"], src["dot_actor"], src["dot_counter"],
+        with_trace=True,
+    )
+    A_spec.merge(B_spec)  # spec records events
+    code = {"update": merge_ops.OUTCOME_UPDATE, "keep": merge_ops.OUTCOME_KEEP,
+            "skip": merge_ops.OUTCOME_SKIP, "add": merge_ops.OUTCOME_ADD,
+            "remove": merge_ops.OUTCOME_REMOVE}
+    p1 = np.asarray(trace.phase1)
+    p2 = np.asarray(trace.phase2)
+    seen_lanes_p1, seen_lanes_p2 = set(), set()
+    for ev in events:
+        e = dictionary.encode(ev.key)
+        if ev.phase == 1:
+            assert p1[e] == code[ev.outcome], (ev, p1[e])
+            seen_lanes_p1.add(e)
+        else:
+            assert p2[e] == code[ev.outcome], (ev, p2[e])
+            seen_lanes_p2.add(e)
+    # lanes with no spec event must be OUTCOME_NONE
+    for e in range(8):
+        if e not in seen_lanes_p1:
+            assert p1[e] == merge_ops.OUTCOME_NONE, e
+        if e not in seen_lanes_p2:
+            assert p2[e] == merge_ops.OUTCOME_NONE, e
