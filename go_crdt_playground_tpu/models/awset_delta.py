@@ -22,6 +22,7 @@ from __future__ import annotations
 
 from typing import Dict, NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -94,3 +95,54 @@ def from_arrays(arrays: Dict[str, np.ndarray]) -> AWSetDeltaState:
 
 def to_arrays(state: AWSetDeltaState) -> Dict[str, np.ndarray]:
     return {name: np.asarray(getattr(state, name)) for name in state._fields}
+
+
+# ---------------------------------------------------------------------------
+# Local mutations (host-driven scenario ops; bulk path is ops/delta.py)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def add_element(state: AWSetDeltaState, replica: jnp.ndarray,
+                element: jnp.ndarray) -> AWSetDeltaState:
+    """δ-state ``Add``: the plain AWSet add (awset.go:89-94) plus the v2
+    invariant processed[self] == vv[self] (a replica has trivially
+    processed its own events; spec AWSetDelta.add)."""
+    r = replica.astype(jnp.int32)
+    a = state.actor[r].astype(jnp.int32)
+    base = awset_mod.add_element(state.base(), replica, element)
+    return state._replace(
+        vv=base.vv,
+        present=base.present,
+        dot_actor=base.dot_actor,
+        dot_counter=base.dot_counter,
+        processed=state.processed.at[r, a].set(base.vv[r, a]),
+    )
+
+
+@jax.jit
+def del_elements(state: AWSetDeltaState, replica: jnp.ndarray,
+                 selector: jnp.ndarray) -> AWSetDeltaState:
+    """δ-state ``Del`` (awset-delta_test.go:14-33): ticks the clock ONCE
+    PER CALL — even when nothing selected is present — and stamps every
+    actually-present selected key with that one shared deletion dot.
+
+    selector: bool[E] — the key set of one Del(k...) call."""
+    r = replica.astype(jnp.int32)
+    a = state.actor[r].astype(jnp.int32)
+    new_counter = state.vv[r, a] + 1
+    hit = selector & state.present[r]
+    return state._replace(
+        vv=state.vv.at[r, a].set(new_counter),
+        present=state.present.at[r].set(state.present[r] & ~hit),
+        dot_actor=state.dot_actor.at[r].set(
+            jnp.where(hit, 0, state.dot_actor[r])),
+        dot_counter=state.dot_counter.at[r].set(
+            jnp.where(hit, 0, state.dot_counter[r])),
+        deleted=state.deleted.at[r].set(state.deleted[r] | hit),
+        del_dot_actor=state.del_dot_actor.at[r].set(
+            jnp.where(hit, state.actor[r], state.del_dot_actor[r])),
+        del_dot_counter=state.del_dot_counter.at[r].set(
+            jnp.where(hit, new_counter, state.del_dot_counter[r])),
+        processed=state.processed.at[r, a].set(new_counter),
+    )

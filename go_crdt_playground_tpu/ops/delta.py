@@ -1,0 +1,276 @@
+"""δ-state merge kernels.
+
+Tensorization of the reference δ prototype (awset-delta_test.go) plus this
+framework's v2 semantics (see models/spec.py AWSetDelta docstring for the
+full semantics discussion; every rule here mirrors a spec rule).
+
+Wire model: the reference's ``MakeDeltaMergeData`` is sender-side payload
+compression against the receiver's advertised VV (awset-delta_test.go:79-105).
+Here a payload is a pair of masked dense tensors — ``changed`` lanes carry
+live dots, ``deleted`` lanes carry deletion dots.  The empty-δ early return
+(awset-delta_test.go:60-64) becomes a masked no-op lane, not control flow
+(SURVEY §5.8).  Bandwidth-compacted payloads (fixed-K index form) live in
+ops/compact.py; the dense form here is what the on-chip gossip rounds use.
+
+GC is the one place the TPU design intentionally diverges from per-peer
+bookkeeping: the spec tracks each peer's advertised ``processed`` vector,
+while the batched SPMD system computes the exact causal-stability frontier
+with one collective — ``min`` of ``processed`` over the replica axis
+(gc_frontier).  Safety is identical (a record is dropped only when every
+participating replica's state reflects it); the collective just learns the
+frontier without per-peer gossip.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
+from go_crdt_playground_tpu.ops.vv import has_dot, vv_join
+
+
+class DeltaPayload(NamedTuple):
+    """Sender-compressed δ payload (one replica pair; batched via vmap).
+
+    changed lanes: entries the receiver's clock hasn't covered
+    (awset-delta_test.go:84-92).  deleted lanes: deletion records not
+    obsoleted by a local re-add (awset-delta_test.go:93-102).
+    """
+
+    src_vv: jnp.ndarray        # uint32[A]
+    changed: jnp.ndarray       # bool[E]
+    ch_da: jnp.ndarray         # uint32[E]  live dots on changed lanes
+    ch_dc: jnp.ndarray         # uint32[E]
+    deleted: jnp.ndarray       # bool[E]
+    del_da: jnp.ndarray        # uint32[E]  deletion dots on deleted lanes
+    del_dc: jnp.ndarray        # uint32[E]
+    src_actor: jnp.ndarray     # uint32[]
+    src_processed: jnp.ndarray # uint32[A]  (v2 bookkeeping; zeros otherwise)
+
+    def nbytes_dense(self) -> int:
+        return sum(x.size * x.dtype.itemsize for x in self)
+
+
+def delta_extract(src: AWSetDeltaState, dst_vv: jnp.ndarray) -> DeltaPayload:
+    """Sender-side ``MakeDeltaMergeData`` (awset-delta_test.go:79-105) for
+    one src replica against one receiver VV.  Shapes: src fields [A]/[E]
+    (single replica slice), dst_vv uint32[A]."""
+    changed = src.present & ~has_dot(dst_vv, src.dot_actor, src.dot_counter)
+    # re-add filter: skip records whose key is live locally under a
+    # different actor or a higher counter (awset-delta_test.go:94-97)
+    resurrected = src.present & (
+        (src.dot_actor != src.del_dot_actor)
+        | (src.dot_counter > src.del_dot_counter)
+    )
+    deleted = src.deleted & ~resurrected
+    return DeltaPayload(
+        src_vv=src.vv,
+        changed=changed,
+        ch_da=jnp.where(changed, src.dot_actor, 0),
+        ch_dc=jnp.where(changed, src.dot_counter, 0),
+        deleted=deleted,
+        del_da=jnp.where(deleted, src.del_dot_actor, 0),
+        del_dc=jnp.where(deleted, src.del_dot_counter, 0),
+        src_actor=src.actor,
+        src_processed=src.processed,
+    )
+
+
+def delta_apply(
+    dst: AWSetDeltaState,
+    p: DeltaPayload,
+    delta_semantics: str = "reference",
+    strict_reference_semantics: bool = True,
+) -> AWSetDeltaState:
+    """Receiver-side ``deltaMerge`` (awset-delta_test.go:107-166) for one
+    dst replica slice.  Branch-free; the mode strings are static."""
+    # PHASE 1 over changed lanes — identical decision table to full-merge
+    # phase 1 (awset-delta_test.go:126-147 vs awset.go:122-143).
+    p1_take = p.changed & (dst.present | ~has_dot(dst.vv, p.ch_da, p.ch_dc))
+    present1 = dst.present | p1_take
+    da1 = jnp.where(p1_take, p.ch_da, dst.dot_actor)
+    dc1 = jnp.where(p1_take, p.ch_dc, dst.dot_counter)
+
+    # PHASE 2 over deletion lanes.
+    if delta_semantics == "v2":
+        # v2 arbitration == full-merge phase 2 (awset.go:152) restricted to
+        # the payload keys: remove iff the SENDER's clock covers our LIVE
+        # dot.  (Key absent at sender is guaranteed by payload
+        # construction.)  Preserves add-wins in any topology.
+        remove = p.deleted & present1 & has_dot(p.src_vv, da1, dc1)
+    else:
+        # Reference arbitration (awset-delta_test.go:153-158): keep iff OUR
+        # clock covers the DELETION dot.
+        remove = p.deleted & present1 & ~has_dot(dst.vv, p.del_da, p.del_dc)
+
+    present = present1 & ~remove
+    da = jnp.where(present, da1, 0)
+    dc = jnp.where(present, dc1, 0)
+
+    # VV join — skipped on an all-empty payload under the strict reference
+    # quirk (awset-delta_test.go:60-64), as a masked select rather than
+    # control flow.
+    joined = vv_join(dst.vv, p.src_vv)
+    if delta_semantics == "reference" and strict_reference_semantics:
+        empty = ~(jnp.any(p.changed) | jnp.any(p.deleted))
+        vv = jnp.where(empty, dst.vv, joined)
+        # the early return also skips the entry/dot updates; on an empty
+        # payload the masks are all-false so present/da/dc already equal
+        # dst's — nothing further to select.
+    else:
+        vv = joined
+
+    if delta_semantics == "v2":
+        # absorb received records for transitive re-gossip (spec
+        # _absorb_records: overwrite if absent or newer counter)
+        take_rec = p.deleted & (~dst.deleted | (p.del_dc > dst.del_dot_counter))
+        deleted_log = dst.deleted | p.deleted
+        del_da = jnp.where(take_rec, p.del_da, dst.del_dot_actor)
+        del_dc = jnp.where(take_rec, p.del_dc, dst.del_dot_counter)
+        # join processed (spec _join_processed): elementwise max plus the
+        # sender's own slot advancing to its clock
+        processed = jnp.maximum(dst.processed, p.src_processed)
+        idx = p.src_actor.astype(jnp.int32)
+        processed = processed.at[idx].max(p.src_vv[idx])
+    else:
+        deleted_log = dst.deleted
+        del_da = dst.del_dot_actor
+        del_dc = dst.del_dot_counter
+        processed = dst.processed
+
+    return AWSetDeltaState(
+        vv=vv, present=present, dot_actor=da, dot_counter=dc,
+        actor=dst.actor, deleted=deleted_log, del_dot_actor=del_da,
+        del_dot_counter=del_dc, processed=processed,
+    )
+
+
+def _full_merge_delta(dst: AWSetDeltaState, src: AWSetDeltaState,
+                      delta_semantics: str) -> AWSetDeltaState:
+    """First-contact branch (awset-delta_test.go:53-56): plain full-state
+    merge.  Reference mode leaves the receiver's log untouched; v2 absorbs
+    src's log and processed vector (the merged state reflects every
+    deletion src's state reflected — spec merge())."""
+    from go_crdt_playground_tpu.ops.merge import merge_kernel
+
+    vv, present, da, dc, _ = merge_kernel(
+        dst.vv, dst.present, dst.dot_actor, dst.dot_counter,
+        src.vv, src.present, src.dot_actor, src.dot_counter,
+    )
+    if delta_semantics == "v2":
+        take_rec = src.deleted & (~dst.deleted
+                                  | (src.del_dot_counter > dst.del_dot_counter))
+        deleted_log = dst.deleted | src.deleted
+        del_da = jnp.where(take_rec, src.del_dot_actor, dst.del_dot_actor)
+        del_dc = jnp.where(take_rec, src.del_dot_counter, dst.del_dot_counter)
+        processed = jnp.maximum(dst.processed, src.processed)
+        idx = src.actor.astype(jnp.int32)
+        processed = processed.at[idx].max(src.vv[idx])
+    else:
+        deleted_log = dst.deleted
+        del_da = dst.del_dot_actor
+        del_dc = dst.del_dot_counter
+        processed = dst.processed
+    return AWSetDeltaState(
+        vv=vv, present=present, dot_actor=da, dot_counter=dc,
+        actor=dst.actor, deleted=deleted_log, del_dot_actor=del_da,
+        del_dot_counter=del_dc, processed=processed,
+    )
+
+
+def delta_merge_pair(
+    dst: AWSetDeltaState,
+    src: AWSetDeltaState,
+    delta_semantics: str = "reference",
+    strict_reference_semantics: bool = True,
+) -> AWSetDeltaState:
+    """One replica-pair δ-dispatch merge (awset-delta_test.go:51-65):
+    full merge on first contact (our counter for src's actor is 0), δ
+    extract+apply otherwise.  Both branches are computed densely and
+    selected per field — the TPU way to express the reference's
+    ``if Counter(src.Actor) <= 0`` control flow."""
+    first_contact = dst.vv[src.actor.astype(jnp.int32)] == 0
+    full = _full_merge_delta(dst, src, delta_semantics)
+    payload = delta_extract(src, dst.vv)
+    delt = delta_apply(dst, payload, delta_semantics,
+                       strict_reference_semantics)
+    return jax.tree.map(
+        lambda f, d: jnp.where(
+            jnp.reshape(first_contact, (1,) * f.ndim), f, d),
+        full, delt,
+    )
+
+
+def delta_merge_pairwise(
+    dst: AWSetDeltaState,
+    src: AWSetDeltaState,
+    delta_semantics: str = "reference",
+    strict_reference_semantics: bool = True,
+) -> AWSetDeltaState:
+    """Batched ``dst[r] <- src[r]`` δ merge (vmapped delta_merge_pair)."""
+    return jax.vmap(
+        lambda d, s: delta_merge_pair(
+            d, s, delta_semantics, strict_reference_semantics)
+    )(dst, src)
+
+
+delta_merge_pairwise_jit = jax.jit(
+    delta_merge_pairwise,
+    static_argnames=("delta_semantics", "strict_reference_semantics"),
+)
+
+
+def delta_merge_one_into(
+    dst: AWSetDeltaState, r_dst: int,
+    src: AWSetDeltaState, r_src: int,
+    delta_semantics: str = "reference",
+    strict_reference_semantics: bool = True,
+) -> AWSetDeltaState:
+    """Scenario-style single δ merge (the reference harness's direct method
+    call, awset-delta_test.go:173)."""
+    d = jax.tree.map(lambda x: x[r_dst], dst)
+    s = jax.tree.map(lambda x: x[r_src], src)
+    merged = delta_merge_pair(d, s, delta_semantics,
+                              strict_reference_semantics)
+    return jax.tree.map(lambda full, row: full.at[r_dst].set(row), dst,
+                        merged)
+
+
+# ---------------------------------------------------------------------------
+# δ-log GC — causal stability via a collective frontier (TPU-native design;
+# the reference's gcDeleted is an empty stub, awset-delta_test.go:67-77)
+# ---------------------------------------------------------------------------
+
+
+def gc_frontier(processed: jnp.ndarray,
+                participating: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Exact causal-stability frontier: frontier[a] = min over participating
+    replicas of processed[r, a].  A deletion record (k, (a, c)) is stable
+    iff c <= frontier[a] — every participating replica's state reflects it.
+
+    processed: uint32[R, A]; participating: bool[R] (None = all).  Under a
+    sharded replica axis this min is ``jax.lax.pmin`` over the mesh
+    (parallel/collectives.py wraps it)."""
+    if participating is not None:
+        big = jnp.asarray(jnp.iinfo(processed.dtype).max, processed.dtype)
+        processed = jnp.where(participating[:, None], processed, big)
+    return jnp.min(processed, axis=0)
+
+
+@jax.jit
+def gc_apply(state: AWSetDeltaState,
+             frontier: jnp.ndarray) -> AWSetDeltaState:
+    """Drop stable deletion records: deleted lanes whose dot counter is
+    covered by the frontier for the dot's origin actor."""
+    covered = jnp.take(frontier, state.del_dot_actor.astype(jnp.int32),
+                       mode="clip")
+    stable = state.deleted & (state.del_dot_counter <= covered)
+    keep = state.deleted & ~stable
+    return state._replace(
+        deleted=keep,
+        del_dot_actor=jnp.where(keep, state.del_dot_actor, 0),
+        del_dot_counter=jnp.where(keep, state.del_dot_counter, 0),
+    )
