@@ -51,9 +51,25 @@ class AWSetState(NamedTuple):
 def init(num_replicas: int, num_elements: int, num_actors: int,
          actors=None) -> AWSetState:
     """Fresh empty replicas (the testAWSetInit fixture shape,
-    awset_test.go:159-168: replica r is actor r unless given)."""
+    awset_test.go:159-168: replica r is actor r).
+
+    INVARIANT — unique writers: an actor id must never be ticked by two
+    replicas concurrently; dots are only causally meaningful if (actor,
+    counter) names one event (the reference guarantees this structurally,
+    one Actor per struct).  Two replicas sharing an actor id and both
+    calling add() produce colliding dots, after which VV coverage triggers
+    spurious phase-1 skips / phase-2 removals.  The default therefore
+    requires A >= R with actor r for replica r.  Pass ``actors`` explicitly
+    for observer topologies (A < R) where the extra replicas only merge,
+    never add — e.g. read-replica fleets and the large-R benchmarks."""
     if actors is None:
-        actors = jnp.arange(num_replicas, dtype=jnp.uint32) % num_actors
+        if num_actors < num_replicas:
+            raise ValueError(
+                f"default actor assignment needs num_actors ({num_actors}) "
+                f">= num_replicas ({num_replicas}); pass explicit actors= "
+                "for an observer topology (replicas that never add)"
+            )
+        actors = jnp.arange(num_replicas, dtype=jnp.uint32)
     else:
         actors = jnp.asarray(actors, jnp.uint32)
     return AWSetState(

@@ -1,0 +1,68 @@
+"""Global reductions over the replica axis: VV join, convergence detection,
+GC frontier.
+
+Reference analogue: none — the reference's "network" is a direct method
+call (awset_test.go:16-17) and convergence is eyeballed via printstate.
+Here convergence detection is a first-class collective: a commutative
+membership hash per replica, reduced with min/max — two scalars per replica
+round instead of shipping states around (SURVEY §5.5's
+rounds-to-convergence metric needs this to be cheap).
+
+All reductions are plain jnp ops over the (possibly sharded) replica axis;
+under pjit XLA lowers them to psum/pmax-style collectives over ICI.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Fibonacci hashing multiplier (2^32 / golden ratio, odd) — good avalanche
+# for sequential element ids.
+_MIX = jnp.uint32(0x9E3779B1)
+
+
+def _mix32(x: jnp.ndarray) -> jnp.ndarray:
+    """xorshift-multiply mix of uint32 lanes."""
+    x = x.astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * _MIX
+    x = (x ^ (x >> 13)) * jnp.uint32(0x85EBCA77)
+    return x ^ (x >> 16)
+
+
+def membership_hash(present: jnp.ndarray) -> jnp.ndarray:
+    """Commutative per-replica membership digest: sum of mixed element ids
+    over present lanes.  present: bool[R, E] -> uint32[R].
+
+    Sum (mod 2^32) keeps it order-independent and shard-composable: the
+    hash of a row sharded over E is the psum of shard-local hashes."""
+    E = present.shape[-1]
+    lane = _mix32(jnp.arange(1, E + 1, dtype=jnp.uint32))
+    return jnp.sum(jnp.where(present, lane, 0).astype(jnp.uint32), axis=-1,
+                   dtype=jnp.uint32)
+
+
+def state_digest(present: jnp.ndarray, vv: jnp.ndarray) -> jnp.ndarray:
+    """(membership, VV) digest per replica — the convergence criterion of
+    the reference semantics (per-entry dots may legitimately diverge,
+    SURVEY §3.2, so they are NOT part of the digest)."""
+    mh = membership_hash(present)
+    vh = jnp.sum(_mix32(vv) * _mix32(jnp.arange(
+        1, vv.shape[-1] + 1, dtype=jnp.uint32)), axis=-1, dtype=jnp.uint32)
+    return mh ^ vh
+
+
+def all_equal(digest: jnp.ndarray) -> jnp.ndarray:
+    """True iff every replica's digest agrees (min == max reduction)."""
+    return jnp.min(digest) == jnp.max(digest)
+
+
+def converged(present: jnp.ndarray, vv: jnp.ndarray) -> jnp.ndarray:
+    """Scalar bool: has the whole batch converged on (membership, VV)?"""
+    return all_equal(state_digest(present, vv))
+
+
+def global_vv_join(vv: jnp.ndarray) -> jnp.ndarray:
+    """The all-replica VV join: elementwise max over the replica axis
+    (VersionVector.Merge lifted to the whole fleet, crdt-misc.go:43-55).
+    vv: uint32[R, A] -> uint32[A]."""
+    return jnp.max(vv, axis=0)

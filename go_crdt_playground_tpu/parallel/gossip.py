@@ -1,0 +1,240 @@
+"""Anti-entropy gossip: pairing schedules, merge rounds, fault injection,
+convergence loops.
+
+Reference analogue: a "message exchange" is ``dst.Merge(src)`` between two
+in-process structs (awset_test.go:16-17).  Here one gossip round is a
+single batched tensor op: every replica r absorbs replica ``perm[r]``
+(``state[perm]`` is a gather that XLA lowers to collective-permute /
+all-to-all over ICI when the replica axis is sharded), then the vmapped
+merge kernel runs with zero cross-replica data dependence.
+
+Schedules:
+  * ring (offset 1)        — classic neighbor gossip; O(R) rounds.
+  * dissemination (doubling offsets 1,2,4,...) — converges in ceil(log2 R)
+    rounds; the butterfly realization of "all-pairs" (SURVEY §5.7c): valid
+    because membership-convergence is associative across merge chains
+    [verified, SURVEY §3.2].
+  * butterfly (XOR pairs)  — symmetric exchanges, R power of two.
+  * random pairing         — uniform gossip for fault-injection studies.
+
+Fault injection (SURVEY §5.3): a dropped exchange is a masked no-op lane —
+replica keeps its old state for the round.  State-based merge is idempotent
+and commutative-on-membership, so drops only delay convergence; the
+rounds-to-convergence-under-drop-rate curve is a north-star metric.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from go_crdt_playground_tpu.models.awset import AWSetState
+from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
+from go_crdt_playground_tpu.ops.merge import merge_pairwise
+from go_crdt_playground_tpu.ops.delta import delta_merge_pairwise
+from go_crdt_playground_tpu.parallel import collectives
+from go_crdt_playground_tpu.parallel.mesh import (
+    ELEMENT_AXIS,
+    REPLICA_AXIS,
+    partition_specs,
+)
+
+# ---------------------------------------------------------------------------
+# Pairing schedules (permutations of the replica axis)
+# ---------------------------------------------------------------------------
+
+
+def ring_perm(num_replicas: int, offset: int = 1) -> jnp.ndarray:
+    """Partner of r is (r + offset) mod R."""
+    return (jnp.arange(num_replicas, dtype=jnp.uint32) + offset) % num_replicas
+
+
+def butterfly_perm(num_replicas: int, stage: int) -> jnp.ndarray:
+    """Partner of r is r XOR 2^stage (symmetric pairs; R power of two)."""
+    if num_replicas & (num_replicas - 1):
+        raise ValueError("butterfly needs a power-of-two replica count")
+    if not 0 <= stage or (1 << stage) >= num_replicas:
+        raise ValueError(
+            f"butterfly stage {stage} out of range for R={num_replicas} "
+            f"(need 1 << stage < R; JAX would silently clamp the partners)")
+    return jnp.arange(num_replicas, dtype=jnp.uint32) ^ jnp.uint32(1 << stage)
+
+
+def random_perm(key: jax.Array, num_replicas: int) -> jnp.ndarray:
+    return jax.random.permutation(key, num_replicas).astype(jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# Gossip rounds
+# ---------------------------------------------------------------------------
+
+
+def _select_rows(mask_r: jnp.ndarray, new, old):
+    """Per-replica select between two state pytrees (mask True -> new)."""
+    return jax.tree.map(
+        lambda n, o: jnp.where(mask_r.reshape((-1,) + (1,) * (n.ndim - 1)),
+                               n, o),
+        new, old,
+    )
+
+
+def gossip_round(
+    state: AWSetState,
+    perm: jnp.ndarray,
+    drop_mask: Optional[jnp.ndarray] = None,
+) -> AWSetState:
+    """One full-state anti-entropy round: r <- perm[r] for all r.
+
+    drop_mask: bool[R], True = this replica's exchange is lost this round
+    (it keeps its old state) — fault injection as a masked lane."""
+    src = jax.tree.map(lambda x: x[perm], state)
+    merged, _ = merge_pairwise(state, src)
+    if drop_mask is not None:
+        merged = _select_rows(~drop_mask, merged, state)
+    return merged
+
+
+gossip_round_jit = jax.jit(gossip_round)
+
+
+def delta_gossip_round(
+    state: AWSetDeltaState,
+    perm: jnp.ndarray,
+    drop_mask: Optional[jnp.ndarray] = None,
+    delta_semantics: str = "v2",
+    strict_reference_semantics: bool = True,
+) -> AWSetDeltaState:
+    """One δ anti-entropy round (payload-compressed exchanges)."""
+    src = jax.tree.map(lambda x: x[perm], state)
+    merged = delta_merge_pairwise(state, src, delta_semantics,
+                                  strict_reference_semantics)
+    if drop_mask is not None:
+        merged = _select_rows(~drop_mask, merged, state)
+    return merged
+
+
+delta_gossip_round_jit = jax.jit(
+    delta_gossip_round,
+    static_argnames=("delta_semantics", "strict_reference_semantics"),
+)
+
+
+def dissemination_offsets(num_replicas: int):
+    """Doubling offsets 1, 2, 4, ... — ceil(log2 R) rounds to full
+    convergence on any replica count."""
+    offs, o = [], 1
+    while o < num_replicas:
+        offs.append(o)
+        o *= 2
+    return offs
+
+
+@functools.partial(jax.jit, static_argnames=("delta", "delta_semantics"))
+def all_pairs_converge(state, delta: bool = False,
+                       delta_semantics: str = "v2"):
+    """The all-pairs exchange realized as ceil(log2 R) doubling-offset
+    rounds instead of O(R^2) work (SURVEY §5.7c)."""
+    R = state.vv.shape[0]
+    for off in dissemination_offsets(R):
+        perm = ring_perm(R, off)
+        if delta:
+            state = delta_gossip_round(state, perm,
+                                       delta_semantics=delta_semantics)
+        else:
+            state = gossip_round(state, perm)
+    return state
+
+
+def rounds_to_convergence(
+    state,
+    key: Optional[jax.Array] = None,
+    drop_rate: float = 0.0,
+    max_rounds: int = 10_000,
+    delta: bool = False,
+    delta_semantics: str = "v2",
+    schedule: str = "dissemination",
+) -> Tuple[int, object]:
+    """Host-driven convergence loop: gossip until every replica agrees on
+    (membership, VV); returns (rounds, final state).  The north-star
+    metric's measurement harness (BASELINE.md).
+
+    With drop_rate > 0 each replica's exchange is lost independently per
+    round (requires ``key``)."""
+    R = state.vv.shape[0]
+    offsets = dissemination_offsets(R) or [1]
+    round_fn = delta_gossip_round_jit if delta else gossip_round_jit
+
+    for rnd in range(max_rounds):
+        if bool(collectives.converged(state.present, state.vv)):
+            return rnd, state
+        if schedule == "dissemination":
+            perm = ring_perm(R, offsets[rnd % len(offsets)])
+        elif schedule == "ring":
+            perm = ring_perm(R, 1)
+        elif schedule == "random":
+            if key is None:
+                raise ValueError("random schedule requires a key")
+            key, sub = jax.random.split(key)
+            perm = random_perm(sub, R)
+        else:
+            raise ValueError(f"unknown schedule {schedule!r}")
+        drop = None
+        if drop_rate > 0.0:
+            if key is None:
+                raise ValueError("drop_rate requires a key")
+            key, sub = jax.random.split(key)
+            drop = jax.random.bernoulli(sub, drop_rate, (R,))
+        if delta:
+            state = round_fn(state, perm, drop,
+                             delta_semantics=delta_semantics)
+        else:
+            state = round_fn(state, perm, drop)
+    if not bool(collectives.converged(state.present, state.vv)):
+        raise RuntimeError(
+            f"no convergence within {max_rounds} rounds "
+            f"(schedule={schedule!r}, drop_rate={drop_rate}) — refusing to "
+            "report an exhausted budget as a measured rounds-to-convergence")
+    return max_rounds, state
+
+
+# ---------------------------------------------------------------------------
+# Explicit shard_map ring (collectives pinned to ICI neighbors)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_step_compiled(mesh: Mesh, state_cls):
+    """Cached jitted shard_map ring step per (mesh, state type) — a fresh
+    jit per call would recompile the program every round."""
+    n = mesh.shape[REPLICA_AXIS]
+    pairs = [(i, (i + 1) % n) for i in range(n)]
+    specs = partition_specs(state_cls)
+
+    def step(local):
+        recv = jax.tree.map(
+            lambda x: jax.lax.ppermute(x, REPLICA_AXIS, pairs), local)
+        merged, _ = merge_pairwise(local, recv)
+        return merged
+
+    return jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs)
+    )
+
+
+def ring_round_shardmap(state: AWSetState, mesh: Mesh) -> AWSetState:
+    """One ring round with the communication pinned explicitly: each
+    replica-shard ppermutes its whole block to the next device over the
+    ring (ICI neighbor), then every replica merges with the received
+    peer — the ring-anti-entropy schedule of SURVEY §5.7b, the set-merge
+    analogue of ring attention's neighbor exchange.
+
+    Full-state AWSet only: the merge kernel has no cross-element
+    reductions, so an element-sharded block is self-contained.  (The δ
+    kernel's strict mode reduces over E — route δ gossip through
+    delta_gossip_round under jit instead, where XLA inserts the psum.)
+    """
+    return _ring_step_compiled(mesh, type(state))(state)

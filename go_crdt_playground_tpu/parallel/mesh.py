@@ -1,0 +1,80 @@
+"""Device mesh + sharding layout for packed CRDT states.
+
+The scaling axes (SURVEY §2.3) are replicas ``R`` (data-parallel: each Go
+``AWSet`` struct was one replica) and the element universe ``E``
+(tensor-parallel: the merge is elementwise, so sharding E is clean).  The
+actor axis ``A`` stays replicated — it is small and every HasDot gather
+reads it.
+
+Layout:
+  vv[R, A], processed[R, A]  -> P(REPLICA_AXIS, None)
+  present/dots[R, E]         -> P(REPLICA_AXIS, ELEMENT_AXIS)
+  actor[R]                   -> P(REPLICA_AXIS)
+
+Gossip permutations move whole replica rows between replica shards
+(XLA lowers them to collective-permute/all-to-all over ICI); element shards
+never need to communicate during a merge — the kernel is elementwise over E
+with only the (replicated) vv read across lanes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+REPLICA_AXIS = "replica"
+ELEMENT_AXIS = "element"
+
+
+def make_mesh(mesh_shape: Optional[Tuple[int, int]] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a (replica_shards, element_shards) mesh.  Default: all devices
+    on the replica axis (gossip bandwidth rides ICI; the element axis only
+    matters once E outgrows a single chip's HBM)."""
+    if devices is None:
+        devices = jax.devices()
+    if mesh_shape is None:
+        mesh_shape = (len(devices), 1)
+    r, e = mesh_shape
+    if r * e != len(devices):
+        raise ValueError(f"mesh_shape {mesh_shape} != #devices {len(devices)}")
+    arr = np.asarray(devices).reshape(r, e)
+    return Mesh(arr, (REPLICA_AXIS, ELEMENT_AXIS))
+
+
+# Actor-axis fields stay replicated across element shards; everything else
+# element-shaped is sharded on both axes.  Keyed by field name (shapes alone
+# are ambiguous when A == E).
+_ACTOR_AXIS_FIELDS = frozenset({"vv", "processed"})
+_REPLICA_ONLY_FIELDS = frozenset({"actor"})
+
+
+def partition_specs(state_cls):
+    """PartitionSpec pytree for an AWSetState / AWSetDeltaState class —
+    the single source of truth for the layout (state_sharding and the
+    shard_map rounds both build on it)."""
+    return state_cls(**{
+        name: (
+            P(REPLICA_AXIS) if name in _REPLICA_ONLY_FIELDS
+            else P(REPLICA_AXIS, None) if name in _ACTOR_AXIS_FIELDS
+            else P(REPLICA_AXIS, ELEMENT_AXIS)
+        )
+        for name in state_cls._fields
+    })
+
+
+def state_sharding(state, mesh: Mesh):
+    """NamedShardings for an AWSetState / AWSetDeltaState pytree."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        partition_specs(type(state)),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_state(state, mesh: Mesh):
+    """Place a packed state onto the mesh with the canonical layout."""
+    return jax.tree.map(jax.device_put, state, state_sharding(state, mesh))
