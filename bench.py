@@ -1,0 +1,109 @@
+"""Benchmark: replica-pair merges/sec/chip (AWSet, 256 elems).
+
+BASELINE.md config 3 — 10K replicas x 256 elements, vmapped dot-context
+merge — measured as sustained anti-entropy gossip throughput on the
+default platform (the real TPU chip under the driver).
+
+The reference publishes no numbers (SURVEY §6: no Benchmark* functions,
+README is one line), and no Go toolchain exists in this environment, so
+``vs_baseline`` is the speedup over the single-core executable spec
+(models/spec.py) running the SAME pair merge on the same element count —
+the go-test-equivalent semantics executed in-process, our only executable
+stand-in for the reference implementation.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "merges/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def build_state(num_replicas: int, num_elements: int, num_writers: int):
+    """Vectorized construction of a valid fleet: rows < num_writers are
+    writers (unique actors) that each added a row-dependent slice of the
+    element universe in element order; the rest are observers (explicit
+    aliased actor ids are safe — they never tick a clock)."""
+    import jax.numpy as jnp
+
+    from go_crdt_playground_tpu.models import awset
+
+    R, E, W = num_replicas, num_elements, num_writers
+    actors = np.arange(R, dtype=np.uint32) % W
+    state = awset.init(R, E, W, actors=actors)
+    r = jnp.arange(R, dtype=jnp.uint32)[:, None]
+    e = jnp.arange(E, dtype=jnp.uint32)[None, :]
+    writer = r < W
+    present = writer & ((e * 2654435761 + r * 40503) % 5 < 2)
+    counter = jnp.cumsum(present, axis=1, dtype=jnp.uint32) * present
+    vv = jnp.zeros((R, W), jnp.uint32).at[
+        jnp.arange(R), jnp.asarray(actors)].max(counter.max(axis=1))
+    return state._replace(
+        vv=vv,
+        present=present,
+        dot_actor=jnp.where(present, r % W, 0),
+        dot_counter=counter,
+    )
+
+
+def measure_tpu(num_replicas=10_000, num_elements=256, num_writers=256,
+                timed_rounds=30):
+    import jax
+
+    from go_crdt_playground_tpu.parallel import gossip
+
+    state = build_state(num_replicas, num_elements, num_writers)
+    offsets = gossip.dissemination_offsets(num_replicas)
+    perms = [np.asarray(gossip.ring_perm(num_replicas, o)) for o in offsets]
+
+    # warmup (compile)
+    out = gossip.gossip_round_jit(state, perms[0])
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    cur = state
+    for i in range(timed_rounds):
+        cur = gossip.gossip_round_jit(cur, perms[i % len(perms)])
+    jax.block_until_ready(cur)
+    dt = time.perf_counter() - t0
+    return num_replicas * timed_rounds / dt
+
+
+def measure_spec_baseline(num_elements=256, merges=60):
+    """Single-core dict-model pair-merge rate at the same element count."""
+    from go_crdt_playground_tpu.models.spec import AWSet, VersionVector
+
+    def writer(actor):
+        s = AWSet(actor=actor, version_vector=VersionVector([0, 0]))
+        s.add(*(f"e{i}" for i in range(0, num_elements, 2 + actor)))
+        return s
+
+    t0 = time.perf_counter()
+    n = 0
+    while n < merges:
+        a, b = writer(0), writer(1)
+        for _ in range(10):
+            a.merge(b)
+            b.merge(a)
+            n += 2
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main():
+    tpu_rate = measure_tpu()
+    spec_rate = measure_spec_baseline()
+    print(json.dumps({
+        "metric": "replica-pair merges/sec/chip (AWSet, 256 elems)",
+        "value": round(tpu_rate, 1),
+        "unit": "merges/sec/chip",
+        "vs_baseline": round(tpu_rate / spec_rate, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,375 @@
+"""Batched lattice-join kernels for the non-AWSet CRDT families.
+
+Every family is a NamedTuple of arrays batched over the replica axis ``R``
+with an elementwise monotone join — the same shape as the AWSet kernel but
+simpler, so they all ride the existing gossip machinery: any ``join(dst,
+src) -> merged`` pytree function plugs into a permutation round exactly
+like ops/merge.merge_pairwise (parallel/gossip.py's pattern of
+``src = state[perm]``).
+
+Conformance oracles: models/spec_extra.py.  The G-Counter join IS the
+reference's VersionVector.Merge (crdt-misc.go:43-55) batched; BASELINE
+config 2 measures it at 1K replicas.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# G-Counter / PN-Counter
+# ---------------------------------------------------------------------------
+
+
+class GCounterState(NamedTuple):
+    counts: jnp.ndarray   # uint32[R, A]
+    actor: jnp.ndarray    # uint32[R]
+
+
+def gcounter_init(num_replicas: int, num_actors: int,
+                  actors=None) -> GCounterState:
+    if actors is None:
+        if num_actors < num_replicas:
+            raise ValueError("need num_actors >= num_replicas by default")
+        actors = jnp.arange(num_replicas, dtype=jnp.uint32)
+    return GCounterState(
+        counts=jnp.zeros((num_replicas, num_actors), jnp.uint32),
+        actor=jnp.asarray(actors, jnp.uint32),
+    )
+
+
+@jax.jit
+def gcounter_inc(state: GCounterState, replica: jnp.ndarray,
+                 amount: jnp.ndarray) -> GCounterState:
+    r = replica.astype(jnp.int32)
+    a = state.actor[r].astype(jnp.int32)
+    return state._replace(counts=state.counts.at[r, a].add(amount))
+
+
+def gcounter_value(state: GCounterState) -> "np.ndarray":
+    """uint64[R] host array — sums can exceed uint32, and JAX truncates
+    64-bit math without the global x64 flag, so the observer runs on host
+    (it is not a merge-path op)."""
+    import numpy as np
+
+    return np.asarray(state.counts).astype(np.uint64).sum(axis=-1)
+
+
+def gcounter_join(dst: GCounterState, src: GCounterState) -> GCounterState:
+    """Elementwise max (VersionVector.Merge batched, crdt-misc.go:43-55)."""
+    return dst._replace(counts=jnp.maximum(dst.counts, src.counts))
+
+
+class PNCounterState(NamedTuple):
+    p: jnp.ndarray        # uint32[R, A]
+    n: jnp.ndarray        # uint32[R, A]
+    actor: jnp.ndarray    # uint32[R]
+
+
+def pncounter_init(num_replicas: int, num_actors: int,
+                   actors=None) -> PNCounterState:
+    g = gcounter_init(num_replicas, num_actors, actors)
+    return PNCounterState(p=g.counts, n=g.counts, actor=g.actor)
+
+
+@jax.jit
+def pncounter_add(state: PNCounterState, replica: jnp.ndarray,
+                  amount: jnp.ndarray) -> PNCounterState:
+    """amount: int32 scalar; positive increments P, negative increments N."""
+    r = replica.astype(jnp.int32)
+    a = state.actor[r].astype(jnp.int32)
+    pos = jnp.maximum(amount, 0).astype(jnp.uint32)
+    neg = jnp.maximum(-amount, 0).astype(jnp.uint32)
+    return state._replace(
+        p=state.p.at[r, a].add(pos),
+        n=state.n.at[r, a].add(neg),
+    )
+
+
+def pncounter_value(state: PNCounterState) -> "np.ndarray":
+    """int64[R] host array (see gcounter_value for why host-side)."""
+    import numpy as np
+
+    return (np.asarray(state.p).astype(np.int64).sum(axis=-1)
+            - np.asarray(state.n).astype(np.int64).sum(axis=-1))
+
+
+def pncounter_join(dst: PNCounterState, src: PNCounterState) -> PNCounterState:
+    return dst._replace(p=jnp.maximum(dst.p, src.p),
+                        n=jnp.maximum(dst.n, src.n))
+
+
+# ---------------------------------------------------------------------------
+# 2P-Set
+# ---------------------------------------------------------------------------
+
+
+class TwoPSetState(NamedTuple):
+    added: jnp.ndarray     # bool[R, E]
+    removed: jnp.ndarray   # bool[R, E]
+
+
+def twopset_init(num_replicas: int, num_elements: int) -> TwoPSetState:
+    z = jnp.zeros((num_replicas, num_elements), bool)
+    return TwoPSetState(added=z, removed=z)
+
+
+@jax.jit
+def twopset_add(state: TwoPSetState, replica: jnp.ndarray,
+                element: jnp.ndarray) -> TwoPSetState:
+    r, e = replica.astype(jnp.int32), element.astype(jnp.int32)
+    return state._replace(added=state.added.at[r, e].set(True))
+
+
+@jax.jit
+def twopset_del(state: TwoPSetState, replica: jnp.ndarray,
+                element: jnp.ndarray) -> TwoPSetState:
+    """Remove-wins tombstone; only observed elements can be removed."""
+    r, e = replica.astype(jnp.int32), element.astype(jnp.int32)
+    observed = state.added[r, e]
+    return state._replace(
+        removed=state.removed.at[r, e].set(state.removed[r, e] | observed))
+
+
+def twopset_member(state: TwoPSetState) -> jnp.ndarray:
+    return state.added & ~state.removed
+
+
+def twopset_join(dst: TwoPSetState, src: TwoPSetState) -> TwoPSetState:
+    """Pairwise OR joins — remove wins forever."""
+    return TwoPSetState(added=dst.added | src.added,
+                        removed=dst.removed | src.removed)
+
+
+# ---------------------------------------------------------------------------
+# LWW-Map (last-writer-wins cells; LWW-Register is the E == 1 case)
+# ---------------------------------------------------------------------------
+
+
+class LWWMapState(NamedTuple):
+    ts: jnp.ndarray        # uint32[R, E]  caller-supplied logical stamps,
+                           #               >= 1 (0 means "never written")
+    wr_actor: jnp.ndarray  # uint32[R, E]  tie-break (higher actor wins)
+    val: jnp.ndarray       # uint32[R, E]
+    live: jnp.ndarray      # bool[R, E]    False = tombstone / never written
+    actor: jnp.ndarray     # uint32[R]
+
+
+def lwwmap_init(num_replicas: int, num_elements: int,
+                actors=None) -> LWWMapState:
+    if actors is None:
+        actors = jnp.arange(num_replicas, dtype=jnp.uint32)
+    zE = jnp.zeros((num_replicas, num_elements), jnp.uint32)
+    return LWWMapState(ts=zE, wr_actor=zE, val=zE,
+                       live=jnp.zeros((num_replicas, num_elements), bool),
+                       actor=jnp.asarray(actors, jnp.uint32))
+
+
+def _lww_newer(ts_a, actor_a, ts_b, actor_b):
+    """Lexicographic (ts, actor) comparison: a > b."""
+    return (ts_a > ts_b) | ((ts_a == ts_b) & (actor_a > actor_b))
+
+
+@jax.jit
+def lwwmap_put(state: LWWMapState, replica: jnp.ndarray,
+               element: jnp.ndarray, value: jnp.ndarray,
+               ts: jnp.ndarray, live: jnp.ndarray) -> LWWMapState:
+    """Write (or tombstone with live=False) if (ts, actor) beats the cell.
+    ts must be >= 1 — unwritten cells are (0, 0), so any valid stamp beats
+    them (callers own the logical clock; the spec model validates)."""
+    r, e = replica.astype(jnp.int32), element.astype(jnp.int32)
+    a = state.actor[r]
+    take = _lww_newer(ts, a, state.ts[r, e], state.wr_actor[r, e])
+    return LWWMapState(
+        ts=state.ts.at[r, e].set(jnp.where(take, ts, state.ts[r, e])),
+        wr_actor=state.wr_actor.at[r, e].set(
+            jnp.where(take, a, state.wr_actor[r, e])),
+        val=state.val.at[r, e].set(jnp.where(take, value, state.val[r, e])),
+        live=state.live.at[r, e].set(
+            jnp.where(take, live, state.live[r, e])),
+        actor=state.actor,
+    )
+
+
+def lwwmap_join(dst: LWWMapState, src: LWWMapState) -> LWWMapState:
+    """Per-cell lexicographic (ts, actor) max; deterministic in any merge
+    order."""
+    take = _lww_newer(src.ts, src.wr_actor, dst.ts, dst.wr_actor)
+    return LWWMapState(
+        ts=jnp.where(take, src.ts, dst.ts),
+        wr_actor=jnp.where(take, src.wr_actor, dst.wr_actor),
+        val=jnp.where(take, src.val, dst.val),
+        live=jnp.where(take, src.live, dst.live),
+        actor=dst.actor,
+    )
+
+
+# ---------------------------------------------------------------------------
+# MV-Register (multi-value; optimized per-actor slots)
+# ---------------------------------------------------------------------------
+
+
+class MVRegisterState(NamedTuple):
+    ctx: jnp.ndarray    # uint32[R, A] causal context
+    live: jnp.ndarray   # bool[R, A]   slot holds a visible value
+    cnt: jnp.ndarray    # uint32[R, A] write counter per slot
+    val: jnp.ndarray    # uint32[R, A]
+    actor: jnp.ndarray  # uint32[R]
+
+
+def mvregister_init(num_replicas: int, num_actors: int,
+                    actors=None) -> MVRegisterState:
+    if actors is None:
+        if num_actors < num_replicas:
+            raise ValueError("need num_actors >= num_replicas by default")
+        actors = jnp.arange(num_replicas, dtype=jnp.uint32)
+    z = jnp.zeros((num_replicas, num_actors), jnp.uint32)
+    return MVRegisterState(ctx=z, live=z.astype(bool), cnt=z, val=z,
+                           actor=jnp.asarray(actors, jnp.uint32))
+
+
+@jax.jit
+def mvregister_write(state: MVRegisterState, replica: jnp.ndarray,
+                     value: jnp.ndarray) -> MVRegisterState:
+    """A write observes (and so replaces) every currently-visible value."""
+    r = replica.astype(jnp.int32)
+    a = state.actor[r].astype(jnp.int32)
+    new_c = state.ctx[r, a] + 1
+    A = state.ctx.shape[-1]
+    onehot = jnp.arange(A, dtype=jnp.uint32) == state.actor[r]
+    return MVRegisterState(
+        ctx=state.ctx.at[r, a].set(new_c),
+        live=state.live.at[r].set(onehot),
+        cnt=state.cnt.at[r].set(jnp.where(onehot, new_c, 0)),
+        val=state.val.at[r].set(jnp.where(onehot, value, 0)),
+        actor=state.actor,
+    )
+
+
+def mvregister_join(dst: MVRegisterState,
+                    src: MVRegisterState) -> MVRegisterState:
+    """Per-actor-slot arbitration mirroring spec_extra.MVRegister.merge:
+    both live -> newer counter; src-only live -> adopt iff beyond our
+    context; dst-only live -> drop iff src's context covers it."""
+    both = dst.live & src.live
+    take_src = (both & (src.cnt > dst.cnt)) | (
+        src.live & ~dst.live & (src.cnt > dst.ctx))
+    drop_dst = dst.live & ~src.live & (dst.cnt <= src.ctx)
+    live = (dst.live & ~drop_dst) | take_src
+    cnt = jnp.where(take_src, src.cnt, dst.cnt)
+    val = jnp.where(take_src, src.val, dst.val)
+    cnt = jnp.where(live, cnt, 0)
+    val = jnp.where(live, val, 0)
+    return MVRegisterState(
+        ctx=jnp.maximum(dst.ctx, src.ctx),
+        live=live, cnt=cnt, val=val, actor=dst.actor,
+    )
+
+
+# ---------------------------------------------------------------------------
+# OR-Map (AWSet key membership + LWW value cells)
+# ---------------------------------------------------------------------------
+
+
+class ORMapState(NamedTuple):
+    """Keys follow the AWSet arrays exactly (models/awset.py layout);
+    cells are an LWWMapState sans its own actor row.  See
+    spec_extra.ORMap for the value-lifetime semantics."""
+
+    vv: jnp.ndarray           # uint32[R, A]
+    present: jnp.ndarray      # bool[R, E]
+    dot_actor: jnp.ndarray    # uint32[R, E]
+    dot_counter: jnp.ndarray  # uint32[R, E]
+    actor: jnp.ndarray        # uint32[R]
+    ts: jnp.ndarray           # uint32[R, E]
+    wr_actor: jnp.ndarray     # uint32[R, E]
+    val: jnp.ndarray          # uint32[R, E]
+
+
+def ormap_init(num_replicas: int, num_elements: int, num_actors: int,
+               actors=None) -> ORMapState:
+    from go_crdt_playground_tpu.models import awset
+
+    base = awset.init(num_replicas, num_elements, num_actors, actors)
+    zE = jnp.zeros((num_replicas, num_elements), jnp.uint32)
+    return ORMapState(vv=base.vv, present=base.present,
+                      dot_actor=base.dot_actor,
+                      dot_counter=base.dot_counter, actor=base.actor,
+                      ts=zE, wr_actor=zE, val=zE)
+
+
+@jax.jit
+def ormap_put(state: ORMapState, replica: jnp.ndarray,
+              element: jnp.ndarray, value: jnp.ndarray,
+              ts: jnp.ndarray) -> ORMapState:
+    from go_crdt_playground_tpu.models import awset
+
+    base = awset.add_element(
+        awset.AWSetState(vv=state.vv, present=state.present,
+                         dot_actor=state.dot_actor,
+                         dot_counter=state.dot_counter, actor=state.actor),
+        replica, element)
+    r, e = replica.astype(jnp.int32), element.astype(jnp.int32)
+    a = state.actor[r]
+    take = _lww_newer(ts, a, state.ts[r, e], state.wr_actor[r, e])
+    return ORMapState(
+        vv=base.vv, present=base.present, dot_actor=base.dot_actor,
+        dot_counter=base.dot_counter, actor=state.actor,
+        ts=state.ts.at[r, e].set(jnp.where(take, ts, state.ts[r, e])),
+        wr_actor=state.wr_actor.at[r, e].set(
+            jnp.where(take, a, state.wr_actor[r, e])),
+        val=state.val.at[r, e].set(jnp.where(take, value, state.val[r, e])),
+    )
+
+
+@jax.jit
+def ormap_delete(state: ORMapState, replica: jnp.ndarray,
+                 element: jnp.ndarray) -> ORMapState:
+    from go_crdt_playground_tpu.models import awset
+
+    base = awset.del_element(
+        awset.AWSetState(vv=state.vv, present=state.present,
+                         dot_actor=state.dot_actor,
+                         dot_counter=state.dot_counter, actor=state.actor),
+        replica, element)
+    return state._replace(vv=base.vv, present=base.present,
+                          dot_actor=base.dot_actor,
+                          dot_counter=base.dot_counter)
+
+
+def ormap_join(dst: ORMapState, src: ORMapState) -> ORMapState:
+    """AWSet merge kernel for membership + LWW join for cells."""
+    from go_crdt_playground_tpu.ops.merge import merge_kernel
+
+    vv, present, da, dc, _ = merge_kernel(
+        dst.vv, dst.present, dst.dot_actor, dst.dot_counter,
+        src.vv, src.present, src.dot_actor, src.dot_counter)
+    take = _lww_newer(src.ts, src.wr_actor, dst.ts, dst.wr_actor)
+    return ORMapState(
+        vv=vv, present=present, dot_actor=da, dot_counter=dc,
+        actor=dst.actor,
+        ts=jnp.where(take, src.ts, dst.ts),
+        wr_actor=jnp.where(take, src.wr_actor, dst.wr_actor),
+        val=jnp.where(take, src.val, dst.val),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Generic batched rounds (any of the joins above)
+# ---------------------------------------------------------------------------
+
+
+def join_pairwise(join_fn, dst, src):
+    """Batched dst[r] <- join(dst[r], src[r]) — lattice analogue of
+    ops/merge.merge_pairwise; plugs into parallel/gossip permutation
+    rounds."""
+    return jax.vmap(join_fn)(dst, src)
+
+
+def gossip_round(join_fn, state, perm):
+    src = jax.tree.map(lambda x: x[perm], state)
+    return join_pairwise(join_fn, state, src)

@@ -26,22 +26,22 @@ rounds-to-convergence-under-drop-rate curve is a north-star metric.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from go_crdt_playground_tpu.models.awset import AWSetState
 from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
 from go_crdt_playground_tpu.ops.merge import merge_pairwise
 from go_crdt_playground_tpu.ops.delta import delta_merge_pairwise
 from go_crdt_playground_tpu.parallel import collectives
-from go_crdt_playground_tpu.parallel.mesh import (
-    ELEMENT_AXIS,
-    REPLICA_AXIS,
-    partition_specs,
-)
+from go_crdt_playground_tpu.parallel.mesh import REPLICA_AXIS, partition_specs
+
+# One fused program for the per-round convergence predicate — the
+# measurement loop calls it up to max_rounds times.
+converged_jit = jax.jit(collectives.converged)
 
 # ---------------------------------------------------------------------------
 # Pairing schedules (permutations of the replica axis)
@@ -169,7 +169,7 @@ def rounds_to_convergence(
     round_fn = delta_gossip_round_jit if delta else gossip_round_jit
 
     for rnd in range(max_rounds):
-        if bool(collectives.converged(state.present, state.vv)):
+        if bool(converged_jit(state.present, state.vv)):
             return rnd, state
         if schedule == "dissemination":
             perm = ring_perm(R, offsets[rnd % len(offsets)])
@@ -193,7 +193,7 @@ def rounds_to_convergence(
                              delta_semantics=delta_semantics)
         else:
             state = round_fn(state, perm, drop)
-    if not bool(collectives.converged(state.present, state.vv)):
+    if not bool(converged_jit(state.present, state.vv)):
         raise RuntimeError(
             f"no convergence within {max_rounds} rounds "
             f"(schedule={schedule!r}, drop_rate={drop_rate}) — refusing to "

@@ -1,0 +1,359 @@
+"""Conformance + property tests for the additional CRDT families:
+tensor kernels vs the spec_extra oracles, randomized, plus lattice laws
+(commutativity / associativity / idempotence) and gossip integration.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from go_crdt_playground_tpu.models import spec_extra as S
+from go_crdt_playground_tpu.ops import lattices as L
+from go_crdt_playground_tpu.parallel import gossip
+
+
+# ---------------------------------------------------------------------------
+# G-Counter / PN-Counter
+# ---------------------------------------------------------------------------
+
+
+def test_gcounter_conformance_randomized():
+    rng = random.Random(0)
+    R = 4
+    spec = [S.GCounter(i, R) for i in range(R)]
+    st = L.gcounter_init(R, R)
+    for _ in range(200):
+        if rng.random() < 0.7:
+            r = rng.randrange(R)
+            amt = rng.randint(1, 5)
+            spec[r].inc(amt)
+            st = L.gcounter_inc(st, np.uint32(r), np.uint32(amt))
+        else:
+            d, s = rng.randrange(R), rng.randrange(R)
+            spec[d].merge(spec[s])
+            merged = L.gcounter_join(
+                jax.tree.map(lambda x: x[d], st),
+                jax.tree.map(lambda x: x[s], st))
+            st = jax.tree.map(lambda f, row: f.at[d].set(row), st, merged)
+        assert np.array_equal(
+            np.asarray(st.counts),
+            np.array([c.counts for c in spec], np.uint32))
+    for r in range(R):
+        assert int(L.gcounter_value(st)[r]) == spec[r].value()
+
+
+def test_pncounter_conformance_randomized():
+    rng = random.Random(1)
+    R = 4
+    spec = [S.PNCounter(i, R) for i in range(R)]
+    st = L.pncounter_init(R, R)
+    for _ in range(200):
+        if rng.random() < 0.7:
+            r = rng.randrange(R)
+            amt = rng.randint(-5, 5)
+            if amt >= 0:
+                spec[r].inc(amt)
+            else:
+                spec[r].dec(-amt)
+            st = L.pncounter_add(st, np.uint32(r), np.int32(amt))
+        else:
+            d, s = rng.randrange(R), rng.randrange(R)
+            spec[d].merge(spec[s])
+            merged = L.pncounter_join(
+                jax.tree.map(lambda x: x[d], st),
+                jax.tree.map(lambda x: x[s], st))
+            st = jax.tree.map(lambda f, row: f.at[d].set(row), st, merged)
+    vals = np.asarray(L.pncounter_value(st))
+    for r in range(R):
+        assert int(vals[r]) == spec[r].value()
+
+
+# ---------------------------------------------------------------------------
+# 2P-Set
+# ---------------------------------------------------------------------------
+
+
+def test_twopset_conformance_randomized():
+    rng = random.Random(2)
+    R, E = 3, 12
+    universe = [f"k{i}" for i in range(E)]
+    spec = [S.TwoPSet() for _ in range(R)]
+    st = L.twopset_init(R, E)
+    for _ in range(200):
+        p = rng.random()
+        r = rng.randrange(R)
+        e = rng.randrange(E)
+        if p < 0.5:
+            spec[r].add(universe[e])
+            st = L.twopset_add(st, np.uint32(r), np.uint32(e))
+        elif p < 0.75:
+            spec[r].del_(universe[e])
+            st = L.twopset_del(st, np.uint32(r), np.uint32(e))
+        else:
+            d, s = rng.randrange(R), rng.randrange(R)
+            spec[d].merge(spec[s])
+            merged = L.twopset_join(
+                jax.tree.map(lambda x: x[d], st),
+                jax.tree.map(lambda x: x[s], st))
+            st = jax.tree.map(lambda f, row: f.at[d].set(row), st, merged)
+        member = np.asarray(L.twopset_member(st))
+        for r2 in range(R):
+            got = sorted(universe[i] for i in np.nonzero(member[r2])[0])
+            assert got == spec[r2].values(), r2
+
+
+def test_twopset_remove_wins_forever():
+    st = L.twopset_init(2, 4)
+    st = L.twopset_add(st, np.uint32(0), np.uint32(1))
+    st = L.twopset_del(st, np.uint32(0), np.uint32(1))
+    st = L.twopset_add(st, np.uint32(0), np.uint32(1))  # re-add is futile
+    assert not bool(L.twopset_member(st)[0, 1])
+    # unobserved delete is a no-op
+    st = L.twopset_del(st, np.uint32(1), np.uint32(2))
+    assert not bool(st.removed[1, 2])
+
+
+# ---------------------------------------------------------------------------
+# LWW-Map
+# ---------------------------------------------------------------------------
+
+
+def test_lwwmap_conformance_randomized():
+    rng = random.Random(3)
+    R, E = 3, 8
+    universe = [f"k{i}" for i in range(E)]
+    spec = [S.LWWMap(actor=i) for i in range(R)]
+    st = L.lwwmap_init(R, E)
+    ts = 0
+    for _ in range(200):
+        p = rng.random()
+        r = rng.randrange(R)
+        e = rng.randrange(E)
+        if p < 0.55:
+            ts += 1
+            v = rng.randrange(1000)
+            spec[r].put(universe[e], v, ts)
+            st = L.lwwmap_put(st, np.uint32(r), np.uint32(e), np.uint32(v),
+                              np.uint32(ts), np.bool_(True))
+        elif p < 0.7:
+            ts += 1
+            spec[r].delete(universe[e], ts)
+            st = L.lwwmap_put(st, np.uint32(r), np.uint32(e), np.uint32(0),
+                              np.uint32(ts), np.bool_(False))
+        else:
+            d, s = rng.randrange(R), rng.randrange(R)
+            spec[d].merge(spec[s])
+            merged = L.lwwmap_join(
+                jax.tree.map(lambda x: x[d], st),
+                jax.tree.map(lambda x: x[s], st))
+            st = jax.tree.map(lambda f, row: f.at[d].set(row), st, merged)
+        for r2 in range(R):
+            live = np.asarray(st.live[r2])
+            vals = np.asarray(st.val[r2])
+            got = {universe[i]: int(vals[i]) for i in np.nonzero(live)[0]}
+            assert got == spec[r2].items(), r2
+
+
+def test_lwwmap_concurrent_same_ts_actor_tiebreak():
+    st = L.lwwmap_init(2, 2)
+    st = L.lwwmap_put(st, np.uint32(0), np.uint32(0), np.uint32(10),
+                      np.uint32(5), np.bool_(True))
+    st = L.lwwmap_put(st, np.uint32(1), np.uint32(0), np.uint32(20),
+                      np.uint32(5), np.bool_(True))
+    # merge both directions: higher actor (1) must win deterministically
+    a = L.lwwmap_join(jax.tree.map(lambda x: x[0], st),
+                      jax.tree.map(lambda x: x[1], st))
+    b = L.lwwmap_join(jax.tree.map(lambda x: x[1], st),
+                      jax.tree.map(lambda x: x[0], st))
+    assert int(a.val[0]) == int(b.val[0]) == 20
+
+
+# ---------------------------------------------------------------------------
+# MV-Register
+# ---------------------------------------------------------------------------
+
+
+def test_mvregister_conformance_randomized():
+    rng = random.Random(4)
+    R = 4
+    spec = [S.MVRegister(i, R) for i in range(R)]
+    st = L.mvregister_init(R, R)
+    for step in range(300):
+        if rng.random() < 0.5:
+            r = rng.randrange(R)
+            v = rng.randrange(1, 1000)
+            spec[r].write(v)
+            st = L.mvregister_write(st, np.uint32(r), np.uint32(v))
+        else:
+            d, s = rng.randrange(R), rng.randrange(R)
+            spec[d].merge(spec[s])
+            merged = L.mvregister_join(
+                jax.tree.map(lambda x: x[d], st),
+                jax.tree.map(lambda x: x[s], st))
+            st = jax.tree.map(lambda f, row: f.at[d].set(row), st, merged)
+        for r2 in range(R):
+            for name, arr in (("ctx", st.ctx), ("live", st.live),
+                              ("cnt", st.cnt), ("val", st.val)):
+                assert np.asarray(arr[r2]).tolist() == list(
+                    getattr(spec[r2], name)), (step, r2, name)
+
+
+def test_mvregister_concurrent_writes_both_visible():
+    st = L.mvregister_init(2, 2)
+    st = L.mvregister_write(st, np.uint32(0), np.uint32(7))
+    st = L.mvregister_write(st, np.uint32(1), np.uint32(9))
+    merged = L.mvregister_join(jax.tree.map(lambda x: x[0], st),
+                               jax.tree.map(lambda x: x[1], st))
+    vis = sorted(int(v) for v, l in zip(np.asarray(merged.val),
+                                        np.asarray(merged.live)) if l)
+    assert vis == [7, 9]
+    # a subsequent write dominates both
+    st2 = jax.tree.map(lambda f, row: f.at[0].set(row), st, merged)
+    st2 = L.mvregister_write(st2, np.uint32(0), np.uint32(42))
+    back = L.mvregister_join(jax.tree.map(lambda x: x[1], st2),
+                             jax.tree.map(lambda x: x[0], st2))
+    vis2 = [int(v) for v, l in zip(np.asarray(back.val),
+                                   np.asarray(back.live)) if l]
+    assert vis2 == [42]
+
+
+# ---------------------------------------------------------------------------
+# OR-Map
+# ---------------------------------------------------------------------------
+
+
+def test_ormap_conformance_randomized():
+    rng = random.Random(6)
+    R, E = 3, 8
+    universe = [f"k{i}" for i in range(E)]
+    spec = [S.ORMap(actor=i, num_actors=R) for i in range(R)]
+    st = L.ormap_init(R, E, R)
+    ts = 0
+    for step in range(200):
+        p = rng.random()
+        r = rng.randrange(R)
+        e = rng.randrange(E)
+        if p < 0.5:
+            ts += 1
+            v = rng.randrange(1, 1000)
+            spec[r].put(universe[e], v, ts)
+            st = L.ormap_put(st, np.uint32(r), np.uint32(e), np.uint32(v),
+                             np.uint32(ts))
+        elif p < 0.7:
+            spec[r].delete(universe[e])
+            st = L.ormap_delete(st, np.uint32(r), np.uint32(e))
+        else:
+            d, s = rng.randrange(R), rng.randrange(R)
+            spec[d].merge(spec[s])
+            merged = L.ormap_join(
+                jax.tree.map(lambda x: x[d], st),
+                jax.tree.map(lambda x: x[s], st))
+            st = jax.tree.map(lambda f, row: f.at[d].set(row), st, merged)
+        for r2 in range(R):
+            pres = np.asarray(st.present[r2])
+            vals = np.asarray(st.val[r2])
+            got = {universe[i]: int(vals[i]) for i in np.nonzero(pres)[0]}
+            assert got == spec[r2].items(), (step, r2)
+
+
+def test_ormap_concurrent_put_wins_over_delete():
+    """The key membership inherits AWSet add-wins (awset_test.go:85-122's
+    property lifted to maps)."""
+    spec = [S.ORMap(actor=i, num_actors=2) for i in range(2)]
+    st = L.ormap_init(2, 4, 2)
+    spec[0].put("k", 1, 1)
+    st = L.ormap_put(st, np.uint32(0), np.uint32(0), np.uint32(1), np.uint32(1))
+    spec[1].merge(spec[0])
+    m = L.ormap_join(jax.tree.map(lambda x: x[1], st),
+                     jax.tree.map(lambda x: x[0], st))
+    st = jax.tree.map(lambda f, row: f.at[1].set(row), st, m)
+    # concurrent: replica 0 deletes, replica 1 re-puts
+    spec[0].delete("k"); spec[1].put("k", 7, 2)
+    st = L.ormap_delete(st, np.uint32(0), np.uint32(0))
+    st = L.ormap_put(st, np.uint32(1), np.uint32(0), np.uint32(7), np.uint32(2))
+    spec[0].merge(spec[1])
+    m = L.ormap_join(jax.tree.map(lambda x: x[0], st),
+                     jax.tree.map(lambda x: x[1], st))
+    assert bool(m.present[0])       # writer wins
+    assert int(m.val[0]) == 7
+    assert spec[0].get("k") == 7
+
+
+# ---------------------------------------------------------------------------
+# Lattice laws + gossip integration
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("family", ["gcounter", "twopset", "lww", "mvreg"])
+def test_lattice_laws(family):
+    """Idempotence, commutativity(-on-read), associativity on random
+    states."""
+    rng = random.Random(5)
+
+    def rand_state():
+        if family == "gcounter":
+            st = L.gcounter_init(3, 3)
+            for _ in range(10):
+                st = L.gcounter_inc(st, np.uint32(rng.randrange(3)),
+                                    np.uint32(rng.randint(1, 9)))
+            return st, L.gcounter_join, lambda s: np.asarray(s.counts)
+        if family == "twopset":
+            st = L.twopset_init(3, 8)
+            for _ in range(15):
+                f = L.twopset_add if rng.random() < 0.6 else L.twopset_del
+                st = f(st, np.uint32(rng.randrange(3)),
+                       np.uint32(rng.randrange(8)))
+            return st, L.twopset_join, lambda s: np.asarray(
+                L.twopset_member(s))
+        if family == "lww":
+            st = L.lwwmap_init(3, 8)
+            for t in range(1, 16):
+                st = L.lwwmap_put(st, np.uint32(rng.randrange(3)),
+                                  np.uint32(rng.randrange(8)),
+                                  np.uint32(rng.randrange(100)),
+                                  np.uint32(t), np.bool_(rng.random() < .8))
+            return st, L.lwwmap_join, lambda s: (
+                np.asarray(s.val), np.asarray(s.live))
+        st = L.mvregister_init(3, 3)
+        for _ in range(10):
+            st = L.mvregister_write(st, np.uint32(rng.randrange(3)),
+                                    np.uint32(rng.randrange(1, 50)))
+        return st, L.mvregister_join, lambda s: (
+            np.asarray(s.val), np.asarray(s.live))
+
+    for _ in range(10):
+        st, join, read = rand_state()
+        rows = [jax.tree.map(lambda x: x[i], st) for i in range(3)]
+        a, b, c = rows
+        # idempotence
+        aa = join(a, a)
+        assert jax.tree.all(jax.tree.map(
+            lambda x, y: bool(jnp.all(x == y)), aa, a))
+        # associativity: (a+b)+c == a+(b+c)
+        lhs = join(join(a, b), c)
+        rhs = join(a, join(b, c))
+        assert jax.tree.all(jax.tree.map(
+            lambda x, y: bool(jnp.all(x == y)), lhs, rhs))
+
+
+def test_gcounter_gossip_convergence_1k_replicas():
+    """BASELINE config 2: 1K replicas, batched elementwise-max join,
+    dissemination rounds to global agreement."""
+    R = 1024
+    A = 64
+    counts = (jnp.arange(R, dtype=jnp.uint32)[:, None]
+              * jnp.ones((1, A), jnp.uint32) % 7)
+    st = L.GCounterState(
+        counts=counts, actor=jnp.arange(R, dtype=jnp.uint32) % A)
+    rounds = 0
+    for off in gossip.dissemination_offsets(R):
+        st = L.gossip_round(L.gcounter_join, st,
+                            gossip.ring_perm(R, off))
+        rounds += 1
+    assert rounds == 10
+    expected = np.asarray(counts).max(axis=0)
+    assert (np.asarray(st.counts) == expected[None, :]).all()
