@@ -1,0 +1,233 @@
+"""Fused Pallas TPU kernel for the AWSet gossip round.
+
+The XLA path (ops/merge.py + parallel/gossip.py) lowers the round as a
+row gather (``state[perm]``) feeding a handful of elementwise fusions,
+with ``HasDot`` via TPU's native gather engine.  This kernel fuses the
+whole round — partner-row gather, both ``HasDot`` lookups, the two-phase
+merge select, and the VV join — into ONE pass over HBM:
+
+  * the gossip permutation rides in as a **scalar-prefetch** operand, so
+    each grid step DMAs its partner row ``perm[r]`` straight out of the
+    source arrays — the permuted copy of the state is never materialized;
+  * ``HasDot`` (crdt-misc.go:28-34) is computed on the **MXU** as an
+    exact one-hot matvec: ``cnt = vv @ onehot(dot_actor)`` with the
+    uint32 counters split into hi/lo 16-bit halves so every f32 product
+    is exact (one-hot rows sum a single term < 2^16);
+  * the merge itself is the same closed-form mask algebra as
+    ops/merge.py (awset.go:107-161, SURVEY §7.2), on the VPU;
+  * the element axis is processed in VMEM-sized tiles (blockwise over
+    ``E``), so element universes far beyond VMEM stream through.
+
+Semantics are bit-identical to ``ops.merge.merge_kernel`` — the
+conformance gate in tests/test_pallas_merge.py checks bitwise equality
+against the XLA kernel (and transitively against the executable spec).
+
+Layout contract: grid is ``(R, E_pad // block_e)`` with one replica row
+per step; row blocks are ``(1, block_e)``.  ``E`` and ``A`` are padded
+to lane multiples with absent/zero lanes, which is semantically inert:
+a zero dot on an absent lane is "covered by every clock" and the lane's
+``present`` bits are False on both sides, so every padded lane resolves
+to absent (same canonical zeroing as ops/merge.py).
+
+Measured regime guidance (v5e 1x1, R=10K, E=A=256): the XLA path runs
+~35us/round (near roofline — XLA fuses the permuted-row gather into the
+merge and lowers HasDot through the TPU gather engine), while this
+kernel's one-row-per-grid-step layout costs ~240ns/step of grid
+overhead, i.e. ~2.4ms/round at R=10K.  Prefer the XLA path for large
+replica fleets with small element universes; this kernel's blockwise-E
+streaming wins when E is huge (row state >> VMEM) and R is modest —
+and it is the scaffold for the ring-specialized multi-row variant
+(block-aligned offsets + in-kernel sublane shift) that lifts the
+per-row restriction.  tests/test_pallas_merge.py pins bitwise equality
+either way, so schedulers may pick per shape freely.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from go_crdt_playground_tpu.models.awset import AWSetState
+
+_LANE = 128
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def _gather_counter(vv: jnp.ndarray, da: jnp.ndarray) -> jnp.ndarray:
+    """``vv[0, da[0, e]]`` for every lane e — HasDot's clock lookup
+    (crdt-misc.go:33) as an exact one-hot matvec on the MXU.
+
+    vv: uint32[1, A]; da: uint32[1, E] with values < A.  Returns
+    uint32[1, E].  Exactness: the one-hot contraction sums exactly one
+    term per lane and both 16-bit halves are < 2^16 <= 2^24, so the f32
+    accumulation is exact.
+    """
+    a_pad, e_blk = vv.shape[1], da.shape[1]
+    a_ids = jax.lax.broadcasted_iota(jnp.uint32, (a_pad, e_blk), 0)
+    onehot = (a_ids == jnp.broadcast_to(da, (a_pad, e_blk))).astype(
+        jnp.float32)
+    # Mosaic has no u32<->f32 casts; both halves are < 2^16 so a bitcast
+    # through i32 is value-preserving in both directions.
+    as_i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)  # noqa: E731
+    hi = as_i32(vv >> 16).astype(jnp.float32)
+    lo = as_i32(vv & 0xFFFF).astype(jnp.float32)
+    cnt_hi = jnp.dot(hi, onehot, preferred_element_type=jnp.float32)
+    cnt_lo = jnp.dot(lo, onehot, preferred_element_type=jnp.float32)
+    cnt = (cnt_hi.astype(jnp.int32) << 16) | cnt_lo.astype(jnp.int32)
+    return jax.lax.bitcast_convert_type(cnt, jnp.uint32)
+
+
+def _round_kernel(perm_ref, dvv_ref, svv_ref, dp_ref, sp_ref,
+                  dda_ref, sda_ref, ddc_ref, sdc_ref,
+                  ovv_ref, op_ref, oda_ref, odc_ref):
+    del perm_ref  # consumed by the index maps
+    # row blocks are (1, 1, X) — Mosaic requires the sublane dim of a
+    # block to be 8-divisible or the full array dim, so the replica axis
+    # is lifted to a leading grid-only dim and blocks drop to [1, X] here
+    dvv, svv = dvv_ref[0], svv_ref[0]
+    dp = dp_ref[0] != 0
+    sp = sp_ref[0] != 0
+    dda, sda = dda_ref[0], sda_ref[0]
+    ddc, sdc = ddc_ref[0], sdc_ref[0]
+
+    # HasDot gathers (awset.go:133 / :152)
+    seen_by_dst = sdc <= _gather_counter(dvv, sda)
+    seen_by_src = ddc <= _gather_counter(svv, dda)
+
+    # two-phase merge as closed-form masks (awset.go:122-159, SURVEY §7.2)
+    take_src = sp & (dp | ~seen_by_dst)
+    present = take_src | (dp & ~sp & ~seen_by_src)
+    da = jnp.where(take_src, sda, dda)
+    dc = jnp.where(take_src, sdc, ddc)
+    zero = jnp.zeros_like(da)
+    oda_ref[0] = jnp.where(present, da, zero)
+    odc_ref[0] = jnp.where(present, dc, zero)
+    op_ref[0] = present.astype(jnp.uint8)
+    # VV join (crdt-misc.go:43-55); Mosaic can't legalize unsigned max,
+    # so spell it as compare+select
+    ovv_ref[0] = jnp.where(dvv < svv, svv, dvv)
+
+
+def _pad_arrays(vv, present_u8, da, dc, e_pad, a_pad):
+    num_r, num_e = da.shape
+    num_a = vv.shape[1]
+    if e_pad != num_e:
+        pad = ((0, 0), (0, e_pad - num_e))
+        present_u8 = jnp.pad(present_u8, pad)
+        da = jnp.pad(da, pad)
+        dc = jnp.pad(dc, pad)
+    if a_pad != num_a:
+        vv = jnp.pad(vv, ((0, 0), (0, a_pad - num_a)))
+    # lift the replica axis out of the tile: arrays become [R, 1, X] so
+    # row blocks are (1, 1, X) and the tiled dims are (1, X)
+    return (vv.reshape(num_r, 1, a_pad),
+            present_u8.reshape(num_r, 1, e_pad),
+            da.reshape(num_r, 1, e_pad),
+            dc.reshape(num_r, 1, e_pad))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_e", "interpret"))
+def _fused_round(dst_arrays, src_arrays, perm, block_e: int,
+                 interpret: bool):
+    """dst/src are (vv, present_u8, da, dc) tuples; src may be the same
+    arrays as dst (gossip: perm indexes the batch itself) or an
+    independent batch of the same shape (pairwise merge)."""
+    num_r, num_e = dst_arrays[2].shape
+    num_a = dst_arrays[0].shape[1]
+    e_pad = _round_up(num_e, _LANE)
+    a_pad = _round_up(num_a, _LANE)
+    blk = min(_round_up(block_e, _LANE), e_pad)
+    while e_pad % blk:  # keep the grid exact; blk stays a lane multiple
+        blk -= _LANE
+    grid = (num_r, e_pad // blk)
+
+    vv, present_u8, da, dc = _pad_arrays(*dst_arrays, e_pad, a_pad)
+    svv, spresent_u8, sda, sdc = _pad_arrays(*src_arrays, e_pad, a_pad)
+
+    def dst_el(i, j, perm_ref):
+        del perm_ref
+        return (i, 0, j)
+
+    def src_el(i, j, perm_ref):
+        return (perm_ref[i], 0, j)
+
+    def dst_vv(i, j, perm_ref):
+        del j, perm_ref
+        return (i, 0, 0)
+
+    def src_vv(i, j, perm_ref):
+        del j
+        return (perm_ref[i], 0, 0)
+
+    vv_blk = pl.BlockSpec((1, 1, a_pad), dst_vv)
+    vv_src_blk = pl.BlockSpec((1, 1, a_pad), src_vv)
+    el = lambda: pl.BlockSpec((1, 1, blk), dst_el)       # noqa: E731
+    el_src = lambda: pl.BlockSpec((1, 1, blk), src_el)   # noqa: E731
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[vv_blk, vv_src_blk, el(), el_src(), el(), el_src(),
+                  el(), el_src()],
+        out_specs=[vv_blk, el(), el(), el()],
+    )
+    out_vv, out_p, out_da, out_dc = pl.pallas_call(
+        _round_kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((num_r, 1, a_pad), jnp.uint32),
+            jax.ShapeDtypeStruct((num_r, 1, e_pad), jnp.uint8),
+            jax.ShapeDtypeStruct((num_r, 1, e_pad), jnp.uint32),
+            jax.ShapeDtypeStruct((num_r, 1, e_pad), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(perm.astype(jnp.int32), vv, svv, present_u8, spresent_u8,
+      da, sda, dc, sdc)
+    return (out_vv[:, 0, :num_a], out_p[:, 0, :num_e],
+            out_da[:, 0, :num_e], out_dc[:, 0, :num_e])
+
+
+def _as_arrays(state: AWSetState):
+    return (state.vv, state.present.astype(jnp.uint8), state.dot_actor,
+            state.dot_counter)
+
+
+def pallas_gossip_round(state: AWSetState, perm, *, block_e: int = 512,
+                        interpret: bool | None = None) -> AWSetState:
+    """One fused anti-entropy round: replica r absorbs replica perm[r].
+
+    Drop-in equivalent of ``parallel.gossip.gossip_round`` (bitwise-equal
+    output), with the partner-row gather fused into the kernel's DMA
+    schedule instead of materialized.  ``interpret=None`` auto-selects
+    interpreter mode off-TPU so the CPU test mesh can run it.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    arrays = _as_arrays(state)
+    vv, p, da, dc = _fused_round(arrays, arrays, perm, block_e, interpret)
+    return AWSetState(vv=vv, present=p != 0, dot_actor=da, dot_counter=dc,
+                      actor=state.actor)
+
+
+def pallas_merge_pairwise(dst: AWSetState, src: AWSetState, *,
+                          block_e: int = 512,
+                          interpret: bool | None = None) -> AWSetState:
+    """Batched dst[r] <- src[r] between two independent batches (the
+    fused analogue of ops.merge.merge_pairwise): the src batch rides in
+    as the kernel's source operands with an identity permutation."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    num_r = dst.present.shape[0]
+    perm = jnp.arange(num_r, dtype=jnp.int32)
+    vv, p, da, dc = _fused_round(
+        _as_arrays(dst), _as_arrays(src), perm, block_e, interpret)
+    return AWSetState(vv=vv, present=p != 0, dot_actor=da, dot_counter=dc,
+                      actor=dst.actor)
