@@ -1,0 +1,45 @@
+"""Packaging + native build hook.
+
+The Python path needs no build step.  ``python setup.py build_ext
+--inplace`` compiles the optional C++ host codec
+(go_crdt_playground_tpu/native/codec.cpp) into the source tree — the
+same artifact the package would otherwise build lazily on first use via
+go_crdt_playground_tpu.native.load().
+"""
+
+from setuptools import Command, find_packages, setup
+
+
+class BuildNativeCodec(Command):
+    description = "compile the native C++ host codec in place"
+    user_options = [("inplace", "i", "ignored (always in place)")]
+
+    def initialize_options(self):
+        self.inplace = True
+
+    def finalize_options(self):
+        pass
+
+    def run(self):
+        from go_crdt_playground_tpu import native
+
+        lib = native.load()
+        if lib is None:
+            # the package contractually degrades to the pure-Python
+            # codec, so a missing toolchain is a warning, not a failure
+            print(f"WARNING: native codec not built "
+                  f"({native.build_error()}); pure-Python paths will be "
+                  f"used")
+        else:
+            print(f"native codec built: {native._lib_path()}")
+
+
+setup(
+    name="go_crdt_playground_tpu",
+    version="0.1.0",
+    description="TPU-native CRDT framework (JAX/XLA/Pallas)",
+    packages=find_packages(include=["go_crdt_playground_tpu*"]),
+    package_data={"go_crdt_playground_tpu.native": ["codec.cpp"]},
+    python_requires=">=3.10",
+    cmdclass={"build_ext": BuildNativeCodec},
+)

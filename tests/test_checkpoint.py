@@ -1,0 +1,137 @@
+"""Checkpoint/resume: roundtrip fidelity and bitwise resume equivalence.
+
+The contract (SURVEY §5.4): the packed tensors are the checkpoint, so a
+gossip run interrupted by save+restore must land bitwise on the same
+state as an uninterrupted run.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from go_crdt_playground_tpu.models import awset, awset_delta
+from go_crdt_playground_tpu.models.spec import AWSet, VersionVector
+from go_crdt_playground_tpu.ops import lattices as L
+from go_crdt_playground_tpu.parallel import gossip
+from go_crdt_playground_tpu.utils import checkpoint as ckpt
+from go_crdt_playground_tpu.utils.codec import ElementDict, pack_awsets
+
+
+def _scenario_state():
+    """Three spec replicas after a concurrent scenario, packed."""
+    reps = [AWSet(actor=i, version_vector=VersionVector([0, 0, 0]))
+            for i in range(3)]
+    reps[0].add("Anne", "Bob")
+    reps[1].add("Anne", "Carol")
+    reps[2].add("Dave")
+    reps[0].del_("Bob")
+    d = ElementDict(capacity=16)
+    arrays = pack_awsets(reps, d, num_actors=3)
+    return awset.from_arrays(arrays), d
+
+
+def assert_tree_equal(a, b):
+    assert type(a) is type(b)
+    for name in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+            err_msg=name)
+
+
+def test_roundtrip_awset(tmp_path):
+    state, d = _scenario_state()
+    p = str(tmp_path / "ck")
+    ckpt.save_checkpoint(p, state, dictionary=d, step=7,
+                         metadata={"note": "after scenario"})
+    got = ckpt.restore_checkpoint(p)
+    assert_tree_equal(state, got.state)
+    assert got.step == 7
+    assert got.metadata == {"note": "after scenario"}
+    assert got.dictionary.state_dict() == d.state_dict()
+
+
+def test_roundtrip_all_lattice_families(tmp_path):
+    states = [
+        L.gcounter_init(4, 4),
+        L.pncounter_init(4, 4),
+        L.twopset_init(4, 8),
+        L.lwwmap_init(4, 8),
+        L.mvregister_init(4, 4),
+        awset_delta.init(3, 16, 3),
+    ]
+    for i, st in enumerate(states):
+        p = str(tmp_path / f"ck{i}")
+        ckpt.save_checkpoint(p, st)
+        got = ckpt.restore_checkpoint(p)
+        assert_tree_equal(st, got.state)
+
+
+def test_resume_equivalence_bitwise(tmp_path):
+    """gossip k rounds -> save -> restore -> gossip k more == gossip 2k."""
+    state, _ = _scenario_state()
+    R = state.vv.shape[0]
+    perms = [gossip.ring_perm(R, o) for o in (1, 2, 1, 2)]
+
+    uninterrupted = state
+    for perm in perms:
+        uninterrupted = gossip.gossip_round(uninterrupted, perm)
+
+    half = state
+    for perm in perms[:2]:
+        half = gossip.gossip_round(half, perm)
+    p = str(tmp_path / "mid")
+    ckpt.save_checkpoint(p, half, step=2)
+    resumed = ckpt.restore_checkpoint(p).state
+    for perm in perms[2:]:
+        resumed = gossip.gossip_round(resumed, perm)
+
+    assert_tree_equal(uninterrupted, resumed)
+
+
+def test_save_overwrites_previous_generation(tmp_path):
+    state, d = _scenario_state()
+    p = str(tmp_path / "ck")
+    ckpt.save_checkpoint(p, state, step=1)
+    bumped = state._replace(vv=state.vv + 1)
+    ckpt.save_checkpoint(p, bumped, step=2)
+    got = ckpt.restore_checkpoint(p)
+    assert got.step == 2
+    np.testing.assert_array_equal(np.asarray(got.state.vv),
+                                  np.asarray(bumped.vv))
+    # no stray temp files from either save
+    assert [f.name for f in tmp_path.iterdir()] == ["ck"]
+
+
+def _tamper_manifest(path, **updates):
+    import json
+
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    m = json.loads(arrays["__manifest__"].tobytes().decode())
+    m.update(updates)
+    arrays["__manifest__"] = np.frombuffer(
+        json.dumps(m).encode(), np.uint8)
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+
+
+def test_unknown_state_type_degrades_to_arrays(tmp_path):
+    state, _ = _scenario_state()
+    p = str(tmp_path / "ck")
+    ckpt.save_checkpoint(p, state)
+    _tamper_manifest(p, state_type="FutureState")
+    got = ckpt.restore_checkpoint(p)
+    assert isinstance(got.state, dict)
+    np.testing.assert_array_equal(np.asarray(got.state["vv"]),
+                                  np.asarray(state.vv))
+
+
+def test_newer_format_version_rejected(tmp_path):
+    state, _ = _scenario_state()
+    p = str(tmp_path / "ck")
+    ckpt.save_checkpoint(p, state)
+    _tamper_manifest(p, format_version=99)
+    with pytest.raises(ValueError, match="newer"):
+        ckpt.restore_checkpoint(p)

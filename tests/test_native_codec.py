@@ -1,0 +1,171 @@
+"""Native C++ codec: parity with the pure-Python implementations.
+
+The native path (go_crdt_playground_tpu/native) must be observably
+identical to utils.codec.ElementDict and byte-identical to the Python
+wire codec — these tests pin both.  If no C++ toolchain is available
+the native tests skip (the framework contract is graceful fallback).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from go_crdt_playground_tpu import native
+from go_crdt_playground_tpu.models import awset_delta
+from go_crdt_playground_tpu.ops import delta as delta_ops
+from go_crdt_playground_tpu.utils import wire
+from go_crdt_playground_tpu.utils.codec import ElementDict
+
+needs_native = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native codec unavailable: {native.build_error()}")
+
+
+# ---------------------------------------------------------------------------
+# Element dictionary parity
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_element_dict_parity_basic():
+    py = ElementDict(capacity=8)
+    nat = native.NativeElementDict(capacity=8)
+    words = ["Anne", "Bob", "Anne", "Cat", "", "Ünïcode✓", "Bob"]
+    assert py.encode_many(words) == nat.encode_many(words)
+    assert len(py) == len(nat)
+    assert py.capacity == nat.capacity
+    for w in words + ["missing"]:
+        assert (w in py) == (w in nat)
+    ids = list(range(len(py)))
+    assert [py.decode(i) for i in ids] == nat.decode_many(ids)
+    assert py.state_dict() == nat.state_dict()
+
+
+@needs_native
+def test_element_dict_overflow_matches():
+    py = ElementDict(capacity=2)
+    nat = native.NativeElementDict(capacity=2)
+    for d in (py, nat):
+        d.encode("a")
+        d.encode("b")
+        with pytest.raises(OverflowError):
+            d.encode("c")
+        d.grow()
+        assert d.encode("c") == 2
+    assert py.state_dict() == nat.state_dict()
+
+
+@needs_native
+def test_element_dict_partial_overflow_batch_prefix_interned():
+    """On mid-batch overflow both implementations keep the prefix."""
+    py = ElementDict(capacity=2)
+    nat = native.NativeElementDict(capacity=2)
+    with pytest.raises(OverflowError):
+        py.encode_many(["x", "y", "z"])
+    with pytest.raises(OverflowError):
+        nat.encode_many(["x", "y", "z"])
+    assert py.state_dict() == nat.state_dict()
+    assert len(nat) == 2
+
+
+@needs_native
+def test_native_roundtrip_from_state_dict():
+    nat = native.NativeElementDict(capacity=16, values=["p", "q", "r"])
+    clone = native.NativeElementDict.from_state_dict(nat.state_dict())
+    assert clone.state_dict() == nat.state_dict()
+
+
+def test_factory_falls_back():
+    d = native.make_element_dict(capacity=4, prefer_native=False)
+    assert isinstance(d, ElementDict)
+    d2 = native.make_element_dict(capacity=4)
+    assert d2.encode("k") == 0
+
+
+# ---------------------------------------------------------------------------
+# Wire codec
+# ---------------------------------------------------------------------------
+
+
+def _payload(rng, e=40, a=5):
+    st = awset_delta.init(1, e, a)
+    present = rng.random(e) < 0.3
+    deleted = ~present & (rng.random(e) < 0.2)
+    st = st._replace(
+        vv=jnp.asarray(rng.integers(0, 9, (1, a)), jnp.uint32),
+        present=jnp.asarray(present)[None],
+        dot_actor=jnp.asarray(
+            np.where(present, rng.integers(0, a, e), 0), jnp.uint32)[None],
+        dot_counter=jnp.asarray(
+            np.where(present, rng.integers(1, 9, e), 0), jnp.uint32)[None],
+        deleted=jnp.asarray(deleted)[None],
+        del_dot_actor=jnp.asarray(
+            np.where(deleted, rng.integers(0, a, e), 0), jnp.uint32)[None],
+        del_dot_counter=jnp.asarray(
+            np.where(deleted, rng.integers(1, 9, e), 0), jnp.uint32)[None],
+    )
+    row = __import__("jax").tree.map(lambda x: x[0], st)
+    dst_vv = jnp.asarray(rng.integers(0, 5, a), jnp.uint32)
+    return delta_ops.delta_extract(row, dst_vv)
+
+
+@pytest.mark.parametrize("prefer_native", [False, True])
+def test_wire_roundtrip(prefer_native):
+    if prefer_native and not native.available():
+        pytest.skip("no native codec")
+    rng = np.random.default_rng(1)
+    for _ in range(5):
+        p = _payload(rng)
+        buf = wire.encode_payload(p, prefer_native=prefer_native)
+        q = wire.decode_payload(buf, 40, 5, src_actor=int(p.src_actor),
+                                prefer_native=prefer_native)
+        for name in ("src_vv", "changed", "ch_da", "ch_dc", "deleted",
+                     "del_da", "del_dc"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(p, name)), np.asarray(getattr(q, name)),
+                err_msg=name)
+
+
+@needs_native
+def test_wire_native_and_python_byte_identical():
+    rng = np.random.default_rng(2)
+    for _ in range(5):
+        p = _payload(rng, e=130, a=7)
+        assert (wire.encode_payload(p, prefer_native=True)
+                == wire.encode_payload(p, prefer_native=False))
+
+
+@needs_native
+def test_wire_cross_decoding():
+    """Bytes from either implementation decode in the other."""
+    rng = np.random.default_rng(3)
+    p = _payload(rng)
+    b_native = wire.encode_payload(p, prefer_native=True)
+    q = wire.decode_payload(b_native, 40, 5, prefer_native=False)
+    np.testing.assert_array_equal(np.asarray(p.changed),
+                                  np.asarray(q.changed))
+    b_py = wire.encode_payload(p, prefer_native=False)
+    q2 = wire.decode_payload(b_py, 40, 5, prefer_native=True)
+    np.testing.assert_array_equal(np.asarray(p.ch_dc), np.asarray(q2.ch_dc))
+
+
+def test_wire_compression_vs_dense():
+    """A sparse payload's wire form is much smaller than its dense form."""
+    rng = np.random.default_rng(4)
+    p = _payload(rng, e=1024, a=8)
+    dense = p.nbytes_dense()
+    compact = wire.payload_nbytes_wire(p)
+    assert compact < dense / 4
+
+
+def test_wire_rejects_malformed():
+    rng = np.random.default_rng(5)
+    p = _payload(rng)
+    buf = wire.encode_payload(p, prefer_native=False)
+    with pytest.raises(ValueError):
+        wire.decode_payload(buf + b"\x00", 40, 5, prefer_native=False)
+    with pytest.raises(ValueError):
+        wire.decode_payload(buf[:-1], 40, 5, prefer_native=False)
+    with pytest.raises(ValueError):
+        wire.decode_payload(buf, 41, 5, prefer_native=False)
