@@ -1,0 +1,13 @@
+"""Host-side anti-entropy networking.
+
+The reference simulates the network boundary as a direct method call
+``dst.Merge(src)`` (awset_test.go:16-17) with sender-side δ-compression
+against the receiver's advertised VV (awset-delta_test.go:79-105).  This
+package makes that boundary real: a length-framed TCP protocol whose
+messages are the compact δ wire format (utils/wire.py), so replicas in
+different processes — or different hosts fronting different TPU pods —
+exchange exactly the payload the reference's ``MakeDeltaMergeData``
+models, and apply it with the same kernels the on-chip gossip uses.
+"""
+
+from go_crdt_playground_tpu.net.peer import Node, SyncStats  # noqa: F401

@@ -1,0 +1,167 @@
+"""Message framing for the peer sync protocol.
+
+Frame:  MAGIC(2) | type(1) | varint body_len | body
+
+Bodies reuse the δ wire primitives (utils/wire.py) so every section is
+byte-identical whether it crosses a socket, lands in a checkpoint, or is
+produced by the C++ codec:
+
+  HELLO    varint actor | varint E | vv-section(vv)
+  PAYLOAD  mode(1) | varint src_actor | vv-section(processed) | payload
+  ERROR    utf-8 message
+
+where ``payload`` is utils.wire.encode_payload's three-section form and
+``mode`` is FULL on first contact (receiver's clock has never seen the
+sender, the dispatch condition of awset-delta_test.go:53) else DELTA.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Tuple
+
+import numpy as np
+
+from go_crdt_playground_tpu.utils import wire
+
+MAGIC = b"\xc7\xd1"
+
+MSG_HELLO = 1
+MSG_PAYLOAD = 2
+MSG_ERROR = 3
+
+MODE_DELTA = 0
+MODE_FULL = 1
+
+_MAX_BODY = 1 << 30
+
+
+class ProtocolError(RuntimeError):
+    pass
+
+
+class RemoteError(RuntimeError):
+    """The peer reported a protocol-level failure (MSG_ERROR frame)."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ProtocolError("connection closed mid-frame")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_varint(sock: socket.socket) -> int:
+    out = 0
+    shift = 0
+    while True:
+        b = _recv_exact(sock, 1)[0]
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out
+        shift += 7
+        if shift > 63:
+            raise ProtocolError("malformed varint")
+
+
+def frame_size(body_len: int) -> int:
+    """Total on-wire bytes of a frame with a body_len-byte body."""
+    n, varint_len = body_len, 1
+    while n >= 0x80:
+        n >>= 7
+        varint_len += 1
+    return 2 + 1 + varint_len + body_len
+
+
+def send_frame(sock: socket.socket, msg_type: int, body: bytes) -> int:
+    head = bytearray(MAGIC)
+    head.append(msg_type)
+    wire._put_varint(head, len(body))
+    data = bytes(head) + body
+    sock.sendall(data)
+    return len(data)
+
+
+def recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
+    magic = _recv_exact(sock, 2)
+    if magic != MAGIC:
+        raise ProtocolError(f"bad magic {magic!r}")
+    msg_type = _recv_exact(sock, 1)[0]
+    n = _recv_varint(sock)
+    if n > _MAX_BODY:
+        raise ProtocolError(f"oversized frame ({n} bytes)")
+    body = _recv_exact(sock, n)
+    if msg_type == MSG_ERROR:
+        raise RemoteError(body.decode("utf-8", "replace"))
+    return msg_type, body
+
+
+# ---------------------------------------------------------------------------
+# Bodies
+# ---------------------------------------------------------------------------
+
+
+def encode_hello(actor: int, num_elements: int, vv: np.ndarray) -> bytes:
+    out = bytearray()
+    wire._put_varint(out, actor)
+    wire._put_varint(out, num_elements)
+    return bytes(out) + wire._encode_vv_py(np.asarray(vv, np.uint32))
+
+
+def decode_hello(body: bytes, num_elements: int,
+                 num_actors: int) -> Tuple[int, np.ndarray]:
+    """Returns (actor, vv); raises ProtocolError on any dimension
+    disagreement — peers must share one dictionary-encoded universe and
+    actor axis."""
+    try:
+        actor, pos = wire._get_varint(body, 0)
+        e, pos = wire._get_varint(body, pos)
+        if e != num_elements:
+            raise ProtocolError(f"element-universe mismatch: peer E={e}, "
+                                f"ours E={num_elements}")
+        vv, pos = wire._decode_vv_py(body, pos, num_actors)
+    except ValueError as err:  # wire-layer section mismatch / malformed
+        raise ProtocolError(str(err)) from err
+    if pos != len(body):
+        raise ProtocolError("trailing bytes after HELLO")
+    if actor >= num_actors:
+        raise ProtocolError(f"peer actor {actor} outside actor axis "
+                            f"{num_actors}")
+    return actor, vv
+
+
+def encode_payload_msg(mode: int, src_actor: int, processed: np.ndarray,
+                       payload) -> bytes:
+    out = bytearray()
+    out.append(mode)
+    wire._put_varint(out, src_actor)
+    return (bytes(out)
+            + wire._encode_vv_py(np.asarray(processed, np.uint32))
+            + wire.encode_payload(payload))
+
+
+def decode_payload_msg(body: bytes, num_elements: int, num_actors: int):
+    """Returns (mode, DeltaPayload) with src_actor and src_processed
+    rehydrated from the out-of-band fields."""
+    if not body:
+        raise ProtocolError("empty PAYLOAD body")
+    mode = body[0]
+    if mode not in (MODE_DELTA, MODE_FULL):
+        raise ProtocolError(f"unknown payload mode {mode}")
+    try:
+        src_actor, pos = wire._get_varint(body, 1)
+        if src_actor >= num_actors:
+            raise ProtocolError(f"payload src_actor {src_actor} outside "
+                                f"actor axis {num_actors}")
+        processed, pos = wire._decode_vv_py(body, pos, num_actors)
+        payload = wire.decode_payload(body[pos:], num_elements, num_actors,
+                                      src_actor=src_actor)
+    except ValueError as err:  # wire-layer section mismatch / malformed
+        raise ProtocolError(str(err)) from err
+    import jax.numpy as jnp
+
+    return mode, payload._replace(src_processed=jnp.asarray(processed))

@@ -1,0 +1,289 @@
+"""A networked δ-AWSet replica node.
+
+One ``Node`` is the process-level analogue of one reference replica struct
+(awset_test.go:159-168): it owns a single-replica packed
+``AWSetDeltaState`` (R=1), mutates it with the models/awset_delta ops, and
+anti-entropies with peers over TCP instead of the reference's direct
+method call.
+
+One ``sync_with`` call is a push-pull exchange:
+
+    client                                server
+      HELLO(actor, E, vv)  ------------->
+                           <-------------  HELLO(actor, E, vv)
+      PAYLOAD(δ vs server vv)  --------->  apply
+                           <-------------  PAYLOAD(δ vs client vv)
+      apply
+
+Each side compresses against the other's advertised VV — exactly the
+sender-side ``MakeDeltaMergeData`` contract (awset-delta_test.go:79-105) —
+and ships FULL state on first contact (the receiver-side dispatch
+condition ``Counter(src.Actor) <= 0``, awset-delta_test.go:53, evaluated
+from the advertised VV).  Apply uses the same kernels as the on-chip
+gossip path (ops/delta.py), so in-process, on-mesh, and cross-socket
+synchronization share one semantics implementation.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from go_crdt_playground_tpu.net import framing
+from go_crdt_playground_tpu.net.framing import (MODE_DELTA, MODE_FULL,
+                                                MSG_HELLO, MSG_PAYLOAD,
+                                                ProtocolError)
+
+
+class SyncStats(NamedTuple):
+    """One push-pull exchange, measured (δ-payload-bytes is a north-star
+    metric, BASELINE.md)."""
+
+    bytes_sent: int
+    bytes_received: int
+    mode_sent: int      # MODE_DELTA | MODE_FULL
+    mode_received: int
+
+
+class Node:
+    """A single networked replica.  Thread-safe; one lock serializes local
+    mutations, payload extraction, and payload application."""
+
+    def __init__(self, actor: int, num_elements: int, num_actors: int,
+                 delta_semantics: str = "v2",
+                 strict_reference_semantics: bool = True):
+        from go_crdt_playground_tpu.models import awset_delta
+
+        if not 0 <= actor < num_actors:
+            raise ValueError(f"actor {actor} outside actor axis {num_actors}")
+        self.actor = actor
+        self.num_elements = num_elements
+        self.num_actors = num_actors
+        self.delta_semantics = delta_semantics
+        self.strict_reference_semantics = strict_reference_semantics
+        self._lock = threading.Lock()
+        self._state = awset_delta.init(
+            1, num_elements, num_actors,
+            actors=np.asarray([actor], np.uint32))
+        self._server_sock: Optional[socket.socket] = None
+        self._server_thread: Optional[threading.Thread] = None
+        self._closing = False
+
+    # -- local ops (reference Add/Del, awset.go:89-101 δ-variant) ----------
+
+    def add(self, *element_ids: int) -> None:
+        """Add elements; each ticks the clock once (awset.go:89-94)."""
+        import jax.numpy as jnp
+
+        from go_crdt_playground_tpu.models import awset_delta
+
+        for e in element_ids:
+            if not 0 <= e < self.num_elements:
+                raise ValueError(f"element id {e} outside universe "
+                                 f"{self.num_elements}")
+        with self._lock:
+            for e in element_ids:
+                self._state = awset_delta.add_element(
+                    self._state, jnp.uint32(0), jnp.uint32(e))
+
+    def delete(self, *element_ids: int) -> None:
+        """δ-Del: one clock tick per call, one shared deletion dot for all
+        hit keys (awset-delta_test.go:14-33)."""
+        import jax.numpy as jnp
+
+        from go_crdt_playground_tpu.models import awset_delta
+
+        selector = np.zeros(self.num_elements, bool)
+        for e in element_ids:
+            if not 0 <= e < self.num_elements:
+                raise ValueError(f"element id {e} outside universe "
+                                 f"{self.num_elements}")
+            selector[e] = True
+        with self._lock:
+            self._state = awset_delta.del_elements(
+                self._state, jnp.uint32(0), jnp.asarray(selector))
+
+    def members(self) -> np.ndarray:
+        """Sorted live element ids (SortedValues, awset.go:61-70, on ids)."""
+        with self._lock:
+            return np.nonzero(np.asarray(self._state.present[0]))[0]
+
+    def vv(self) -> np.ndarray:
+        with self._lock:
+            return np.asarray(self._state.vv[0]).copy()
+
+    def state_slice(self):
+        """Snapshot of the single-replica state (for tests/checkpointing)."""
+        import jax
+
+        with self._lock:
+            return jax.tree.map(lambda x: x[0], self._state)
+
+    # -- payload plumbing ---------------------------------------------------
+
+    def _extract_msg(self, peer_vv: np.ndarray) -> Tuple[int, bytes]:
+        """Build the PAYLOAD frame body for a peer that advertised peer_vv.
+        Caller holds the lock."""
+        import jax
+        import jax.numpy as jnp
+
+        from go_crdt_playground_tpu.ops import delta as delta_ops
+
+        me = jax.tree.map(lambda x: x[0], self._state)
+        first_contact = int(peer_vv[self.actor]) == 0
+        if first_contact:
+            # FULL: ship the complete entry set + deletion log — the wire
+            # image of the reference's full-merge branch source state.
+            payload = delta_ops.DeltaPayload(
+                src_vv=me.vv,
+                changed=me.present,
+                ch_da=me.dot_actor, ch_dc=me.dot_counter,
+                deleted=me.deleted,
+                del_da=me.del_dot_actor, del_dc=me.del_dot_counter,
+                src_actor=jnp.uint32(self.actor),
+                src_processed=me.processed,
+            )
+            mode = MODE_FULL
+        else:
+            payload = delta_ops.delta_extract(me, jnp.asarray(peer_vv))
+            mode = MODE_DELTA
+        body = framing.encode_payload_msg(
+            mode, self.actor, np.asarray(me.processed), payload)
+        return mode, body
+
+    def _apply_msg(self, body: bytes) -> int:
+        """Decode + apply a PAYLOAD frame body.  Caller holds the lock."""
+        import jax
+
+        from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
+        from go_crdt_playground_tpu.ops import delta as delta_ops
+
+        mode, payload = framing.decode_payload_msg(
+            body, self.num_elements, self.num_actors)
+        me = jax.tree.map(lambda x: x[0], self._state)
+        if mode == MODE_FULL:
+            src = AWSetDeltaState(
+                vv=payload.src_vv,
+                present=payload.changed,
+                dot_actor=payload.ch_da, dot_counter=payload.ch_dc,
+                actor=payload.src_actor,
+                deleted=payload.deleted,
+                del_dot_actor=payload.del_da,
+                del_dot_counter=payload.del_dc,
+                processed=payload.src_processed,
+            )
+            merged = delta_ops.full_merge_delta(me, src, self.delta_semantics)
+        else:
+            merged = delta_ops.delta_apply(
+                me, payload, self.delta_semantics,
+                self.strict_reference_semantics)
+        self._state = jax.tree.map(
+            lambda full, row: full.at[0].set(row), self._state, merged)
+        return mode
+
+    # -- server -------------------------------------------------------------
+
+    def serve(self, host: str = "127.0.0.1",
+              port: int = 0) -> Tuple[str, int]:
+        """Start answering sync requests; returns the bound (host, port)."""
+        if self._server_sock is not None:
+            raise RuntimeError("already serving")
+        sock = socket.create_server((host, port))
+        self._server_sock = sock
+        self._closing = False
+        self._server_thread = threading.Thread(
+            target=self._accept_loop, name=f"crdt-node-{self.actor}",
+            daemon=True)
+        self._server_thread.start()
+        return sock.getsockname()[:2]
+
+    def _accept_loop(self) -> None:
+        assert self._server_sock is not None
+        while not self._closing:
+            try:
+                conn, _ = self._server_sock.accept()
+            except OSError:
+                return  # socket closed
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.settimeout(30.0)
+                msg_type, body = framing.recv_frame(conn)
+                if msg_type != MSG_HELLO:
+                    raise ProtocolError(f"expected HELLO, got {msg_type}")
+                try:
+                    peer_actor, peer_vv = framing.decode_hello(
+                        body, self.num_elements, self.num_actors)
+                except ProtocolError as e:
+                    framing.send_frame(conn, framing.MSG_ERROR,
+                                       str(e).encode())
+                    return
+                framing.send_frame(conn, MSG_HELLO, framing.encode_hello(
+                    self.actor, self.num_elements, self.vv()))
+                msg_type, body = framing.recv_frame(conn)
+                if msg_type != MSG_PAYLOAD:
+                    raise ProtocolError(f"expected PAYLOAD, got {msg_type}")
+                try:
+                    with self._lock:
+                        self._apply_msg(body)
+                        # extract after absorbing the client's payload so
+                        # transitively-learned entries ride along;
+                        # compression vs the client's advertised VV
+                        # filters what it has.
+                        _, reply = self._extract_msg(peer_vv)
+                except ProtocolError as e:
+                    framing.send_frame(conn, framing.MSG_ERROR,
+                                       str(e).encode())
+                    return
+                framing.send_frame(conn, MSG_PAYLOAD, reply)
+        except (ProtocolError, framing.RemoteError, OSError):
+            pass  # connection-scoped failure; anti-entropy self-heals
+
+    def close(self) -> None:
+        self._closing = True
+        if self._server_sock is not None:
+            try:
+                self._server_sock.close()
+            finally:
+                self._server_sock = None
+        if self._server_thread is not None:
+            self._server_thread.join(timeout=5.0)
+            self._server_thread = None
+
+    def __enter__(self) -> "Node":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- client -------------------------------------------------------------
+
+    def sync_with(self, addr: Tuple[str, int],
+                  timeout: float = 30.0) -> SyncStats:
+        """One push-pull anti-entropy exchange with the peer at addr."""
+        with socket.create_connection(addr, timeout=timeout) as sock:
+            sent = framing.send_frame(sock, MSG_HELLO, framing.encode_hello(
+                self.actor, self.num_elements, self.vv()))
+            msg_type, body = framing.recv_frame(sock)
+            if msg_type != MSG_HELLO:
+                raise ProtocolError(f"expected HELLO, got {msg_type}")
+            _, peer_vv = framing.decode_hello(
+                body, self.num_elements, self.num_actors)
+            recv = framing.frame_size(len(body))
+            with self._lock:
+                mode_sent, out = self._extract_msg(peer_vv)
+            sent += framing.send_frame(sock, MSG_PAYLOAD, out)
+            msg_type, body = framing.recv_frame(sock)
+            if msg_type != MSG_PAYLOAD:
+                raise ProtocolError(f"expected PAYLOAD, got {msg_type}")
+            recv += framing.frame_size(len(body))
+            with self._lock:
+                mode_recv = self._apply_msg(body)
+        return SyncStats(bytes_sent=sent, bytes_received=recv,
+                         mode_sent=mode_sent, mode_received=mode_recv)

@@ -148,8 +148,8 @@ def delta_apply(
     )
 
 
-def _full_merge_delta(dst: AWSetDeltaState, src: AWSetDeltaState,
-                      delta_semantics: str) -> AWSetDeltaState:
+def full_merge_delta(dst: AWSetDeltaState, src: AWSetDeltaState,
+                     delta_semantics: str) -> AWSetDeltaState:
     """First-contact branch (awset-delta_test.go:53-56): plain full-state
     merge.  Reference mode leaves the receiver's log untouched; v2 absorbs
     src's log and processed vector (the merged state reflects every
@@ -193,7 +193,7 @@ def delta_merge_pair(
     selected per field — the TPU way to express the reference's
     ``if Counter(src.Actor) <= 0`` control flow."""
     first_contact = dst.vv[src.actor.astype(jnp.int32)] == 0
-    full = _full_merge_delta(dst, src, delta_semantics)
+    full = full_merge_delta(dst, src, delta_semantics)
     payload = delta_extract(src, dst.vv)
     delt = delta_apply(dst, payload, delta_semantics,
                        strict_reference_semantics)

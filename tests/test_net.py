@@ -1,0 +1,219 @@
+"""Networked anti-entropy (net/peer.py): the reference's simulated
+``dst.Merge(src)`` exchange (awset_test.go:16-17) carried over a real TCP
+socket in the compact δ wire format, applied with the same kernels as the
+on-chip gossip path.
+
+Oracle: the executable spec (models/spec.py).  One push-pull ``sync_with``
+equals the sequential spec exchange ``server.merge(client)`` then
+``client.merge(server)`` — the server extracts its reply after absorbing
+the client's payload.
+"""
+
+import numpy as np
+import pytest
+
+from go_crdt_playground_tpu.models.spec import AWSetDelta, VersionVector
+from go_crdt_playground_tpu.net import Node, framing
+from go_crdt_playground_tpu.net.framing import MODE_DELTA, MODE_FULL
+
+E = 32
+A = 2
+
+
+def make_nodes(delta_semantics="v2", num_actors=A):
+    nodes = [Node(i, E, num_actors, delta_semantics=delta_semantics)
+             for i in range(num_actors)]
+    return nodes
+
+
+def key(i: int) -> str:
+    return f"e{i:03d}"
+
+
+def make_spec_pair(delta_semantics="v2", num_actors=A):
+    return [AWSetDelta(actor=i,
+                       version_vector=VersionVector([0] * num_actors),
+                       delta_semantics=delta_semantics)
+            for i in range(num_actors)]
+
+
+def spec_exchange(client: AWSetDelta, server: AWSetDelta) -> None:
+    server.merge(client)
+    client.merge(server)
+
+
+def members_of(spec: AWSetDelta):
+    return np.asarray(sorted(int(k[1:]) for k in spec.entries))
+
+
+def test_two_node_convergence_and_modes():
+    a, b = make_nodes()
+    with b:
+        addr = b.serve()
+        a.add(1, 2, 3)
+        b.add(3, 4)
+        stats = a.sync_with(addr)
+        # neither side had seen the other: both directions ship FULL state
+        assert stats.mode_sent == MODE_FULL
+        assert stats.mode_received == MODE_FULL
+        np.testing.assert_array_equal(a.members(), [1, 2, 3, 4])
+        np.testing.assert_array_equal(b.members(), [1, 2, 3, 4])
+        # established peers ride the δ path
+        a.add(5)
+        stats = a.sync_with(addr)
+        assert stats.mode_sent == MODE_DELTA
+        assert stats.mode_received == MODE_DELTA
+        np.testing.assert_array_equal(b.members(), [1, 2, 3, 4, 5])
+
+
+def test_add_wins_over_concurrent_delete():
+    # the reference's headline property (awset_test.go:85-112) over a socket
+    a, b = make_nodes()
+    with b:
+        addr = b.serve()
+        a.add(5)
+        a.sync_with(addr)
+        b.delete(5)       # observed remove of the first instance...
+        a.add(5)          # ...concurrent with a fresh add at A
+        a.sync_with(addr)
+        np.testing.assert_array_equal(a.members(), [5])
+        np.testing.assert_array_equal(b.members(), [5])
+
+
+def test_observed_delete_sticks():
+    # the non-concurrent case (awset_test.go:113-121): B observed the add
+    # and deleted it; no concurrent re-add, so the delete wins everywhere
+    a, b = make_nodes()
+    with b:
+        addr = b.serve()
+        a.add(7)
+        a.sync_with(addr)
+        b.delete(7)
+        a.sync_with(addr)
+        assert a.members().size == 0
+        assert b.members().size == 0
+
+
+def test_three_node_transitive_propagation():
+    nodes = make_nodes(num_actors=3)
+    a, b, c = nodes
+    with a, b, c:
+        addr_b = b.serve()
+        addr_c = c.serve()
+        a.add(1)
+        c.add(9)
+        a.sync_with(addr_b)    # B learns 1
+        b.sync_with(addr_c)    # C learns 1 via B; B learns 9
+        b.sync_with(addr_c)    # (already converged pair — stays converged)
+        a.sync_with(addr_b)    # A learns 9 via B
+        for n in (a, b, c):
+            np.testing.assert_array_equal(n.members(), [1, 9])
+
+
+def test_payload_bytes_shrink_after_convergence():
+    a, b = make_nodes()
+    with b:
+        addr = b.serve()
+        a.add(*range(20))
+        b.add(30)  # tick B's clock so the δ dispatch applies both ways
+        first = a.sync_with(addr)
+        second = a.sync_with(addr)
+        # converged: both directions are near-empty δ payloads (only
+        # HELLO + framing + empty sections remain on the wire)
+        assert second.mode_sent == MODE_DELTA
+        assert second.mode_received == MODE_DELTA
+        assert second.bytes_sent < first.bytes_sent
+        assert second.bytes_received < first.bytes_received
+        assert second.bytes_sent < 48
+
+
+def test_write_free_replica_keeps_full_dispatch():
+    """A replica that never wrote has counter 0 — peers must keep taking
+    the full-merge branch toward it (awset-delta_test.go:53)."""
+    a, b = make_nodes()
+    with b:
+        addr = b.serve()
+        a.add(1)
+        stats = a.sync_with(addr)
+        assert stats.mode_received == MODE_FULL
+        stats = a.sync_with(addr)
+        # B still has never written: its reply stays FULL; A has written,
+        # so A's outbound flips to δ after the first exchange
+        assert stats.mode_sent == MODE_DELTA
+        assert stats.mode_received == MODE_FULL
+
+
+def test_dimension_mismatch_rejected():
+    a = Node(0, E, A)
+    b = Node(1, E * 2, A)
+    with b:
+        addr = b.serve()
+        with pytest.raises(framing.RemoteError, match="universe mismatch"):
+            a.sync_with(addr)
+
+
+def test_actor_axis_mismatch_rejected():
+    # wire-layer ValueError must surface as a clean MSG_ERROR frame, not
+    # kill the server handler thread
+    a = Node(0, E, 2)
+    b = Node(1, E, 3)
+    with b:
+        addr = b.serve()
+        with pytest.raises(framing.RemoteError, match="actor-axis mismatch"):
+            a.sync_with(addr)
+        # server survives the bad peer and still serves well-formed ones
+        c = Node(0, E, 3)
+        c.add(4)
+        c.sync_with(addr)
+        np.testing.assert_array_equal(b.members(), [4])
+
+
+@pytest.mark.parametrize("delta_semantics", ["v2", "reference"])
+def test_randomized_scenario_matches_spec(delta_semantics):
+    """Random op/sync interleavings over the socket must track the spec
+    replica pair step for step (membership oracle; VVs compared too in the
+    non-quirk v2 mode)."""
+    rng = np.random.default_rng(7)
+    a, b = make_nodes(delta_semantics)
+    sa, sb = make_spec_pair(delta_semantics)
+    with b:
+        addr = b.serve()
+        for _ in range(60):
+            op = rng.integers(0, 4)
+            if op == 0:
+                ids = rng.choice(E, size=rng.integers(1, 4), replace=False)
+                a.add(*ids)
+                sa.add(*(key(i) for i in ids))
+            elif op == 1:
+                ids = rng.choice(E, size=rng.integers(1, 4), replace=False)
+                b.add(*ids)
+                sb.add(*(key(i) for i in ids))
+            elif op == 2:
+                who, spec_who = (a, sa) if rng.integers(2) else (b, sb)
+                live = who.members()
+                if live.size:
+                    ids = rng.choice(live, size=rng.integers(
+                        1, min(3, live.size) + 1), replace=False)
+                    who.delete(*ids)
+                    spec_who.del_(*(key(i) for i in ids))
+            else:
+                a.sync_with(addr)
+                spec_exchange(sa, sb)
+                np.testing.assert_array_equal(a.members(), members_of(sa))
+                np.testing.assert_array_equal(b.members(), members_of(sb))
+        a.sync_with(addr)
+        spec_exchange(sa, sb)
+        np.testing.assert_array_equal(a.members(), members_of(sa))
+        np.testing.assert_array_equal(b.members(), members_of(sb))
+        if delta_semantics == "v2":
+            np.testing.assert_array_equal(
+                a.vv(), [sa.version_vector[i] for i in range(A)])
+            np.testing.assert_array_equal(
+                b.vv(), [sb.version_vector[i] for i in range(A)])
+
+
+def test_frame_size_matches_send():
+    assert framing.frame_size(0) == 4
+    assert framing.frame_size(127) == 4 + 127
+    assert framing.frame_size(128) == 5 + 128
+    assert framing.frame_size(1 << 20) == 2 + 1 + 3 + (1 << 20)
