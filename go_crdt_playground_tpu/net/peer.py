@@ -54,11 +54,16 @@ class Node:
 
     def __init__(self, actor: int, num_elements: int, num_actors: int,
                  delta_semantics: str = "v2",
-                 strict_reference_semantics: bool = True):
+                 strict_reference_semantics: bool = True,
+                 recorder=None):
+        """recorder: optional obs.Recorder; when given, every exchange
+        counts sync.exchanges / sync.bytes_sent / sync.bytes_received /
+        sync.full_payloads on it (served and initiated alike)."""
         from go_crdt_playground_tpu.models import awset_delta
 
         if not 0 <= actor < num_actors:
             raise ValueError(f"actor {actor} outside actor axis {num_actors}")
+        self.recorder = recorder
         self.actor = actor
         self.num_elements = num_elements
         self.num_actors = num_actors
@@ -201,10 +206,11 @@ class Node:
         return sock.getsockname()[:2]
 
     def _accept_loop(self) -> None:
-        assert self._server_sock is not None
+        sock = self._server_sock  # snapshot: close() may null the field
+        assert sock is not None
         while not self._closing:
             try:
-                conn, _ = self._server_sock.accept()
+                conn, _ = sock.accept()
             except OSError:
                 return  # socket closed
             threading.Thread(target=self._handle, args=(conn,),
@@ -216,7 +222,11 @@ class Node:
                 conn.settimeout(30.0)
                 msg_type, body = framing.recv_frame(conn)
                 if msg_type != MSG_HELLO:
-                    raise ProtocolError(f"expected HELLO, got {msg_type}")
+                    framing.send_frame(conn, framing.MSG_ERROR,
+                                       f"expected HELLO, got {msg_type}"
+                                       .encode())
+                    return
+                recv = framing.frame_size(len(body))
                 try:
                     peer_actor, peer_vv = framing.decode_hello(
                         body, self.num_elements, self.num_actors)
@@ -224,11 +234,15 @@ class Node:
                     framing.send_frame(conn, framing.MSG_ERROR,
                                        str(e).encode())
                     return
-                framing.send_frame(conn, MSG_HELLO, framing.encode_hello(
-                    self.actor, self.num_elements, self.vv()))
+                sent = framing.send_frame(
+                    conn, MSG_HELLO, framing.encode_hello(
+                        self.actor, self.num_elements, self.vv()))
                 msg_type, body = framing.recv_frame(conn)
                 if msg_type != MSG_PAYLOAD:
-                    raise ProtocolError(f"expected PAYLOAD, got {msg_type}")
+                    framing.send_frame(conn, framing.MSG_ERROR,
+                                       f"expected PAYLOAD, got {msg_type}"
+                                       .encode())
+                    return
                 try:
                     with self._lock:
                         self._apply_msg(body)
@@ -236,12 +250,15 @@ class Node:
                         # transitively-learned entries ride along;
                         # compression vs the client's advertised VV
                         # filters what it has.
-                        _, reply = self._extract_msg(peer_vv)
+                        reply_mode, reply = self._extract_msg(peer_vv)
                 except ProtocolError as e:
                     framing.send_frame(conn, framing.MSG_ERROR,
                                        str(e).encode())
                     return
-                framing.send_frame(conn, MSG_PAYLOAD, reply)
+                sent += framing.send_frame(conn, MSG_PAYLOAD, reply)
+                recv += framing.frame_size(len(body))
+                self._record(reply_mode, bytes_sent=sent,
+                             bytes_received=recv)
         except (ProtocolError, framing.RemoteError, OSError):
             pass  # connection-scoped failure; anti-entropy self-heals
 
@@ -285,5 +302,16 @@ class Node:
             recv += framing.frame_size(len(body))
             with self._lock:
                 mode_recv = self._apply_msg(body)
+        self._record(mode_sent, bytes_sent=sent, bytes_received=recv)
         return SyncStats(bytes_sent=sent, bytes_received=recv,
                          mode_sent=mode_sent, mode_received=mode_recv)
+
+    def _record(self, mode_sent: int, bytes_sent: int,
+                bytes_received: int) -> None:
+        if self.recorder is None:
+            return
+        self.recorder.count("sync.exchanges")
+        self.recorder.count("sync.bytes_sent", bytes_sent)
+        self.recorder.count("sync.bytes_received", bytes_received)
+        if mode_sent == MODE_FULL:
+            self.recorder.count("sync.full_payloads")

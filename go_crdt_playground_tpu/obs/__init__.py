@@ -1,0 +1,19 @@
+"""Observability: merge-decision tracing and metrics.
+
+The reference's only observability is unconditional ``fmt.Printf`` of
+every merge decision (awset.go:109-121) with nondeterministic line order
+(Go map iteration).  Here tracing is an optional per-element decision
+tensor emitted by the kernels (ops/merge.MergeTrace) — array-comparable,
+deterministic — plus renderers that reproduce the reference's exact
+stdout format for eyeball-debugging, and a small metrics recorder for
+the north-star counters (merges/sec, rounds-to-convergence, δ-payload
+bytes; SURVEY §5.5).
+"""
+
+from go_crdt_playground_tpu.obs.metrics import Recorder, payload_metrics  # noqa: F401
+from go_crdt_playground_tpu.obs.trace import (  # noqa: F401
+    format_event,
+    render_spec_trace,
+    render_tensor_trace,
+    trace_counts,
+)

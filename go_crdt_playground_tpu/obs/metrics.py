@@ -1,0 +1,84 @@
+"""North-star metrics plumbing (SURVEY §5.5, BASELINE.md).
+
+The reference has zero metrics machinery; its operational counters are
+implicit in stdout traces.  This module gives the framework the three
+counters the measurement ladder tracks — merges/sec, rounds-to-
+convergence, δ-payload bytes — behind one small thread-safe ``Recorder``
+(net.Node takes one and counts every sync exchange on it) plus
+payload-size helpers for δ payloads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict
+
+
+class Recorder:
+    """Thread-safe counters, value observations, and wall-clock timers.
+
+    count():   monotonically increasing totals (merges, rounds, bytes).
+    observe(): value streams summarized as n/sum/min/max.
+    time():    context manager feeding observe() with elapsed seconds.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._observations: Dict[str, Dict[str, float]] = {}
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            o = self._observations.get(name)
+            if o is None:
+                self._observations[name] = {
+                    "n": 1, "sum": float(value),
+                    "min": float(value), "max": float(value),
+                }
+            else:
+                o["n"] += 1
+                o["sum"] += float(value)
+                o["min"] = min(o["min"], float(value))
+                o["max"] = max(o["max"], float(value))
+
+    @contextmanager
+    def time(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    def snapshot(self) -> Dict[str, object]:
+        """Point-in-time copy: {"counters": {...}, "observations": {...}}
+        with per-stream mean added."""
+        with self._lock:
+            obs = {
+                name: {**o, "mean": o["sum"] / o["n"]}
+                for name, o in self._observations.items()
+            }
+            return {"counters": dict(self._counters), "observations": obs}
+
+
+def payload_metrics(payload, wire: bool = True) -> Dict[str, int]:
+    """Size/occupancy metrics for one δ payload (ops/delta.DeltaPayload,
+    single-replica slices): changed/deleted lane counts, dense on-device
+    bytes, and (optionally — it costs an encode) actual wire bytes."""
+    import numpy as np
+
+    out = {
+        "changed_lanes": int(np.asarray(payload.changed).sum()),
+        "deleted_lanes": int(np.asarray(payload.deleted).sum()),
+        "dense_bytes": int(payload.nbytes_dense()),
+    }
+    if wire:
+        from go_crdt_playground_tpu.utils.wire import payload_nbytes_wire
+
+        out["wire_bytes"] = int(payload_nbytes_wire(payload))
+    return out

@@ -1,0 +1,144 @@
+"""Merge-decision trace rendering.
+
+Reproduces the reference's trace lines byte-for-byte
+(``> phase %d %-10q %-18s => %s``, awset.go:120, and the
+``merge %v <- %v`` header, awset.go:121) from either source:
+
+  * spec-model TraceEvents (models/spec.py collects them via a TraceFn);
+  * the kernel's MergeTrace decision tensors (ops/merge.py), whose per-
+    element codes are decoded back to lines in element-id order — the
+    deterministic normalization of Go's random map-iteration order
+    (SURVEY §5.1).
+
+Cross-path conformance: rendering both sources for the same scenario and
+comparing as *sorted* line sets must agree (tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from go_crdt_playground_tpu.models.spec import Dot, TraceEvent, _go_quote
+from go_crdt_playground_tpu.ops.merge import (OUTCOME_ADD, OUTCOME_KEEP,
+                                              OUTCOME_NONE, OUTCOME_REMOVE,
+                                              OUTCOME_SKIP, OUTCOME_UPDATE,
+                                              MergeTrace)
+
+OUTCOME_NAMES: Dict[int, str] = {
+    OUTCOME_UPDATE: "update",
+    OUTCOME_KEEP: "keep",
+    OUTCOME_SKIP: "skip",
+    OUTCOME_ADD: "add",
+    OUTCOME_REMOVE: "remove",
+}
+
+
+def _dot_str(dot: Optional[Tuple[int, int]]) -> str:
+    """Go ``Dot.String``: ``(A 1)`` with the actor as a letter
+    (crdt-misc.go:17-19); ``()`` for a nil dot."""
+    if dot is None:
+        return "()"
+    actor, counter = dot
+    return f"({chr(ord('A') + actor)} {counter})"
+
+
+def vv_str(vv: Sequence[int]) -> str:
+    """Go ``VersionVector.String`` (crdt-misc.go:57-68)."""
+    return "[" + ", ".join(
+        f"({chr(ord('A') + i)} {int(n)})" for i, n in enumerate(vv)) + "]"
+
+
+def format_line(phase: int, key: str, dst_dot: Optional[Tuple[int, int]],
+                src_dot: Optional[Tuple[int, int]], outcome: str) -> str:
+    """One ``logOutcome`` line (awset.go:109-120)."""
+    dots = f"{_dot_str(dst_dot)} <- {_dot_str(src_dot)}"
+    return f"> phase {phase} {_go_quote(key):<10} {dots:<18} => {outcome}"
+
+
+def _as_pair(dot) -> Optional[Tuple[int, int]]:
+    if dot is None:
+        return None
+    if isinstance(dot, Dot):
+        return (int(dot.actor), int(dot.counter))
+    return (int(dot[0]), int(dot[1]))
+
+
+def format_event(ev: TraceEvent) -> str:
+    """Render one spec-model TraceEvent as the reference line."""
+    return format_line(ev.phase, ev.key, _as_pair(ev.dst_dot),
+                       _as_pair(ev.src_dot), ev.outcome)
+
+
+def render_spec_trace(events: Iterable[TraceEvent]) -> List[str]:
+    return [format_event(ev) for ev in events]
+
+
+def render_tensor_trace(
+    trace: MergeTrace,
+    dst_before,
+    src,
+    key_of=None,
+    header: bool = True,
+) -> List[str]:
+    """Decode a kernel MergeTrace back to reference-format lines.
+
+    dst_before/src: single-replica AWSetState slices captured BEFORE the
+    merge (the kernel is functional, so the caller still has them).
+    key_of: element id -> key string (e.g. ElementDict.decode); defaults
+    to the decimal id.  Lines come out in element-id order — Go's map
+    order is nondeterministic, so comparisons should sort both sides.
+    """
+    key_of = key_of or (lambda e: str(e))
+    p1 = np.asarray(trace.phase1)
+    p2 = np.asarray(trace.phase2)
+    dst_p = np.asarray(dst_before.present)
+    src_p = np.asarray(src.present)
+    dst_dot = (np.asarray(dst_before.dot_actor),
+               np.asarray(dst_before.dot_counter))
+    src_dot = (np.asarray(src.dot_actor), np.asarray(src.dot_counter))
+    if p1.ndim != 1:
+        raise ValueError("render_tensor_trace takes single-replica slices; "
+                         "index the batch first")
+
+    def dot_at(dots, e):
+        return (int(dots[0][e]), int(dots[1][e]))
+
+    lines: List[str] = []
+    if header:
+        lines.append(f"merge {vv_str(np.asarray(dst_before.vv))} "
+                     f"<- {vv_str(np.asarray(src.vv))}")
+    for e in np.nonzero(p1 != OUTCOME_NONE)[0]:
+        code = int(p1[e])
+        d = dot_at(dst_dot, e) if dst_p[e] else None
+        s = dot_at(src_dot, e) if src_p[e] else None
+        lines.append(format_line(1, key_of(int(e)), d, s,
+                                 OUTCOME_NAMES[code]))
+    for e in np.nonzero(p2 != OUTCOME_NONE)[0]:
+        code = int(p2[e])
+        # phase 2 logs the POST-phase-1 dst dot (awset.go:145-147): for
+        # lanes present on both sides phase 1 overwrote it with src's dot
+        if src_p[e]:
+            d = dot_at(src_dot, e)
+            s = dot_at(src_dot, e)
+        else:
+            d = dot_at(dst_dot, e)
+            s = None
+        lines.append(format_line(2, key_of(int(e)), d, s,
+                                 OUTCOME_NAMES[code]))
+    return lines
+
+
+def trace_counts(trace: MergeTrace) -> Dict[str, Dict[str, int]]:
+    """Outcome histograms per phase — the aggregate view that replaces
+    stdout-scraping for bulk merges (works on batched traces too)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for phase_name, arr in (("phase1", trace.phase1),
+                            ("phase2", trace.phase2)):
+        counts = np.bincount(np.asarray(arr).ravel(), minlength=6)
+        out[phase_name] = {
+            name: int(counts[code]) for code, name in OUTCOME_NAMES.items()
+            if counts[code]
+        }
+    return out

@@ -212,6 +212,26 @@ def test_randomized_scenario_matches_spec(delta_semantics):
                 b.vv(), [sb.version_vector[i] for i in range(A)])
 
 
+def test_recorder_counts_exchanges():
+    from go_crdt_playground_tpu.obs import Recorder
+
+    ra, rb = Recorder(), Recorder()
+    a = Node(0, E, A, recorder=ra)
+    b = Node(1, E, A, recorder=rb)
+    with b:
+        addr = b.serve()
+        a.add(1)
+        stats = a.sync_with(addr)
+        ca = ra.snapshot()["counters"]
+        cb = rb.snapshot()["counters"]
+        assert ca["sync.exchanges"] == 1 and cb["sync.exchanges"] == 1
+        assert ca["sync.bytes_sent"] == stats.bytes_sent
+        assert ca["sync.bytes_received"] == stats.bytes_received
+        # server's sent bytes are the client's received bytes
+        assert cb["sync.bytes_sent"] == stats.bytes_received
+        assert ca["sync.full_payloads"] == 1  # first contact ships FULL
+
+
 def test_frame_size_matches_send():
     assert framing.frame_size(0) == 4
     assert framing.frame_size(127) == 4 + 127

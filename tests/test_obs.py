@@ -1,0 +1,138 @@
+"""Observability (obs/): trace rendering conformance + metrics.
+
+The key property: rendering the kernel's MergeTrace decision tensors and
+the spec model's TraceEvents for the SAME scenario yields the same line
+set (Go's map-iteration line order is nondeterministic, reference
+SURVEY §5.1, so comparison is on sorted lines)."""
+
+import numpy as np
+import pytest
+
+from go_crdt_playground_tpu.models import awset
+from go_crdt_playground_tpu.models.spec import (AWSet, Dot, TraceEvent,
+                                                VersionVector)
+from go_crdt_playground_tpu.obs import (Recorder, format_event,
+                                        payload_metrics, render_spec_trace,
+                                        render_tensor_trace, trace_counts)
+from go_crdt_playground_tpu.ops.merge import merge_pairwise
+from go_crdt_playground_tpu.utils import codec
+
+E = 16
+
+
+def key(i: int) -> str:
+    return f"e{i:02d}"
+
+
+def run_scenario():
+    """Two replicas, ops chosen so merge A<-B hits update (both present,
+    different dots), add (B-only unseen), remove (A-only entry B
+    witnessed and deleted), and phase-2 keep."""
+    a = AWSet(actor=0, version_vector=VersionVector([0, 0]))
+    b = AWSet(actor=1, version_vector=VersionVector([0, 0]))
+    a.add(key(1), key(2), key(3))
+    b.merge(a)            # b now shares 1,2,3 (same dots -> keep lanes)
+    b.del_(key(3))        # b witnessed 3 and removed it -> remove lane
+    b.add(key(2))         # fresh dot for 2 at B -> update lane
+    b.add(key(4))         # B-only -> add lane
+    a.del_(key(1))
+    b.add(key(1))         # hmm: A deleted 1 but B re-adds with new dot
+    return a, b
+
+
+def packed_pair(a: AWSet, b: AWSet):
+    dictionary = codec.ElementDict(capacity=E)
+    for i in range(E):
+        dictionary.encode(key(i))
+    arrays = codec.pack_awsets([a, b], dictionary, num_actors=2)
+    return awset.from_arrays(arrays), dictionary
+
+
+def test_tensor_trace_matches_spec_trace():
+    a, b = run_scenario()
+    events = []
+    a.trace = events.append
+    state, dictionary = packed_pair(a, b)
+
+    # spec merge a <- b (collects events)
+    a.merge(b)
+
+    # kernel merge row0 <- row1 with trace
+    import jax
+
+    dst = jax.tree.map(lambda x: x[:1], state)
+    src = jax.tree.map(lambda x: x[1:], state)
+    merged, trace = merge_pairwise(dst, src, with_trace=True)
+
+    spec_lines = render_spec_trace(events)
+    tensor_lines = render_tensor_trace(
+        jax.tree.map(lambda x: x[0], trace),
+        jax.tree.map(lambda x: x[0], dst),
+        jax.tree.map(lambda x: x[0], src),
+        key_of=dictionary.decode,
+        header=False,
+    )
+    assert sorted(tensor_lines) == sorted(spec_lines)
+    # and the merged state agrees with the spec replica
+    np.testing.assert_array_equal(
+        np.nonzero(np.asarray(merged.present[0]))[0],
+        sorted(dictionary.encode(k) for k in a.entries),
+    )
+
+
+def test_line_format_is_go_identical():
+    # awset.go:120: fmt.Printf("> phase %d %-10q %-18s => %s\n", ...)
+    ev_line = format_event(TraceEvent(1, "Anne", Dot(0, 1), Dot(1, 2),
+                                      "update"))
+    assert ev_line == '> phase 1 "Anne"     (A 1) <- (B 2)     => update'
+    ev_line = format_event(TraceEvent(2, "Bob", Dot(2, 7), None, "remove"))
+    assert ev_line == '> phase 2 "Bob"      (C 7) <- ()        => remove'
+
+
+def test_trace_counts_all_outcomes():
+    a, b = run_scenario()
+    state, _ = packed_pair(a, b)
+    import jax
+
+    dst = jax.tree.map(lambda x: x[:1], state)
+    src = jax.tree.map(lambda x: x[1:], state)
+    _, trace = merge_pairwise(dst, src, with_trace=True)
+    counts = trace_counts(trace)
+    assert counts["phase1"].get("update", 0) >= 1
+    assert counts["phase1"].get("add", 0) >= 1
+    assert counts["phase2"].get("remove", 0) >= 1
+    assert counts["phase2"].get("keep", 0) >= 1
+
+
+def test_recorder():
+    r = Recorder()
+    r.count("merges", 5)
+    r.count("merges", 3)
+    r.observe("payload_bytes", 10)
+    r.observe("payload_bytes", 30)
+    with r.time("round_s"):
+        pass
+    snap = r.snapshot()
+    assert snap["counters"]["merges"] == 8
+    o = snap["observations"]["payload_bytes"]
+    assert (o["n"], o["sum"], o["min"], o["max"], o["mean"]) == (
+        2, 40.0, 10.0, 30.0, 20.0)
+    assert snap["observations"]["round_s"]["n"] == 1
+
+
+def test_payload_metrics():
+    import jax
+    import jax.numpy as jnp
+
+    from go_crdt_playground_tpu.models import awset_delta
+    from go_crdt_playground_tpu.ops import delta as delta_ops
+
+    state = awset_delta.init(1, E, 2)
+    state = awset_delta.add_element(state, jnp.uint32(0), jnp.uint32(3))
+    state = awset_delta.add_element(state, jnp.uint32(0), jnp.uint32(5))
+    me = jax.tree.map(lambda x: x[0], state)
+    p = delta_ops.delta_extract(me, jnp.zeros(2, jnp.uint32))
+    m = payload_metrics(p)
+    assert m["changed_lanes"] == 2
+    assert m["deleted_lanes"] == 0
+    assert 0 < m["wire_bytes"] < m["dense_bytes"]
