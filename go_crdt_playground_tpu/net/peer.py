@@ -310,8 +310,11 @@ class Node:
                 bytes_received: int) -> None:
         if self.recorder is None:
             return
-        self.recorder.count("sync.exchanges")
-        self.recorder.count("sync.bytes_sent", bytes_sent)
-        self.recorder.count("sync.bytes_received", bytes_received)
+        counts = {
+            "sync.exchanges": 1,
+            "sync.bytes_sent": bytes_sent,
+            "sync.bytes_received": bytes_received,
+        }
         if mode_sent == MODE_FULL:
-            self.recorder.count("sync.full_payloads")
+            counts["sync.full_payloads"] = 1
+        self.recorder.count_many(counts)

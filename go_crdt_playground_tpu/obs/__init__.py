@@ -11,9 +11,20 @@ bytes; SURVEY §5.5).
 """
 
 from go_crdt_playground_tpu.obs.metrics import Recorder, payload_metrics  # noqa: F401
-from go_crdt_playground_tpu.obs.trace import (  # noqa: F401
-    format_event,
-    render_spec_trace,
-    render_tensor_trace,
-    trace_counts,
-)
+
+# trace.py pulls in ops.merge -> jax; keep the metrics-only import path
+# (net.Node defers jax the same way) light by lazy-loading the renderers.
+_TRACE_EXPORTS = frozenset({
+    "format_event", "render_spec_trace", "render_tensor_trace",
+    "trace_counts",
+})
+
+__all__ = ["Recorder", "payload_metrics", *sorted(_TRACE_EXPORTS)]
+
+
+def __getattr__(name: str):
+    if name in _TRACE_EXPORTS:
+        from go_crdt_playground_tpu.obs import trace
+
+        return getattr(trace, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

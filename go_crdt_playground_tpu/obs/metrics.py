@@ -33,6 +33,13 @@ class Recorder:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + n
 
+    def count_many(self, counts: Dict[str, int]) -> None:
+        """Atomically bump several counters — a snapshot() concurrent with
+        one count_many sees either none or all of its increments."""
+        with self._lock:
+            for name, n in counts.items():
+                self._counters[name] = self._counters.get(name, 0) + n
+
     def observe(self, name: str, value: float) -> None:
         with self._lock:
             o = self._observations.get(name)

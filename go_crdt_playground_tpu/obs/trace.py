@@ -20,7 +20,8 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from go_crdt_playground_tpu.models.spec import Dot, TraceEvent, _go_quote
+from go_crdt_playground_tpu.models.spec import (Dot, TraceEvent,
+                                                VersionVector, _go_quote)
 from go_crdt_playground_tpu.ops.merge import (OUTCOME_ADD, OUTCOME_KEEP,
                                               OUTCOME_NONE, OUTCOME_REMOVE,
                                               OUTCOME_SKIP, OUTCOME_UPDATE,
@@ -36,18 +37,17 @@ OUTCOME_NAMES: Dict[int, str] = {
 
 
 def _dot_str(dot: Optional[Tuple[int, int]]) -> str:
-    """Go ``Dot.String``: ``(A 1)`` with the actor as a letter
-    (crdt-misc.go:17-19); ``()`` for a nil dot."""
+    """Go ``Dot.String`` via the spec model's renderer (crdt-misc.go:17-19);
+    ``()`` for a nil dot."""
     if dot is None:
         return "()"
-    actor, counter = dot
-    return f"({chr(ord('A') + actor)} {counter})"
+    return str(Dot(dot[0], dot[1]))
 
 
 def vv_str(vv: Sequence[int]) -> str:
-    """Go ``VersionVector.String`` (crdt-misc.go:57-68)."""
-    return "[" + ", ".join(
-        f"({chr(ord('A') + i)} {int(n)})" for i, n in enumerate(vv)) + "]"
+    """Go ``VersionVector.String`` via the spec model's renderer
+    (crdt-misc.go:57-68)."""
+    return str(VersionVector([int(n) for n in vv]))
 
 
 def format_line(phase: int, key: str, dst_dot: Optional[Tuple[int, int]],

@@ -35,9 +35,12 @@ from jax.sharding import Mesh
 from go_crdt_playground_tpu.models.awset import AWSetState
 from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
 from go_crdt_playground_tpu.ops.merge import merge_pairwise
-from go_crdt_playground_tpu.ops.delta import delta_merge_pairwise
+from go_crdt_playground_tpu.ops.delta import (
+    delta_apply, delta_extract, delta_merge_pairwise)
 from go_crdt_playground_tpu.parallel import collectives
-from go_crdt_playground_tpu.parallel.mesh import REPLICA_AXIS, partition_specs
+from go_crdt_playground_tpu.parallel import mesh as mesh_mod
+from go_crdt_playground_tpu.parallel.mesh import (
+    ELEMENT_AXIS, REPLICA_AXIS, partition_specs)
 
 # One fused program for the per-round convergence predicate — the
 # measurement loop calls it up to max_rounds times.
@@ -121,6 +124,51 @@ delta_gossip_round_jit = jax.jit(
     delta_gossip_round,
     static_argnames=("delta_semantics", "strict_reference_semantics"),
 )
+
+
+def _extract_round(state: AWSetDeltaState, perm: jnp.ndarray):
+    """Batched sender-side δ-extraction for one round's pairing: replica r
+    will absorb perm[r], so extract perm[r]'s payload against r's VV."""
+    src = jax.tree.map(lambda x: x[perm], state)
+    return jax.vmap(delta_extract)(src, state.vv)
+
+
+@jax.jit
+def pipelined_delta_gossip(state: AWSetDeltaState,
+                           perms: jnp.ndarray) -> AWSetDeltaState:
+    """PP-analogue δ gossip (SURVEY §2.3 PP row): the δ-extract →
+    δ-apply → VV-join pipeline is staged ACROSS rounds with a
+    double-buffered payload.
+
+    Round i's apply consumes the payload extracted during round i-1, and
+    round i+1's payload is extracted from the PRE-apply state — so inside
+    the compiled ``lax.scan`` body the extraction (and, on a sharded
+    replica axis, its collective-permute traffic) has no data dependence
+    on the in-flight apply and XLA overlaps the two stages.  The price is
+    one round of staleness: payloads are compressed against a receiver VV
+    that is one round old.  A stale receiver VV only ever ENLARGES the
+    payload (the receiver's clock is monotone), and δ-apply is idempotent
+    and mask-guarded, so the schedule stays convergent — it just ships
+    data learned in round i starting at round i+2 instead of i+1
+    (pipeline depth 2, exactly the double buffer).
+
+    v2 δ semantics (payload-only exchanges subsume the first-contact full
+    merge: extraction against a never-seen receiver VV ships every present
+    lane and live deletion record).  perms: uint32[n_rounds, R].
+    """
+    apply_round = jax.vmap(
+        lambda d, p: delta_apply(d, p, delta_semantics="v2"))
+    payload = _extract_round(state, perms[0])
+    n = perms.shape[0]
+
+    def body(carry, i):
+        s, p = carry
+        return (apply_round(s, p), _extract_round(s, perms[i + 1])), None
+
+    if n > 1:  # scan the first n-1 rounds; the last apply needs no staging
+        (state, payload), _ = jax.lax.scan(
+            body, (state, payload), jnp.arange(n - 1))
+    return apply_round(state, payload)
 
 
 def dissemination_offsets(num_replicas: int):
@@ -223,6 +271,53 @@ def _ring_step_compiled(mesh: Mesh, state_cls):
     return jax.jit(
         jax.shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs)
     )
+
+
+@functools.lru_cache(maxsize=None)
+def _ep_ring_step_compiled(mesh: Mesh, state_cls):
+    """Cached jitted EP ring step: vv's actor axis lives sharded over the
+    mesh element dim (SURVEY §2.3 EP row — per-actor ownership of VV
+    slots, awset.go:91)."""
+    n_r = mesh.shape[REPLICA_AXIS]
+    n_e = mesh.shape[ELEMENT_AXIS]
+    pairs = [(i, (i + 1) % n_r) for i in range(n_r)]
+    specs = partition_specs(state_cls, shard_actors=True)
+
+    def step(local):
+        # HasDot reads arbitrary actor slots, so the EP gather is one
+        # all_gather of the vv shards per round (the expert-parallel
+        # pattern: gather the sharded table, compute, re-slice).
+        vv_full = jax.lax.all_gather(
+            local.vv, ELEMENT_AXIS, axis=1, tiled=True)
+        full = local._replace(vv=vv_full)
+        recv = jax.tree.map(
+            lambda x: jax.lax.ppermute(x, REPLICA_AXIS, pairs), full)
+        merged, _ = merge_pairwise(full, recv)
+        a_shard = merged.vv.shape[1] // n_e
+        idx = jax.lax.axis_index(ELEMENT_AXIS)
+        vv_local = jax.lax.dynamic_slice_in_dim(
+            merged.vv, idx * a_shard, a_shard, axis=1)
+        return merged._replace(vv=vv_local)
+
+    return jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs)
+    )
+
+
+def ep_ring_round_shardmap(state: AWSetState, mesh: Mesh) -> AWSetState:
+    """One ring round under the EP layout (mesh.partition_specs with
+    shard_actors=True): version-vector slots are owned per actor shard,
+    all-gathered for the round's HasDot gathers, and the joined vv is
+    sliced back to this shard's slots.  Bitwise-identical results to
+    ring_round_shardmap — EP is a layout choice, never a semantics choice.
+
+    Wants A large relative to the element-dim shard count; the win is VV
+    memory (A can be as big as R in an every-replica-writes world, making
+    vv[R, A] the dominant array) spread over the mesh instead of
+    replicated per element shard.
+    """
+    mesh_mod.validate_ep_layout(state, mesh)
+    return _ep_ring_step_compiled(mesh, type(state))(state)
 
 
 def ring_round_shardmap(state: AWSetState, mesh: Mesh) -> AWSetState:

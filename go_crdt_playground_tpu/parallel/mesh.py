@@ -3,13 +3,19 @@
 The scaling axes (SURVEY §2.3) are replicas ``R`` (data-parallel: each Go
 ``AWSet`` struct was one replica) and the element universe ``E``
 (tensor-parallel: the merge is elementwise, so sharding E is clean).  The
-actor axis ``A`` stays replicated — it is small and every HasDot gather
-reads it.
+actor axis ``A`` is replicated by default — it is small and every HasDot
+gather reads it — but can be sharded over the mesh element dim for the
+EP analogue (SURVEY §2.3: per-actor ownership of VV slots, awset.go:91;
+``shard_actors=True``), in which case HasDot becomes a gather across the
+actor shard, realized as one ``all_gather`` per merge round
+(gossip.ep_ring_round_shardmap).
 
-Layout:
+Default layout:
   vv[R, A], processed[R, A]  -> P(REPLICA_AXIS, None)
   present/dots[R, E]         -> P(REPLICA_AXIS, ELEMENT_AXIS)
   actor[R]                   -> P(REPLICA_AXIS)
+EP layout (shard_actors=True) differs only in
+  vv[R, A], processed[R, A]  -> P(REPLICA_AXIS, ELEMENT_AXIS)
 
 Gossip permutations move whole replica rows between replica shards
 (XLA lowers them to collective-permute/all-to-all over ICI); element shards
@@ -52,29 +58,44 @@ _ACTOR_AXIS_FIELDS = frozenset({"vv", "processed"})
 _REPLICA_ONLY_FIELDS = frozenset({"actor"})
 
 
-def partition_specs(state_cls):
+def partition_specs(state_cls, shard_actors: bool = False):
     """PartitionSpec pytree for an AWSetState / AWSetDeltaState class —
     the single source of truth for the layout (state_sharding and the
-    shard_map rounds both build on it)."""
+    shard_map rounds both build on it).  ``shard_actors`` switches the
+    actor-axis fields to the EP layout (module docstring)."""
+    actor_spec = (P(REPLICA_AXIS, ELEMENT_AXIS) if shard_actors
+                  else P(REPLICA_AXIS, None))
     return state_cls(**{
         name: (
             P(REPLICA_AXIS) if name in _REPLICA_ONLY_FIELDS
-            else P(REPLICA_AXIS, None) if name in _ACTOR_AXIS_FIELDS
+            else actor_spec if name in _ACTOR_AXIS_FIELDS
             else P(REPLICA_AXIS, ELEMENT_AXIS)
         )
         for name in state_cls._fields
     })
 
 
-def state_sharding(state, mesh: Mesh):
+def validate_ep_layout(state, mesh: Mesh) -> None:
+    """EP layout precondition: the actor axis must divide evenly over the
+    mesh element dim (shard_map and NamedSharding both require it)."""
+    if state.vv.shape[-1] % mesh.shape[ELEMENT_AXIS]:
+        raise ValueError(
+            f"EP layout needs A={state.vv.shape[-1]} divisible by the mesh "
+            f"element dim {mesh.shape[ELEMENT_AXIS]}")
+
+
+def state_sharding(state, mesh: Mesh, shard_actors: bool = False):
     """NamedShardings for an AWSetState / AWSetDeltaState pytree."""
+    if shard_actors:
+        validate_ep_layout(state, mesh)
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        partition_specs(type(state)),
+        partition_specs(type(state), shard_actors),
         is_leaf=lambda x: isinstance(x, P),
     )
 
 
-def shard_state(state, mesh: Mesh):
+def shard_state(state, mesh: Mesh, shard_actors: bool = False):
     """Place a packed state onto the mesh with the canonical layout."""
-    return jax.tree.map(jax.device_put, state, state_sharding(state, mesh))
+    return jax.tree.map(jax.device_put, state,
+                        state_sharding(state, mesh, shard_actors))

@@ -1,0 +1,55 @@
+"""uint32 counter overflow guards (SURVEY §5.2, §7.5.5).
+
+The reference's clocks are Go ``uint`` — 64-bit (crdt-misc.go:9, 23) — so
+it can tick forever.  The packed tensors use uint32 (the north-star
+layout), which wraps after 2^32-1 ticks per actor; a wrapped counter
+silently corrupts causality because every decision is a ``>=`` compare on
+counters (HasDot, crdt-misc.go:33).  The integer lattice has no NaNs to
+trip on, so these guards are the framework's replacement for NaN checks:
+they make clock exhaustion loud before it becomes wrong answers.
+
+``overflow_risk`` is jit-safe (returns a device scalar) so long-running
+gossip loops can fold it into their per-round convergence fetch;
+``check_headroom`` is the host-side wrapper that raises.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+UINT32_MAX = 0xFFFF_FFFF
+
+# One ``Add(k...)`` ticks once per key (awset.go:91) and a δ-``Del`` once
+# per call (awset-delta_test.go:15-16): a margin of 2^20 ticks is
+# thousands of full-universe rewrites of warning space.
+DEFAULT_MARGIN = 1 << 20
+
+
+def counter_headroom(vv: jnp.ndarray) -> jnp.ndarray:
+    """Ticks left before the fastest clock wraps: UINT32_MAX - max(vv).
+
+    vv: uint32[..., A] (any leading batch axes).  Returns a uint32 scalar.
+    """
+    return jnp.uint32(UINT32_MAX) - jnp.max(vv)
+
+
+def overflow_risk(vv: jnp.ndarray,
+                  margin: int = DEFAULT_MARGIN) -> jnp.ndarray:
+    """Jit-safe bool scalar: True when any actor clock is within ``margin``
+    ticks of wrapping."""
+    return counter_headroom(vv) < jnp.uint32(margin)
+
+
+def check_headroom(state, margin: int = DEFAULT_MARGIN):
+    """Host-side guard: raise ``OverflowError`` when the state's clocks are
+    within ``margin`` ticks of uint32 wraparound; otherwise return the
+    state unchanged (chainable)."""
+    headroom = int(counter_headroom(state.vv))
+    if headroom < margin:
+        raise OverflowError(
+            f"uint32 clock exhaustion: only {headroom} ticks of headroom "
+            f"left (margin {margin}).  The packed representation caps each "
+            f"actor at {UINT32_MAX} events (the Go reference's 64-bit uint "
+            "does not); repack with a wider dtype or retire the actor id."
+        )
+    return state

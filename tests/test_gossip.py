@@ -131,6 +131,41 @@ def test_delta_gossip_sharded_equals_unsharded():
     _assert_states_equal(plain, sharded)
 
 
+def test_pipelined_delta_gossip_converges_to_same_fixed_point():
+    """The double-buffered PP schedule (one round of payload staleness)
+    must reach the same (membership, VV) fixed point as the unpipelined
+    δ gossip — staleness only delays shipment, never changes the join."""
+    import random
+    rng = random.Random(37)
+    R = 16
+    state = _random_state(rng, R=R, E=32, A=16, delta=True)
+    offsets = gossip.dissemination_offsets(R)
+    # pipeline depth 2 => cycle the dissemination schedule enough times
+    # to cover the lag (2x + slack)
+    perms = jnp.stack([gossip.ring_perm(R, o) for o in offsets] * 3)
+    piped = gossip.pipelined_delta_gossip(state, perms)
+    assert bool(collectives.converged(piped.present, piped.vv))
+    ref = gossip.all_pairs_converge(state, delta=True,
+                                    delta_semantics="v2")
+    assert bool(collectives.converged(ref.present, ref.vv))
+    assert np.array_equal(np.asarray(piped.present), np.asarray(ref.present))
+    assert np.array_equal(np.asarray(piped.vv), np.asarray(ref.vv))
+
+
+def test_pipelined_round_lag_is_exactly_one():
+    """Data added before round 0 reaches the ring neighbor at round 1
+    (payload for round 0 is extracted fresh), but data present only in
+    the staged buffer propagates with the documented one-round lag."""
+    R, E, A = 4, 8, 4
+    state = awset_delta.init(R, E, A)
+    state = awset_delta.add_element(state, np.uint32(0), np.uint32(3))
+    perms = jnp.stack([gossip.ring_perm(R, 1)])  # replica r absorbs r+1
+    one = gossip.pipelined_delta_gossip(state, perms)
+    # replica 3 absorbs replica 0's fresh payload in round 0
+    assert bool(one.present[3, 3])
+    assert not bool(one.present[2, 3])
+
+
 def test_ring_shardmap_matches_equivalent_gather_round():
     """The explicit ppermute ring (device i's block -> device i+1) is the
     gather round with offset -shard_size; both paths must agree bitwise."""
@@ -145,6 +180,37 @@ def test_ring_shardmap_matches_equivalent_gather_round():
     perm = (jnp.arange(R, dtype=jnp.uint32) - shard_size) % R
     expected = gossip.gossip_round_jit(state, perm)
     _assert_states_equal(ring, expected)
+
+
+def test_ep_ring_matches_replicated_actor_ring():
+    """EP layout (vv's actor axis sharded over the mesh element dim,
+    SURVEY §2.3 EP row) must be invisible in the results: the EP ring
+    round agrees bitwise with the replicated-actor ring round on the
+    same mesh, and with the equivalent gather round."""
+    import random
+    rng = random.Random(29)
+    R, A = 16, 16
+    state = _random_state(rng, R=R, E=32, A=A)
+    for shape in ((4, 2), (2, 4)):
+        m = mesh_mod.make_mesh(shape)
+        ep = gossip.ep_ring_round_shardmap(
+            mesh_mod.shard_state(state, m, shard_actors=True), m)
+        plain = gossip.ring_round_shardmap(
+            mesh_mod.shard_state(state, m), m)
+        _assert_states_equal(ep, plain, f"mesh {shape}")
+        shard_size = R // shape[0]
+        perm = (jnp.arange(R, dtype=jnp.uint32) - shard_size) % R
+        _assert_states_equal(ep, gossip.gossip_round_jit(state, perm),
+                             f"mesh {shape} vs gather")
+
+
+def test_ep_ring_rejects_indivisible_actor_axis():
+    state = awset.init(16, 32, 12, actors=np.arange(16) % 12)
+    m = mesh_mod.make_mesh((1, 8))   # A=12 not divisible by 8
+    with pytest.raises(ValueError):
+        gossip.ep_ring_round_shardmap(state, m)
+    with pytest.raises(ValueError):
+        mesh_mod.shard_state(state, m, shard_actors=True)
 
 
 def test_gossip_determinism():

@@ -1,0 +1,40 @@
+"""uint32 overflow guards (SURVEY §5.2): clock-exhaustion detection."""
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from go_crdt_playground_tpu.models import awset
+from go_crdt_playground_tpu.utils import guards
+
+
+def test_headroom_fresh_state():
+    state = awset.init(4, 8, 4)
+    assert int(guards.counter_headroom(state.vv)) == guards.UINT32_MAX
+    assert not bool(guards.overflow_risk(state.vv))
+    assert guards.check_headroom(state) is state
+
+
+def test_overflow_risk_trips_within_margin():
+    state = awset.init(4, 8, 4)
+    vv = state.vv.at[2, 1].set(guards.UINT32_MAX - 100)
+    assert bool(guards.overflow_risk(vv))
+    assert int(guards.counter_headroom(vv)) == 100
+    with pytest.raises(OverflowError):
+        guards.check_headroom(state._replace(vv=vv))
+
+
+def test_overflow_risk_is_jit_safe():
+    risky = jax.jit(guards.overflow_risk)
+    vv = jnp.zeros((3, 3), jnp.uint32)
+    assert not bool(risky(vv))
+    assert bool(risky(vv.at[0, 0].set(guards.UINT32_MAX)))
+
+
+def test_margin_boundary_exact():
+    vv = jnp.zeros((2, 2), jnp.uint32).at[0, 0].set(
+        guards.UINT32_MAX - guards.DEFAULT_MARGIN)
+    # headroom == margin: not yet at risk
+    assert not bool(guards.overflow_risk(vv))
+    assert bool(guards.overflow_risk(vv + jnp.uint32(1)))

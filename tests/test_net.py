@@ -9,6 +9,8 @@ equals the sequential spec exchange ``server.merge(client)`` then
 the client's payload.
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -223,6 +225,13 @@ def test_recorder_counts_exchanges():
         a.add(1)
         stats = a.sync_with(addr)
         ca = ra.snapshot()["counters"]
+        # sync_with returns when the client has the reply; the server's
+        # handler thread records its counters after its send returns —
+        # poll instead of racing it.
+        deadline = time.monotonic() + 5.0
+        while ("sync.exchanges" not in rb.snapshot()["counters"]
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
         cb = rb.snapshot()["counters"]
         assert ca["sync.exchanges"] == 1 and cb["sync.exchanges"] == 1
         assert ca["sync.bytes_sent"] == stats.bytes_sent
