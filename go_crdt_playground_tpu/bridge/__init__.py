@@ -1,0 +1,14 @@
+"""Merger bridge: the framework's merge kernels as a service.
+
+The reference's only harness is in-process ``go test`` (README.md:1);
+this package is the attach point it would use from outside — proto
+schema in ``merger.proto``, always-available TCP transport and optional
+gRPC serving in ``service``.
+"""
+
+from go_crdt_playground_tpu.bridge.service import (  # noqa: F401
+    MergerClient,
+    MergerServer,
+    execute_merge,
+    serve_grpc,
+)

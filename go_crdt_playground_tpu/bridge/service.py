@@ -1,0 +1,296 @@
+"""Merger bridge service: drive the packed merge kernels from outside.
+
+SURVEY §7.3 step 1 keeps a Merger service so an external harness — the
+natural endpoint is a Go port of the reference's own tests, which in the
+reference call ``dst.Merge(src)`` directly (awset_test.go:16-17) — can
+submit two replica states and get back this framework's merged result
+plus the conformance oracles (SortedValues, canonical String).
+
+Execution path is the REAL product path, not the spec model: proto ->
+spec -> pack (utils/codec) -> packed kernel (ops/merge or ops/delta) ->
+unpack -> proto.  The spec model is only used as the host-side
+(de)serialization vehicle.
+
+Transport (Go-friendly, zero dependencies beyond the stdlib):
+
+    frame   = method(1 byte) | length(uint32 big-endian) | body
+    request  body = crdtbridge.MergeRequest   (method 0x01)
+    response body = crdtbridge.MergeResponse  (same method byte echoed)
+    ping          = method 0x02, empty body, echoed empty
+
+One TCP connection carries any number of frames.  When grpcio is
+installed the same messages are served as proper gRPC instead
+(``serve_grpc``); the proto file carries the service definition either
+way.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Optional, Tuple
+
+from go_crdt_playground_tpu.bridge import convert
+from go_crdt_playground_tpu.bridge import merger_pb2 as pb
+
+METHOD_MERGE = 0x01
+METHOD_PING = 0x02
+
+_MAX_BODY = 64 << 20
+
+
+# ---------------------------------------------------------------------------
+# Framing
+# ---------------------------------------------------------------------------
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, method: int, body: bytes) -> None:
+    if len(body) > _MAX_BODY:
+        raise ValueError(f"frame body {len(body)} exceeds {_MAX_BODY}")
+    sock.sendall(struct.pack(">BI", method, len(body)) + body)
+
+
+def recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
+    method, length = struct.unpack(">BI", _recv_exact(sock, 5))
+    if length > _MAX_BODY:
+        raise ValueError(f"frame body {length} exceeds {_MAX_BODY}")
+    return method, _recv_exact(sock, length)
+
+
+# ---------------------------------------------------------------------------
+# Merge execution on the packed kernels
+# ---------------------------------------------------------------------------
+
+
+def _dimensions(*replicas) -> Tuple[int, int]:
+    """(E, A) for packing a request's replica pair: E covers every key,
+    A covers every VV slot and dot actor (zero-padding beyond is exact,
+    crdt-misc.go:29-41)."""
+    keys = set()
+    num_actors = 1
+    for rep in replicas:
+        keys.update(rep.entries)
+        num_actors = max(num_actors, len(rep.version_vector), rep.actor + 1)
+        for d in rep.entries.values():
+            num_actors = max(num_actors, d.actor + 1)
+        deleted = getattr(rep, "deleted", None)
+        if deleted:
+            keys.update(deleted)
+            for d in deleted.values():
+                num_actors = max(num_actors, d.actor + 1)
+        processed = getattr(rep, "processed", None)
+        if processed:
+            num_actors = max(num_actors, max(processed) + 1)
+    return max(1, len(keys)), num_actors
+
+
+def execute_merge(req: pb.MergeRequest) -> pb.MergeResponse:
+    """Run one MergeRequest through the packed kernels."""
+    from go_crdt_playground_tpu.models import awset as awset_mod
+    from go_crdt_playground_tpu.models import awset_delta as delta_mod
+    from go_crdt_playground_tpu.ops import delta as delta_ops
+    from go_crdt_playground_tpu.ops import merge as merge_ops
+    from go_crdt_playground_tpu.utils import codec
+
+    try:
+        convert.check_uint32(req.dst, "dst")
+        convert.check_uint32(req.src, "src")
+        semantics = req.delta_semantics or "reference"
+        dst = convert.replica_from_proto(
+            req.dst, req.delta, semantics, req.strict_reference_semantics)
+        src = convert.replica_from_proto(
+            req.src, req.delta, semantics, req.strict_reference_semantics)
+        E, A = _dimensions(dst, src)
+        dictionary = codec.ElementDict(capacity=E)
+        if req.delta:
+            arrays = codec.pack_awset_deltas([dst, src], dictionary, A)
+            state = delta_mod.from_arrays(arrays)
+            merged_state = delta_ops.delta_merge_one_into(
+                state, 0, state, 1, semantics,
+                req.strict_reference_semantics)
+            merged = codec.unpack_awset_deltas(
+                delta_mod.to_arrays(merged_state), dictionary, semantics)[0]
+        else:
+            arrays = codec.pack_awsets([dst, src], dictionary, A)
+            state = awset_mod.from_arrays(arrays)
+            merged_state, _ = merge_ops.merge_one_into(state, 0, state, 1)
+            merged = codec.unpack_awsets(
+                awset_mod.to_arrays(merged_state), dictionary)[0]
+    except (OverflowError, ValueError) as exc:
+        return pb.MergeResponse(error=str(exc))
+    return pb.MergeResponse(
+        merged=convert.replica_to_proto(merged),
+        sorted_values=merged.sorted_values(),
+        canonical=str(merged),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Plain-TCP server / client
+# ---------------------------------------------------------------------------
+
+
+class MergerServer:
+    """Serve the Merger service over the Go-friendly TCP framing."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._sock: Optional[socket.socket] = None
+        self._closing = threading.Event()
+
+    def serve(self) -> Tuple[str, int]:
+        """Bind + start accepting on a daemon thread; returns (host, port)."""
+        self._sock = socket.create_server((self.host, self.port))
+        self.port = self._sock.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        return self.host, self.port
+
+    def _accept_loop(self) -> None:
+        assert self._sock is not None
+        while not self._closing.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # closed
+            # daemonic and unretained: connection threads die with their
+            # socket, so a long-lived server doesn't accumulate objects
+            threading.Thread(
+                target=self._handle_conn, args=(conn,), daemon=True).start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while True:
+                try:
+                    method, body = recv_frame(conn)
+                except (ConnectionError, OSError):
+                    return
+                if method == METHOD_PING:
+                    send_frame(conn, METHOD_PING, b"")
+                elif method == METHOD_MERGE:
+                    req = pb.MergeRequest()
+                    try:
+                        req.ParseFromString(body)
+                        resp = execute_merge(req)
+                    except Exception as exc:  # malformed proto, kernel error
+                        resp = pb.MergeResponse(error=repr(exc))
+                    send_frame(conn, METHOD_MERGE, resp.SerializeToString())
+                else:
+                    resp = pb.MergeResponse(error=f"unknown method {method}")
+                    send_frame(conn, method, resp.SerializeToString())
+
+    def close(self) -> None:
+        self._closing.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "MergerServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class MergerClient:
+    """Python-side client for the TCP transport (tests and tooling; a Go
+    harness implements the same five-byte header + proto body)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+
+    def ping(self) -> bool:
+        send_frame(self._sock, METHOD_PING, b"")
+        method, body = recv_frame(self._sock)
+        return method == METHOD_PING and body == b""
+
+    def merge_raw(self, req: pb.MergeRequest) -> pb.MergeResponse:
+        send_frame(self._sock, METHOD_MERGE, req.SerializeToString())
+        method, body = recv_frame(self._sock)
+        resp = pb.MergeResponse()
+        resp.ParseFromString(body)
+        return resp
+
+    def merge(self, dst, src, delta: bool = False,
+              delta_semantics: str = "reference",
+              strict_reference_semantics: bool = True):
+        """Spec-model convenience: ship two spec replicas, return the
+        merged spec replica (raises on service-reported errors)."""
+        req = pb.MergeRequest(
+            dst=convert.replica_to_proto(dst),
+            src=convert.replica_to_proto(src),
+            delta=delta,
+            delta_semantics=delta_semantics,
+            strict_reference_semantics=strict_reference_semantics,
+        )
+        resp = self.merge_raw(req)
+        if resp.error:
+            raise RuntimeError(f"merge service error: {resp.error}")
+        return convert.replica_from_proto(
+            resp.merged, delta, delta_semantics, strict_reference_semantics)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "MergerClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# gRPC adapter (optional — grpcio is not in the base image)
+# ---------------------------------------------------------------------------
+
+
+def serve_grpc(host: str = "127.0.0.1", port: int = 0):
+    """Serve the same Merger service as real gRPC when grpcio exists.
+
+    Returns (server, port).  Raises ImportError with guidance otherwise —
+    the TCP transport above is the always-available path.
+    """
+    try:
+        import grpc  # noqa: F401
+    except ImportError as exc:
+        raise ImportError(
+            "grpcio is not installed in this environment; use MergerServer "
+            "(plain-TCP transport, same proto messages) or install grpcio "
+            "to serve bridge/merger.proto as gRPC"
+        ) from exc
+    from concurrent import futures
+
+    class _Servicer:
+        def Merge(self, request, context):  # noqa: N802 (gRPC naming)
+            return execute_merge(request)
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    # Generic handler keeps us independent of grpc codegen (only protoc's
+    # message codegen is vendored).
+    rpc = grpc.unary_unary_rpc_method_handler(
+        lambda req, ctx: _Servicer().Merge(req, ctx),
+        request_deserializer=pb.MergeRequest.FromString,
+        response_serializer=pb.MergeResponse.SerializeToString,
+    )
+    service = grpc.method_handlers_generic_handler(
+        "crdtbridge.Merger", {"Merge": rpc})
+    server.add_generic_rpc_handlers((service,))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    server.start()
+    return server, bound

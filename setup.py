@@ -39,7 +39,10 @@ setup(
     version="0.1.0",
     description="TPU-native CRDT framework (JAX/XLA/Pallas)",
     packages=find_packages(include=["go_crdt_playground_tpu*"]),
-    package_data={"go_crdt_playground_tpu.native": ["codec.cpp"]},
+    package_data={
+        "go_crdt_playground_tpu.native": ["codec.cpp"],
+        "go_crdt_playground_tpu.bridge": ["merger.proto"],
+    },
     python_requires=">=3.10",
     cmdclass={"build_ext": BuildNativeCodec},
 )

@@ -1,0 +1,137 @@
+"""Merger bridge service tests: the packed kernels driven through the
+proto schema over the TCP transport, compared against the spec model —
+the shape a Go conformance harness would take (SURVEY §7.3 step 1)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from go_crdt_playground_tpu.bridge import (MergerClient, MergerServer,
+                                           convert, serve_grpc)
+from go_crdt_playground_tpu.bridge import merger_pb2 as pb
+from go_crdt_playground_tpu.models.spec import (AWSet, AWSetDelta, Dot,
+                                                VersionVector)
+from go_crdt_playground_tpu.utils.guards import UINT32_MAX
+
+
+def _writer_pair(delta=False, **kw):
+    cls = AWSetDelta if delta else AWSet
+    a = cls(actor=0, version_vector=VersionVector([0, 0]), **kw)
+    b = cls(actor=1, version_vector=VersionVector([0, 0]), **kw)
+    return a, b
+
+
+def test_proto_roundtrip_preserves_state():
+    a, _ = _writer_pair(delta=True, delta_semantics="v2")
+    a.add("Anne", "Bob")
+    a.del_("Bob")
+    msg = convert.replica_to_proto(a)
+    back = convert.replica_from_proto(msg, delta=True, delta_semantics="v2")
+    assert back.entries == a.entries
+    assert back.deleted == a.deleted
+    assert back.processed == a.processed
+    assert list(back.version_vector.v) == list(a.version_vector.v)
+    assert str(back) == str(a)
+
+
+def test_tcp_merge_matches_spec_full_state():
+    """The add-wins scenario (awset_test.go:85-122) through the service."""
+    a, b = _writer_pair()
+    a.add("Anne", "Bob")
+    b.merge(a)          # local pre-merge: delete will be OBSERVED
+    a.del_("Bob")
+    with MergerServer() as srv:
+        host, port = srv.serve()
+        with MergerClient(host, port) as cli:
+            assert cli.ping()
+            merged = cli.merge(b, a)
+    expected = b.clone()
+    expected.merge(a)
+    assert merged.sorted_values() == expected.sorted_values()
+    assert str(merged) == str(expected)
+
+
+def test_tcp_merge_randomized_conformance():
+    rng = random.Random(41)
+    with MergerServer() as srv:
+        host, port = srv.serve()
+        with MergerClient(host, port) as cli:
+            for trial in range(10):
+                a, b = _writer_pair()
+                for _ in range(12):
+                    rep = a if rng.random() < 0.5 else b
+                    if rng.random() < 0.7:
+                        rep.add(f"k{rng.randrange(8)}")
+                    elif rep.entries:
+                        rep.del_(rng.choice(sorted(rep.entries)))
+                merged = cli.merge(a, b)
+                expected = a.clone()
+                expected.merge(b)
+                assert str(merged) == str(expected), trial
+
+
+def test_tcp_delta_merge_dispatch_and_quirk():
+    """δ dispatch through the service, incl. the strict empty-δ VV quirk."""
+    for strict in (True, False):
+        a, b = _writer_pair(delta=True)
+        a.strict_reference_semantics = strict
+        b.strict_reference_semantics = strict
+        a.add("x")
+        b.merge(a)         # first contact: full branch
+        a.del_("x")
+        b.merge(a)         # δ branch ships the deletion
+        with MergerServer() as srv:
+            host, port = srv.serve()
+            with MergerClient(host, port) as cli:
+                merged = cli.merge(
+                    b, a, delta=True,
+                    strict_reference_semantics=strict)
+        expected = b.clone()
+        expected.merge(a)
+        assert merged.sorted_values() == expected.sorted_values()
+        assert list(merged.version_vector.v) == list(
+            expected.version_vector.v), f"strict={strict}"
+
+
+def test_service_rejects_uint64_overflow():
+    a, b = _writer_pair()
+    a.add("k")
+    req = pb.MergeRequest(
+        dst=convert.replica_to_proto(a),
+        src=convert.replica_to_proto(b),
+    )
+    req.src.version_vector.append(UINT32_MAX + 1)
+    with MergerServer() as srv:
+        host, port = srv.serve()
+        with MergerClient(host, port) as cli:
+            resp = cli.merge_raw(req)
+    assert "uint32" in resp.error
+
+
+def test_grpc_adapter_gated():
+    try:
+        import grpc  # noqa: F401
+        has_grpc = True
+    except ImportError:
+        has_grpc = False
+    if has_grpc:
+        server, port = serve_grpc()
+        server.stop(0)
+        assert port > 0
+    else:
+        with pytest.raises(ImportError):
+            serve_grpc()
+
+
+def test_unknown_method_reports_error():
+    from go_crdt_playground_tpu.bridge import service as svc
+    import socket
+    with MergerServer() as srv:
+        host, port = srv.serve()
+        with socket.create_connection((host, port)) as sock:
+            svc.send_frame(sock, 0x7F, b"")
+            method, body = svc.recv_frame(sock)
+    resp = pb.MergeResponse()
+    resp.ParseFromString(body)
+    assert method == 0x7F and "unknown method" in resp.error
