@@ -171,6 +171,98 @@ def pipelined_delta_gossip(state: AWSetDeltaState,
     return apply_round(state, payload)
 
 
+@functools.partial(jax.jit, static_argnames=("k_changed", "k_deleted"))
+def compact_delta_gossip_round(
+    state: AWSetDeltaState,
+    perm: jnp.ndarray,
+    k_changed: int = 64,
+    k_deleted: int = 64,
+) -> AWSetDeltaState:
+    """One δ round through the fixed-K compact payload form
+    (ops/compact.py): extract -> compact to K index/value lanes ->
+    expand -> apply (v2 semantics).
+
+    This is the steady-state gossip path — the analogue of the
+    reference's δ branch after first contact (awset-delta_test.go:57-62).
+    When a pair's payload exceeds K, that exchange degrades to a safe
+    partial one (entries up to capacity, NO clock advance — see
+    ops/compact.py's correctness note), exactly like a lossy network
+    round; schedules should bootstrap bulk divergence with dense rounds
+    (delta_gossip_round / gossip_round, the full-merge analogue of
+    awset-delta_test.go:53-56) and use compact rounds once payloads fit.
+    """
+    from go_crdt_playground_tpu.ops import compact as compact_ops
+
+    E = state.present.shape[-1]
+    src = jax.tree.map(lambda x: x[perm], state)
+    payload = jax.vmap(delta_extract)(src, state.vv)
+    comp = compact_ops.compact_payload_batch(payload, k_changed, k_deleted)
+    dense = compact_ops.expand_payload_batch(comp, E)
+    return jax.vmap(
+        lambda d, p: delta_apply(d, p, delta_semantics="v2"))(state, dense)
+
+
+@functools.lru_cache(maxsize=None)
+def _compact_ring_step_compiled(mesh: Mesh, k_changed: int, k_deleted: int):
+    """Cached jitted compact-payload ring: the only arrays that cross
+    devices are the receiver VV advertisement (backward) and the fixed-K
+    payload (forward) — O(K) ICI bytes per replica instead of O(E)."""
+    from go_crdt_playground_tpu.ops import compact as compact_ops
+
+    n = mesh.shape[REPLICA_AXIS]
+    fwd = [(i, (i + 1) % n) for i in range(n)]       # sender -> receiver
+    bwd = [(i, (i - 1) % n) for i in range(n)]       # receiver VV -> sender
+    # The element mesh dim is pinned to 1 (caller-checked), so the EP
+    # spec — actor axes formally sharded over it — is the same layout
+    # while letting shard_map's replication inference accept vv/processed
+    # outputs that mix element-tagged values (the payload path) in.
+    specs = partition_specs(AWSetDeltaState, shard_actors=True)
+
+    def step(local):
+        E = local.present.shape[-1]
+        # 1. receiver advertises its VV to its ring sender
+        #    (the wire protocol of awset-delta_test.go:59: δ-extraction
+        #    is compressed against the receiver's clock)
+        recv_vv = jax.lax.ppermute(local.vv, REPLICA_AXIS, bwd)
+        # 2. sender-side extract + compact against the advertised VV
+        payload = jax.vmap(delta_extract)(local, recv_vv)
+        comp = compact_ops.compact_payload_batch(
+            payload, k_changed, k_deleted)
+        # 3. only the compact payload crosses the ring
+        shipped = jax.tree.map(
+            lambda x: jax.lax.ppermute(x, REPLICA_AXIS, fwd), comp)
+        # 4. receiver-side expand + apply
+        dense = compact_ops.expand_payload_batch(shipped, E)
+        return jax.vmap(
+            lambda d, p: delta_apply(d, p, delta_semantics="v2"))(
+                local, dense)
+
+    return jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs)
+    )
+
+
+def compact_ring_round_shardmap(
+    state: AWSetDeltaState,
+    mesh: Mesh,
+    k_changed: int = 64,
+    k_deleted: int = 64,
+) -> AWSetDeltaState:
+    """One compact-payload ring round with the communication pinned to
+    ICI neighbors: device i's replica block syncs into device i+1's,
+    shipping only the fixed-K payload lanes (plus the receiver's VV
+    advertisement going the other way).  Equivalent to
+    ``compact_delta_gossip_round`` with the block-shift permutation;
+    requires the element axis unsharded (compaction scans E locally).
+    """
+    if mesh.shape[ELEMENT_AXIS] != 1:
+        raise ValueError(
+            "compact ring needs the element axis unsharded "
+            f"(mesh element dim {mesh.shape[ELEMENT_AXIS]}): lane "
+            "compaction is a scan over the full element axis")
+    return _compact_ring_step_compiled(mesh, k_changed, k_deleted)(state)
+
+
 def dissemination_offsets(num_replicas: int):
     """Doubling offsets 1, 2, 4, ... — ceil(log2 R) rounds to full
     convergence on any replica count."""
