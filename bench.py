@@ -1,8 +1,15 @@
 """Benchmark: replica-pair merges/sec/chip (AWSet, 256 elems).
 
-BASELINE.md config 3 — 10K replicas x 256 elements, vmapped dot-context
-merge — measured as sustained anti-entropy gossip throughput on the
-default platform (the real TPU chip under the driver).
+Default mode (the driver contract) measures BASELINE.md config 3 — 10K
+replicas x 256 elements, vmapped dot-context merge — as sustained
+anti-entropy gossip throughput on the default platform (the real TPU
+chip under the driver), and prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "merges/sec/chip", "vs_baseline": N}
+
+``python bench.py --ladder`` measures every config of the BASELINE.md
+measurement ladder (1: conformance-anchor spec rate, 2: GCounter 1K,
+3: AWSet 10K x 256, 4: delta-AWSet 100K gossip, 5: mixed AWSet+2P-Set
+1M), prints one JSON line per config, and writes BENCH_LADDER.json.
 
 The reference publishes no numbers (SURVEY §6: no Benchmark* functions,
 README is one line), and no Go toolchain exists in this environment, so
@@ -10,9 +17,6 @@ README is one line), and no Go toolchain exists in this environment, so
 (models/spec.py) running the SAME pair merge on the same element count —
 the go-test-equivalent semantics executed in-process, our only executable
 stand-in for the reference implementation.
-
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "merges/sec/chip", "vs_baseline": N}
 """
 
 from __future__ import annotations
@@ -51,16 +55,11 @@ def build_state(num_replicas: int, num_elements: int, num_writers: int):
     )
 
 
-def measure_tpu(num_replicas=10_000, num_elements=256, num_writers=256,
-                n_small=16, n_big=272, repeats=3):
-    """True sustained device rate: rounds are fused into one compiled
-    program with ``lax.scan`` (one dispatch, scalar fetch to sync), and
-    the fixed dispatch/transfer overhead — ~60ms through the remote-TPU
-    tunnel, which would otherwise dominate — is cancelled by a two-point
-    linear fit over the round count."""
-    import functools
-
-    import jax
+def measure_tpu(num_replicas=10_000, num_elements=256, num_writers=256):
+    """True sustained device rate for the headline config: rounds fused
+    with ``lax.scan`` and timed by the adaptive two-point fit
+    (_scan_round_rate), which cancels the fixed dispatch/transfer
+    overhead (~60ms through the remote-TPU tunnel)."""
     import jax.numpy as jnp
 
     from go_crdt_playground_tpu.parallel import gossip
@@ -68,25 +67,8 @@ def measure_tpu(num_replicas=10_000, num_elements=256, num_writers=256,
     state = build_state(num_replicas, num_elements, num_writers)
     offsets = gossip.dissemination_offsets(num_replicas)
     perms = jnp.stack([gossip.ring_perm(num_replicas, o) for o in offsets])
-
-    @functools.partial(jax.jit, static_argnames=("n",))
-    def run(state, n):
-        def body(s, i):
-            return gossip.gossip_round(s, perms[i]), None
-        s, _ = jax.lax.scan(
-            body, state, jnp.arange(n) % perms.shape[0])
-        return s.vv.sum()  # scalar depends on every round; fetch = sync
-
-    def timed(n):
-        float(run(state, n))  # compile + warm
-        best = float("inf")
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            float(run(state, n))
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    per_round = (timed(n_big) - timed(n_small)) / (n_big - n_small)
+    per_round = _scan_round_rate(gossip.gossip_round, state, perms,
+                                 start=64)
     return num_replicas / per_round
 
 
@@ -111,7 +93,218 @@ def measure_spec_baseline(num_elements=256, merges=60):
     return n / dt
 
 
+def _scan_round_rate(round_fn, state, aux, start=16, max_n=1 << 17,
+                     min_delta=0.25, repeats=3):
+    """Sustained per-round seconds for ``state <- round_fn(state, aux[i])``
+    rounds fused with lax.scan, overhead-cancelled by a two-point fit.
+
+    The round count adapts: it doubles until the (2n - n) timing delta
+    clears ``min_delta`` seconds, so the fit cannot drown in the fixed
+    dispatch/transfer overhead (~60ms through the remote-TPU tunnel) the
+    way a fixed pair of counts can for very cheap or very expensive
+    rounds."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def run(state, n):
+        def body(s, i):
+            return round_fn(s, jax.tree.map(lambda x: x[i], aux)), None
+        s, _ = jax.lax.scan(
+            body, state, jnp.arange(n) % jax.tree.leaves(aux)[0].shape[0])
+        return jax.tree.leaves(s)[0].sum()
+
+    memo = {}
+
+    def timed(n):
+        if n not in memo:  # each doubling reuses the previous full count
+            float(run(state, n))
+            best = float("inf")
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                float(run(state, n))
+                best = min(best, time.perf_counter() - t0)
+            memo[n] = best
+        return memo[n]
+
+    n = max(2, start)
+    while True:
+        delta = timed(n) - timed(n // 2)
+        if delta >= min_delta or n >= max_n:
+            if delta <= 0:
+                raise RuntimeError(
+                    f"timing fit degenerate at n={n} (delta {delta:.4f}s)")
+            return delta / (n - n // 2)
+        n *= 2
+
+
+def measure_config1(num_ops=120, seed=11):
+    """Correctness anchor: randomized 3-replica scenario replayed against
+    BOTH the executable spec and the packed kernel with byte-equal
+    canonical renderings, plus the spec's single-core merge rate at the
+    config's element count (E=16)."""
+    import random
+
+    import jax
+
+    from go_crdt_playground_tpu.models import awset
+    from go_crdt_playground_tpu.models.spec import AWSet, VersionVector
+    from go_crdt_playground_tpu.ops.merge import merge_one_into
+    from go_crdt_playground_tpu.utils import codec
+
+    rng = random.Random(seed)
+    R, E, A = 3, 16, 3
+    spec = [AWSet(actor=r, version_vector=VersionVector([0] * A))
+            for r in range(R)]
+    dictionary = codec.ElementDict(capacity=E,
+                                   values=[f"e{i}" for i in range(E)])
+    packed = awset.from_arrays(codec.pack_awsets(spec, dictionary, A))
+    for _ in range(num_ops):
+        r = rng.randrange(R)
+        op = rng.random()
+        if op < 0.55:
+            k = f"e{rng.randrange(E)}"
+            spec[r].add(k)
+            packed = awset.add_element(
+                packed, np.uint32(r), np.uint32(dictionary.encode(k)))
+        elif op < 0.75 and spec[r].entries:
+            k = rng.choice(sorted(spec[r].entries))
+            spec[r].del_(k)
+            packed = awset.del_element(
+                packed, np.uint32(r), np.uint32(dictionary.encode(k)))
+        else:
+            src = rng.randrange(R)
+            if src != r:
+                spec[r].merge(spec[src])
+                packed, _ = merge_one_into(packed, r, packed, src)
+    jax.block_until_ready(packed.vv)
+    rendered = codec.render_packed(awset.to_arrays(packed), dictionary)
+    conformant = rendered == [str(s) for s in spec]
+    return {
+        "metric": "config1: AWSet 3x16 conformance anchor "
+                  "(spec merges/sec, 1 CPU core)",
+        "value": round(measure_spec_baseline(num_elements=16), 1),
+        "unit": "merges/sec",
+        "conformant": conformant,
+    }
+
+
+def measure_config2(num_replicas=1000, num_actors=256):
+    """GCounter 1K replicas — batched elementwise-max join gossip."""
+    import jax.numpy as jnp
+
+    from go_crdt_playground_tpu.ops import lattices
+    from go_crdt_playground_tpu.parallel import gossip
+
+    counts = np.random.default_rng(0).integers(
+        0, 1 << 20, (num_replicas, num_actors)).astype(np.uint32)
+    state = lattices.GCounterState(
+        counts=jnp.asarray(counts),
+        actor=jnp.arange(num_replicas, dtype=jnp.uint32) % num_actors)
+    offsets = gossip.dissemination_offsets(num_replicas)
+    perms = jnp.stack([gossip.ring_perm(num_replicas, o) for o in offsets])
+    per_round = _scan_round_rate(
+        lambda s, perm: lattices.gossip_round(lattices.gcounter_join, s,
+                                              perm),
+        state, perms, start=256)
+    return {
+        "metric": "config2: GCounter 1K replicas, elementwise-max join",
+        "value": round(num_replicas / per_round, 1),
+        "unit": "merges/sec/chip",
+    }
+
+
+def measure_config4(num_replicas=100_000, num_elements=256,
+                    num_writers=256):
+    """delta-AWSet 100K replicas: payload-compressed gossip rounds (the
+    single-chip rate of the program that runs on a v5e-4 mesh via
+    parallel/mesh.py; the driver environment has one chip)."""
+    import jax.numpy as jnp
+
+    from go_crdt_playground_tpu.models import awset_delta
+    from go_crdt_playground_tpu.parallel import gossip
+
+    base = build_state(num_replicas, num_elements, num_writers)
+    zE = jnp.zeros((num_replicas, num_elements), jnp.uint32)
+    state = awset_delta.AWSetDeltaState(
+        vv=base.vv, present=base.present, dot_actor=base.dot_actor,
+        dot_counter=base.dot_counter, actor=base.actor,
+        deleted=jnp.zeros((num_replicas, num_elements), bool),
+        del_dot_actor=zE, del_dot_counter=zE, processed=base.vv)
+    offsets = gossip.dissemination_offsets(num_replicas)
+    perms = jnp.stack([gossip.ring_perm(num_replicas, o) for o in offsets])
+    per_round = _scan_round_rate(
+        lambda s, perm: gossip.delta_gossip_round(s, perm,
+                                                  delta_semantics="v2"),
+        state, perms, start=8, max_n=256)
+    return {
+        "metric": "config4: delta-AWSet 100K replicas, v2 delta gossip",
+        "value": round(num_replicas / per_round, 1),
+        "unit": "delta-merges/sec/chip",
+    }
+
+
+def measure_config5(num_replicas=1_000_000, num_elements=256,
+                    num_writers=256):
+    """Mixed AWSet + 2P-Set at 1M replicas: one anti-entropy round of
+    each family per step (the all-families lattice-join workload)."""
+    import jax.numpy as jnp
+
+    from go_crdt_playground_tpu.ops import lattices
+    from go_crdt_playground_tpu.parallel import gossip
+
+    aw = build_state(num_replicas, num_elements, num_writers)
+    rng = np.random.default_rng(1)
+    tp = lattices.TwoPSetState(
+        added=jnp.asarray(rng.random((num_replicas, num_elements)) < 0.3),
+        removed=jnp.asarray(
+            rng.random((num_replicas, num_elements)) < 0.05))
+    offsets = gossip.dissemination_offsets(num_replicas)
+    perms = jnp.stack([gossip.ring_perm(num_replicas, o)
+                       for o in offsets[:8]])
+
+    def both(state, perm):
+        a, t = state
+        return (gossip.gossip_round(a, perm),
+                lattices.gossip_round(lattices.twopset_join, t, perm))
+
+    per_round = _scan_round_rate(both, (aw, tp), perms, start=4,
+                                 max_n=64, repeats=2)
+    return {
+        "metric": "config5: mixed AWSet + 2P-Set 1M replicas, "
+                  "fused lattice-join round",
+        "value": round(2 * num_replicas / per_round, 1),
+        "unit": "merges/sec/chip",
+    }
+
+
+def run_ladder():
+    spec_rate = measure_spec_baseline()
+    results = [measure_config1(), measure_config2()]
+    tpu_rate = measure_tpu()
+    results.append({
+        "metric": "config3: AWSet 10K x 256 vmapped dot-context merge",
+        "value": round(tpu_rate, 1),
+        "unit": "merges/sec/chip",
+        "vs_baseline": round(tpu_rate / spec_rate, 1),
+    })
+    results.append(measure_config4())
+    results.append(measure_config5())
+    for r in results:
+        print(json.dumps(r))
+    with open("BENCH_LADDER.json", "w") as f:
+        json.dump(results, f, indent=2)
+    return results
+
+
 def main():
+    import sys
+
+    if "--ladder" in sys.argv:
+        run_ladder()
+        return
     tpu_rate = measure_tpu()
     spec_rate = measure_spec_baseline()
     print(json.dumps({

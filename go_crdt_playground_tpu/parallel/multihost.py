@@ -1,0 +1,81 @@
+"""Multi-host deployment: the same SPMD gossip program over a
+DCN-spanning mesh (SURVEY §5.8).
+
+The reference has no distribution at all — replicas are structs in one
+process and "exchange" is a method call (awset_test.go:16-17).  The
+TPU-native scaling story is one program, three regimes:
+
+  1. single chip      — jit, no mesh (bench.py).
+  2. single host pod  — ``mesh.make_mesh`` over the local devices;
+                        gossip permutations lower to collective-permute
+                        over ICI.
+  3. multi-host       — initialize JAX's distributed runtime, then build
+                        the SAME mesh over ``jax.devices()`` (now global):
+                        XLA routes the replica-axis collectives over ICI
+                        within a host/pod slice and DCN across slices.
+
+The mesh axis ORDER is the placement policy: the replica axis is
+outermost, so contiguous replica blocks live on one host and ring/
+dissemination offsets smaller than a host's block stay entirely on ICI;
+only the block-crossing residue rides DCN.  ``dissemination_offsets``
+ordering therefore starts with the smallest offsets (ICI-local) and
+touches DCN only in the last log2(hosts) rounds.
+
+Nothing here can be exercised in a 1-process CI; the functions are thin,
+deliberately side-effect-explicit wrappers kept separate from mesh.py so
+the testable single-process surface stays import-clean.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+
+from go_crdt_playground_tpu.parallel import mesh as mesh_mod
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bring up JAX's distributed runtime (one call per host process,
+    before any other JAX API).  Arguments default to the standard
+    environment autodetection (JAX_COORDINATOR_ADDRESS etc.)."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(element_shards: int = 1):
+    """A DCN-spanning (replica, element) mesh over every device of every
+    participating process.  Call after ``initialize()``; identical
+    programs (same jit-compiled gossip rounds) then run unchanged —
+    sharding constraints place replica blocks host-contiguously so small
+    gossip offsets ride ICI."""
+    devices = jax.devices()
+    if len(devices) % element_shards:
+        raise ValueError(
+            f"{len(devices)} devices not divisible by "
+            f"element_shards={element_shards}")
+    return mesh_mod.make_mesh(
+        (len(devices) // element_shards, element_shards), devices=devices)
+
+
+def process_replica_block(num_replicas: int) -> Tuple[int, int]:
+    """[start, stop) of the replica rows whose shards live on THIS
+    process under the canonical layout — the slice a host-local ingest
+    pipeline (e.g. net.Node feeding adds into the fleet) should write.
+
+    Requires even division (the mesh's replica axis does too); raises
+    instead of reporting a placement the sharding cannot realize."""
+    n = jax.process_count()
+    if num_replicas % n:
+        raise ValueError(
+            f"num_replicas={num_replicas} not divisible by "
+            f"process_count={n}; pad the replica axis (observer rows are "
+            "free: they never tick a clock)")
+    per = num_replicas // n
+    start = jax.process_index() * per
+    return start, start + per

@@ -1,0 +1,12 @@
+"""Demo CLI (python -m go_crdt_playground_tpu): the reference's go-test
+walkthrough and a converging fleet, as shell commands."""
+
+from go_crdt_playground_tpu.__main__ import main
+
+
+def test_scenario_command_passes():
+    assert main(["scenario"]) == 0
+
+
+def test_gossip_command_converges():
+    assert main(["gossip", "--replicas", "8"]) == 0
