@@ -1,0 +1,13 @@
+"""Axis roles of packed-state fields, by field name — the single source
+of truth shared by the sharding layout (parallel/mesh.py) and the
+host-side repack helpers (utils/codec.py).  Field names are used because
+shapes alone are ambiguous when A == E.
+
+Jax-free on purpose: importable from host-only code paths.
+"""
+
+# trailing axis is the actor axis A (vv[R, A]-shaped)
+ACTOR_AXIS_FIELDS = frozenset({"vv", "processed"})
+
+# replica axis only (no trailing data axis)
+REPLICA_ONLY_FIELDS = frozenset({"actor"})

@@ -130,6 +130,67 @@ def render_tensor_trace(
     return lines
 
 
+def render_delta_tensor_trace(
+    trace: MergeTrace,
+    dst_before,
+    payload,
+    key_of=None,
+    header: bool = True,
+    delta_semantics: str = "reference",
+) -> List[str]:
+    """Decode a δ-apply trace (ops.delta.delta_apply_traced) back to the
+    reference's deltaMerge log lines (awset-delta_test.go:113-163).
+
+    dst_before: the receiver slice BEFORE the apply; payload: the
+    DeltaPayload that was applied.  The phase-2 dst dot is the
+    post-phase-1 live dot, reconstructed here the same way the spec
+    model reads ``dst.entries`` after phase 1 mutated it.
+    """
+    key_of = key_of or (lambda e: str(e))
+    p1 = np.asarray(trace.phase1)
+    p2 = np.asarray(trace.phase2)
+    dst_p = np.asarray(dst_before.present)
+    changed = np.asarray(payload.changed)
+    ch_dot = (np.asarray(payload.ch_da), np.asarray(payload.ch_dc))
+    del_dot = (np.asarray(payload.del_da), np.asarray(payload.del_dc))
+    dst_dot = (np.asarray(dst_before.dot_actor),
+               np.asarray(dst_before.dot_counter))
+    if p1.ndim != 1:
+        raise ValueError("render_delta_tensor_trace takes single-replica "
+                         "slices; index the batch first")
+
+    def dot_at(dots, e):
+        return (int(dots[0][e]), int(dots[1][e]))
+
+    lines: List[str] = []
+    if header:
+        lines.append(f"merge {vv_str(np.asarray(dst_before.vv))} "
+                     f"<- {vv_str(np.asarray(payload.src_vv))}")
+    for e in np.nonzero(p1 != OUTCOME_NONE)[0]:
+        code = int(p1[e])
+        d = dot_at(dst_dot, e) if dst_p[e] else None
+        lines.append(format_line(1, key_of(int(e)), d, dot_at(ch_dot, e),
+                                 OUTCOME_NAMES[code]))
+    # post-phase-1 live dot: changed lanes taken in phase 1 carry the
+    # payload dot (take = changed & (present | outcome != skip))
+    take = changed & (dst_p | (p1 == OUTCOME_ADD))
+    for e in np.nonzero(p2 != OUTCOME_NONE)[0]:
+        code = int(p2[e])
+        live = dot_at(ch_dot, e) if take[e] else dot_at(dst_dot, e)
+        present1 = dst_p[e] or take[e]
+        if not present1:
+            d, s = None, None                      # no-op delete, :160-162
+        elif code == OUTCOME_REMOVE:
+            d, s = live, None                      # :570/:582 in the spec
+        elif delta_semantics == "v2":
+            d, s = live, dot_at(del_dot, e)        # v2 keep
+        else:
+            d, s = None, dot_at(del_dot, e)        # reference keep, :153-155
+        lines.append(format_line(2, key_of(int(e)), d, s,
+                                 OUTCOME_NAMES[code]))
+    return lines
+
+
 def trace_counts(trace: MergeTrace) -> Dict[str, Dict[str, int]]:
     """Outcome histograms per phase — the aggregate view that replaces
     stdout-scraping for bulk merges (works on batched traces too)."""

@@ -28,7 +28,13 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from typing import Optional
+
 from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
+from go_crdt_playground_tpu.ops.merge import (MergeTrace, OUTCOME_ADD,
+                                              OUTCOME_KEEP, OUTCOME_NONE,
+                                              OUTCOME_REMOVE, OUTCOME_SKIP,
+                                              OUTCOME_UPDATE)
 from go_crdt_playground_tpu.ops.vv import has_dot, vv_join
 
 
@@ -87,9 +93,37 @@ def delta_apply(
 ) -> AWSetDeltaState:
     """Receiver-side ``deltaMerge`` (awset-delta_test.go:107-166) for one
     dst replica slice.  Branch-free; the mode strings are static."""
+    state, _ = _delta_apply_impl(dst, p, delta_semantics,
+                                 strict_reference_semantics, False)
+    return state
+
+
+def delta_apply_traced(
+    dst: AWSetDeltaState,
+    p: DeltaPayload,
+    delta_semantics: str = "reference",
+    strict_reference_semantics: bool = True,
+) -> Tuple[AWSetDeltaState, MergeTrace]:
+    """delta_apply plus per-lane decision tensors — the δ counterpart of
+    ops.merge's trace, covering the reference's deltaMerge logOutcome
+    calls (awset-delta_test.go:113-123, logged at 126-163)."""
+    state, trace = _delta_apply_impl(dst, p, delta_semantics,
+                                     strict_reference_semantics, True)
+    assert trace is not None
+    return state, trace
+
+
+def _delta_apply_impl(
+    dst: AWSetDeltaState,
+    p: DeltaPayload,
+    delta_semantics: str,
+    strict_reference_semantics: bool,
+    with_trace: bool,
+) -> Tuple[AWSetDeltaState, Optional[MergeTrace]]:
     # PHASE 1 over changed lanes — identical decision table to full-merge
     # phase 1 (awset-delta_test.go:126-147 vs awset.go:122-143).
-    p1_take = p.changed & (dst.present | ~has_dot(dst.vv, p.ch_da, p.ch_dc))
+    seen_by_dst = has_dot(dst.vv, p.ch_da, p.ch_dc)
+    p1_take = p.changed & (dst.present | ~seen_by_dst)
     present1 = dst.present | p1_take
     da1 = jnp.where(p1_take, p.ch_da, dst.dot_actor)
     dc1 = jnp.where(p1_take, p.ch_dc, dst.dot_counter)
@@ -141,11 +175,36 @@ def delta_apply(
         del_dc = dst.del_dot_counter
         processed = dst.processed
 
+    trace = None
+    if with_trace:
+        # phase-1 table mirrors ops.merge's (same outcome labels,
+        # awset-delta_test.go:126-147); lanes outside the payload are NONE
+        both = p.changed & dst.present
+        upd = both & ((dst.dot_actor != p.ch_da)
+                      | (dst.dot_counter != p.ch_dc))
+        t1 = jnp.where(
+            upd, OUTCOME_UPDATE,
+            jnp.where(
+                both, OUTCOME_KEEP,
+                jnp.where(
+                    p.changed & seen_by_dst, OUTCOME_SKIP,
+                    jnp.where(p.changed, OUTCOME_ADD, OUTCOME_NONE)))
+        ).astype(jnp.uint8)
+        # phase 2 over deletion lanes (awset-delta_test.go:149-163): the
+        # no-op delete on an absent key also logs "remove" (:160-162)
+        t2 = jnp.where(
+            remove, OUTCOME_REMOVE,
+            jnp.where(
+                p.deleted & present1, OUTCOME_KEEP,
+                jnp.where(p.deleted, OUTCOME_REMOVE, OUTCOME_NONE))
+        ).astype(jnp.uint8)
+        trace = MergeTrace(phase1=t1, phase2=t2)
+
     return AWSetDeltaState(
         vv=vv, present=present, dot_actor=da, dot_counter=dc,
         actor=dst.actor, deleted=deleted_log, del_dot_actor=del_da,
         del_dot_counter=del_dc, processed=processed,
-    )
+    ), trace
 
 
 def full_merge_delta(dst: AWSetDeltaState, src: AWSetDeltaState,

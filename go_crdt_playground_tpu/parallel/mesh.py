@@ -51,11 +51,14 @@ def make_mesh(mesh_shape: Optional[Tuple[int, int]] = None,
     return Mesh(arr, (REPLICA_AXIS, ELEMENT_AXIS))
 
 
-# Actor-axis fields stay replicated across element shards; everything else
-# element-shaped is sharded on both axes.  Keyed by field name (shapes alone
-# are ambiguous when A == E).
-_ACTOR_AXIS_FIELDS = frozenset({"vv", "processed"})
-_REPLICA_ONLY_FIELDS = frozenset({"actor"})
+# Actor-axis fields stay replicated across element shards (default
+# layout); everything else element-shaped is sharded on both axes.  The
+# field tables live in models/layout.py, shared with the host-side
+# repack helpers.
+from go_crdt_playground_tpu.models.layout import (  # noqa: E402
+    ACTOR_AXIS_FIELDS as _ACTOR_AXIS_FIELDS,
+    REPLICA_ONLY_FIELDS as _REPLICA_ONLY_FIELDS,
+)
 
 
 def partition_specs(state_cls, shard_actors: bool = False):

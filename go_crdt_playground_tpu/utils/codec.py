@@ -139,6 +139,66 @@ def unpack_awsets(
     return out
 
 
+from go_crdt_playground_tpu.models.layout import (
+    ACTOR_AXIS_FIELDS as _ACTOR_AXIS_FIELDS,
+    REPLICA_ONLY_FIELDS as _REPLICA_ONLY_FIELDS,
+)
+
+
+def _pad_last(x, amount: int):
+    import jax.numpy as jnp
+
+    return jnp.pad(jnp.asarray(x), [(0, 0)] * (x.ndim - 1) + [(0, amount)])
+
+
+def grow_elements(state, new_num_elements: int):
+    """Grow-and-repack, element axis (the overflow policy of SURVEY
+    §7.5.1): pad every element-shaped field of an AWSetState /
+    AWSetDeltaState to the new universe size.  Exact — padded lanes are
+    absent (present/deleted False, zero dots), the canonical encoding of
+    keys no replica has seen."""
+    if not hasattr(state, "present"):
+        raise TypeError(
+            f"grow_elements supports the AWSet state family; "
+            f"{type(state).__name__} has no element-presence field")
+    num_e = state.present.shape[-1]
+    if new_num_elements < num_e:
+        raise ValueError(
+            f"cannot shrink element axis {num_e} -> {new_num_elements}")
+    pad = new_num_elements - num_e
+    if pad == 0:
+        return state
+    return type(state)(**{
+        name: (val if name in _ACTOR_AXIS_FIELDS
+               or name in _REPLICA_ONLY_FIELDS
+               else _pad_last(val, pad))
+        for name, val in zip(state._fields, state)
+    })
+
+
+def grow_actors(state, new_num_actors: int):
+    """Grow-and-repack, actor axis: pad vv/processed to more actor slots.
+    Exact — a zero counter means "never seen" (crdt-misc.go:29-41)."""
+    num_a = state.vv.shape[-1]
+    if new_num_actors < num_a:
+        raise ValueError(
+            f"cannot shrink actor axis {num_a} -> {new_num_actors}")
+    pad = new_num_actors - num_a
+    if pad == 0:
+        return state
+    return type(state)(**{
+        name: (_pad_last(val, pad) if name in _ACTOR_AXIS_FIELDS else val)
+        for name, val in zip(state._fields, state)
+    })
+
+
+def grow_universe(dictionary: ElementDict, state, factor: int = 2):
+    """The full overflow move: double the dictionary capacity and repack
+    the packed state to match (callers re-bind both)."""
+    dictionary.grow(factor)
+    return grow_elements(state, dictionary.capacity)
+
+
 def render_packed(arrays: Dict[str, np.ndarray], dictionary: ElementDict) -> List[str]:
     """Canonical per-replica rendering of a packed state, byte-identical to
     the reference's ``AWSet.String`` format (awset.go:163-171) — the

@@ -1,0 +1,77 @@
+"""Grow-and-repack (SURVEY §7.5.1): the fixed element universe's
+overflow policy and the actor-axis extension, both exact by the
+zero-padding semantics (crdt-misc.go:29-41)."""
+
+import numpy as np
+import pytest
+
+from go_crdt_playground_tpu.models import awset, awset_delta
+from go_crdt_playground_tpu.models.spec import AWSet, VersionVector
+from go_crdt_playground_tpu.ops.merge import merge_one_into
+from go_crdt_playground_tpu.utils import codec
+
+
+def _two_writers(E=8):
+    a = AWSet(actor=0, version_vector=VersionVector([0, 0]))
+    b = AWSet(actor=1, version_vector=VersionVector([0, 0]))
+    a.add("x", "y")
+    b.add("y", "z")
+    a.del_("y")
+    d = codec.ElementDict(capacity=E)
+    packed = awset.from_arrays(codec.pack_awsets([a, b], d, 2))
+    return a, b, d, packed
+
+
+def test_grow_elements_preserves_rendering_and_merge():
+    a, b, d, packed = _two_writers()
+    grown = codec.grow_elements(packed, 32)
+    assert grown.present.shape[-1] == 32
+    # rendering unchanged (padded lanes are absent)
+    d32 = codec.ElementDict(capacity=32, values=[d.decode(i)
+                                                 for i in range(len(d))])
+    assert (codec.render_packed(awset.to_arrays(grown), d32)
+            == codec.render_packed(awset.to_arrays(packed), d))
+    # grow-then-merge == merge-then-grow, bitwise on the common lanes
+    m_then_g = codec.grow_elements(merge_one_into(packed, 0, packed, 1)[0],
+                                   32)
+    g_then_m = merge_one_into(grown, 0, grown, 1)[0]
+    for name in m_then_g._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(m_then_g, name)),
+                                      np.asarray(getattr(g_then_m, name)),
+                                      name)
+
+
+def test_grow_universe_admits_new_keys():
+    a, b, d, packed = _two_writers(E=4)
+    # fill the dictionary to capacity, then overflow
+    d.encode("w")
+    assert len(d) <= 4
+    with pytest.raises(OverflowError):
+        for i in range(10):
+            d.encode(f"spill{i}")
+    grown = codec.grow_universe(d, packed)
+    eid = d.encode("spill-ok")
+    assert eid < d.capacity and grown.present.shape[-1] == d.capacity
+    grown = awset.add_element(grown, np.uint32(0), np.uint32(eid))
+    assert bool(grown.present[0, eid])
+
+
+def test_grow_actors_exact():
+    st = awset_delta.init(4, 8, 4)
+    st = awset_delta.add_element(st, np.uint32(2), np.uint32(5))
+    grown = codec.grow_actors(st, 16)
+    assert grown.vv.shape == (4, 16) and grown.processed.shape == (4, 16)
+    np.testing.assert_array_equal(np.asarray(grown.vv[:, :4]),
+                                  np.asarray(st.vv))
+    assert (np.asarray(grown.vv[:, 4:]) == 0).all()
+    # element-shaped fields untouched
+    np.testing.assert_array_equal(np.asarray(grown.present),
+                                  np.asarray(st.present))
+
+
+def test_grow_rejects_shrink():
+    st = awset.init(2, 8, 2)
+    with pytest.raises(ValueError):
+        codec.grow_elements(st, 4)
+    with pytest.raises(ValueError):
+        codec.grow_actors(st, 1)

@@ -80,6 +80,59 @@ def test_tensor_trace_matches_spec_trace():
     )
 
 
+@pytest.mark.parametrize("semantics", ["reference", "v2"])
+def test_delta_tensor_trace_matches_spec_trace(semantics):
+    """δ-path parity: the packed δ-apply's decision tensors render to the
+    same line set as the spec AWSetDelta's deltaMerge logging
+    (awset-delta_test.go:113-163), in both δ semantics."""
+    import jax
+
+    from go_crdt_playground_tpu.models import awset_delta as delta_mod
+    from go_crdt_playground_tpu.models.spec import AWSetDelta
+    from go_crdt_playground_tpu.obs import render_delta_tensor_trace
+    from go_crdt_playground_tpu.ops import delta as delta_ops
+
+    a = AWSetDelta(actor=0, version_vector=VersionVector([0, 0]),
+                   delta_semantics=semantics)
+    b = AWSetDelta(actor=1, version_vector=VersionVector([0, 0]),
+                   delta_semantics=semantics)
+    a.add(key(1), key(2), key(3))
+    b.merge(a)                 # first contact: full branch, untraced
+    a.del_(key(3))             # deletion record -> phase-2 lane
+    a.add(key(2))              # fresh dot at A -> update lane at B
+    a.add(key(4))              # A-only -> add lane
+    b.add(key(5))              # B-only local entry, untouched
+    b.del_(key(1))             # B deleted 1; A's record for 1? none - keep
+
+    # packed twin BEFORE the traced exchange
+    dictionary = codec.ElementDict(capacity=E)
+    for i in range(E):
+        dictionary.encode(key(i))
+    arrays = codec.pack_awset_deltas([a, b], dictionary, 2)
+    packed = delta_mod.from_arrays(arrays)
+
+    events = []
+    b.trace = events.append
+    b.merge(a)                 # spec δ branch, collects log events
+
+    src = jax.tree.map(lambda x: x[0], packed)   # A
+    dst = jax.tree.map(lambda x: x[1], packed)   # B
+    payload = delta_ops.delta_extract(src, dst.vv)
+    merged, trace = delta_ops.delta_apply_traced(
+        dst, payload, delta_semantics=semantics)
+
+    spec_lines = render_spec_trace(events)
+    tensor_lines = render_delta_tensor_trace(
+        trace, dst, payload, key_of=dictionary.decode, header=False,
+        delta_semantics=semantics)
+    assert sorted(tensor_lines) == sorted(spec_lines)
+    # and the applied state matches the spec receiver's membership
+    np.testing.assert_array_equal(
+        np.nonzero(np.asarray(merged.present))[0],
+        sorted(dictionary.encode(k) for k in b.entries),
+    )
+
+
 def test_line_format_is_go_identical():
     # awset.go:120: fmt.Printf("> phase %d %-10q %-18s => %s\n", ...)
     ev_line = format_event(TraceEvent(1, "Anne", Dot(0, 1), Dot(1, 2),
