@@ -114,7 +114,11 @@ def _scan_round_rate(round_fn, state, aux, start=16, max_n=1 << 17,
             return round_fn(s, jax.tree.map(lambda x: x[i], aux)), None
         s, _ = jax.lax.scan(
             body, state, jnp.arange(n) % jax.tree.leaves(aux)[0].shape[0])
-        return jax.tree.leaves(s)[0].sum()
+        # the sync scalar MUST read every output leaf: the VV join chain
+        # depends only on vv, so a vv-only fetch lets XLA dead-code the
+        # entire membership/dot merge and the "measurement" collapses to
+        # the max-join alone
+        return sum(x.astype(jnp.float32).sum() for x in jax.tree.leaves(s))
 
     memo = {}
 
@@ -257,10 +261,14 @@ def measure_config5(num_replicas=1_000_000, num_elements=256,
 
     aw = build_state(num_replicas, num_elements, num_writers)
     rng = np.random.default_rng(1)
-    # uint8 draws: the float64 equivalent transiently costs ~2GB per array
-    draws = rng.integers(0, 100, (num_replicas, num_elements), dtype=np.uint8)
-    tp = lattices.TwoPSetState(added=jnp.asarray(draws < 30),
-                               removed=jnp.asarray(draws < 5))
+    # independent uint8 draws per mask (float64 draws would transiently
+    # cost ~2GB per array; correlating the two masks would drop the
+    # removed-without-added merge case from the workload)
+    tp = lattices.TwoPSetState(
+        added=jnp.asarray(rng.integers(
+            0, 100, (num_replicas, num_elements), dtype=np.uint8) < 30),
+        removed=jnp.asarray(rng.integers(
+            0, 100, (num_replicas, num_elements), dtype=np.uint8) < 5))
     offsets = gossip.dissemination_offsets(num_replicas)
     perms = jnp.stack([gossip.ring_perm(num_replicas, o)
                        for o in offsets[:8]])

@@ -1,0 +1,207 @@
+"""Fused Pallas TPU kernel for the δ-AWSet gossip round (v2 semantics).
+
+One δ exchange is extract → dispatch → apply (ops/delta.py): the sender
+compresses against the receiver's VV (awset-delta_test.go:79-105), the
+receiver takes the full-merge branch on first contact
+(awset-delta_test.go:53-56) or the δ branch otherwise, absorbs deletion
+records and joins the causal-stability vectors.  On the XLA path each of
+those steps re-gathers HasDot with [R, E] indices, which lowers
+pathologically inside compiled loops (see ops/pallas_merge.py regime
+notes) — at R=100K a round costs over a second.  Fusing the whole
+exchange into one kernel with the block-diagonal MXU gather
+(pallas_merge.gather_rows) brings it to HBM-bandwidth order.
+
+Fusion also simplifies the algebra: extraction and application see the
+SAME receiver VV, so phase-1's "take" mask collapses to the changed mask
+(a changed lane is by construction not covered by the receiver's clock,
+awset-delta_test.go:84-92 vs 126-147).
+
+v2 δ semantics only — the strict-reference quirk path (empty-δ VV skip,
+awset-delta_test.go:60-64) needs a cross-E reduction per pair and stays
+on the XLA path, which is also the conformance reference this kernel is
+pinned against bitwise (tests/test_pallas_delta.py).
+
+Layout contract mirrors pallas_merge._fused_rows: 8 replica rows per
+grid step, partner rows pre-gathered by XLA at HBM bandwidth, E in
+lane-multiple tiles, A padded to a lane multiple (zero slots are "never
+seen", crdt-misc.go:29-41).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
+from go_crdt_playground_tpu.ops.pallas_merge import (_LANE, _round_up,
+                                                     gather_rows)
+
+_BLOCK_R = 8
+
+
+def _delta_kernel(dvv_ref, svv_ref, dpr_ref, spr_ref, ah_ref,
+                  dp_ref, sp_ref, dda_ref, sda_ref, ddc_ref, sdc_ref,
+                  dd_ref, sd_ref, ddda_ref, sdda_ref, dddc_ref, sddc_ref,
+                  ovv_ref, opr_ref, op_ref, oda_ref, odc_ref,
+                  od_ref, odda_ref, oddc_ref):
+    dvv, svv = dvv_ref[...], svv_ref[...]            # uint32[8, A]
+    dproc, sproc = dpr_ref[...], spr_ref[...]        # uint32[8, A]
+    aonehot = ah_ref[...] != 0                       # bool[8, A]: sender slot
+    dp, sp = dp_ref[...] != 0, sp_ref[...] != 0      # bool[8, blk]
+    dda, sda = dda_ref[...], sda_ref[...]
+    ddc, sdc = ddc_ref[...], sdc_ref[...]
+    dd, sd = dd_ref[...] != 0, sd_ref[...] != 0      # deletion logs
+    ddda, sdda = ddda_ref[...], sdda_ref[...]        # deletion dots
+    dddc, sddc = dddc_ref[...], sddc_ref[...]
+
+    as_i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)  # noqa: E731
+
+    # first contact: receiver's counter for the sender's actor is zero
+    # (awset-delta_test.go:53).  Single-term masked sum, bit-exact via
+    # the int32 view (Mosaic has no unsigned reductions).
+    sender_cnt = jnp.sum(
+        jnp.where(aonehot, as_i32(dvv), jnp.zeros_like(as_i32(dvv))),
+        axis=1, keepdims=True)
+    fc = sender_cnt == 0                             # bool[8, 1]
+
+    # shared HasDot gathers
+    seen_s_by_d = sdc <= gather_rows(dvv, sda)       # receiver covers src dot
+    seen_d_by_s = ddc <= gather_rows(svv, dda)       # sender covers dst dot
+
+    # ---- FULL branch (first contact; ops/delta.full_merge_delta v2) ----
+    take_f = sp & (dp | ~seen_s_by_d)
+    present_f = take_f | (dp & ~sp & ~seen_d_by_s)
+    da_f = jnp.where(present_f, jnp.where(take_f, sda, dda), 0)
+    dc_f = jnp.where(present_f, jnp.where(take_f, sdc, ddc), 0)
+    rec_f = sd & (~dd | (sddc > dddc))
+    deleted_f = dd | sd
+    del_da_f = jnp.where(rec_f, sdda, ddda)
+    del_dc_f = jnp.where(rec_f, sddc, dddc)
+
+    # ---- δ branch (ops/delta.delta_extract + delta_apply, fused) ----
+    changed = sp & ~seen_s_by_d                      # :84-92
+    resurrected = sp & ((sda != sdda) | (sdc > sddc))  # :94-97
+    deleted_p = sd & ~resurrected
+    present1 = dp | changed                          # p1_take == changed
+    da1 = jnp.where(changed, sda, dda)
+    dc1 = jnp.where(changed, sdc, ddc)
+    # v2 arbitration: remove iff the SENDER's clock covers our live dot
+    remove = deleted_p & present1 & (dc1 <= gather_rows(svv, da1))
+    present_d = present1 & ~remove
+    da_d = jnp.where(present_d, da1, 0)
+    dc_d = jnp.where(present_d, dc1, 0)
+    rec_d = deleted_p & (~dd | (sddc > dddc))
+    deleted_d = dd | deleted_p
+    del_da_d = jnp.where(rec_d, sdda, ddda)
+    del_dc_d = jnp.where(rec_d, sddc, dddc)
+
+    # ---- select per row; A-shaped outputs are branch-independent ----
+    # (select between i1 vectors doesn't lower on Mosaic — "Unsupported
+    # target bitwidth for truncation" — so widen the operands first)
+    op_ref[...] = jnp.where(fc, present_f.astype(jnp.uint8),
+                            present_d.astype(jnp.uint8))
+    oda_ref[...] = jnp.where(fc, da_f, da_d)
+    odc_ref[...] = jnp.where(fc, dc_f, dc_d)
+    od_ref[...] = jnp.where(fc, deleted_f.astype(jnp.uint8),
+                            deleted_d.astype(jnp.uint8))
+    odda_ref[...] = jnp.where(fc, del_da_f, del_da_d)
+    oddc_ref[...] = jnp.where(fc, del_dc_f, del_dc_d)
+    ovv_ref[...] = jnp.where(dvv < svv, svv, dvv)
+    proc = jnp.where(dproc < sproc, sproc, dproc)
+    # the sender's own slot advances to its clock (spec _join_processed)
+    opr_ref[...] = jnp.where(aonehot & (proc < svv), svv, proc)
+
+
+@functools.partial(jax.jit, static_argnames=("block_e", "interpret"))
+def _fused_delta_round(arrays, perm, block_e: int, interpret: bool):
+    """arrays: the 9 AWSetDeltaState fields as a dict of padded 2D
+    device arrays (present/deleted as uint8)."""
+    num_r, num_e = arrays["present"].shape
+    num_a = arrays["vv"].shape[1]
+    e_pad = _round_up(num_e, _LANE)
+    a_pad = _round_up(num_a, _LANE)
+    r_pad = _round_up(num_r, _BLOCK_R)
+    blk = min(_round_up(block_e, _LANE), e_pad)
+    while e_pad % blk:
+        blk -= _LANE
+
+    def pad(x, last):
+        return jnp.pad(x, ((0, r_pad - num_r), (0, last - x.shape[1])))
+
+    perm = perm.astype(jnp.int32)
+    aonehot = (jnp.arange(a_pad, dtype=jnp.uint32)[None, :]
+               == arrays["actor"][perm].astype(jnp.uint32)[:, None]
+               ).astype(jnp.uint8)
+    aonehot = jnp.pad(aonehot, ((0, r_pad - num_r), (0, 0)))
+
+    a_named = ("vv", "processed")
+    e_named = ("present", "dot_actor", "dot_counter", "deleted",
+               "del_dot_actor", "del_dot_counter")
+    dst, src = {}, {}
+    for name in a_named + e_named:
+        x = arrays[name]
+        last = a_pad if name in a_named else e_pad
+        dst[name] = pad(x, last)
+        src[name] = pad(x[perm], last)
+
+    grid = (r_pad // _BLOCK_R, e_pad // blk)
+    a_blk = pl.BlockSpec((_BLOCK_R, a_pad), lambda i, j: (i, 0))
+    e_blk = pl.BlockSpec((_BLOCK_R, blk), lambda i, j: (i, j))
+
+    ins = [dst["vv"], src["vv"], dst["processed"], src["processed"],
+           aonehot]
+    in_specs = [a_blk] * 5
+    for name in e_named:
+        ins += [dst[name], src[name]]
+        in_specs += [e_blk, e_blk]
+
+    u32 = jnp.uint32
+    outs = pl.pallas_call(
+        _delta_kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[a_blk, a_blk, e_blk, e_blk, e_blk, e_blk, e_blk, e_blk],
+        out_shape=[
+            jax.ShapeDtypeStruct((r_pad, a_pad), u32),   # vv
+            jax.ShapeDtypeStruct((r_pad, a_pad), u32),   # processed
+            jax.ShapeDtypeStruct((r_pad, e_pad), jnp.uint8),  # present
+            jax.ShapeDtypeStruct((r_pad, e_pad), u32),   # dot_actor
+            jax.ShapeDtypeStruct((r_pad, e_pad), u32),   # dot_counter
+            jax.ShapeDtypeStruct((r_pad, e_pad), jnp.uint8),  # deleted
+            jax.ShapeDtypeStruct((r_pad, e_pad), u32),   # del_dot_actor
+            jax.ShapeDtypeStruct((r_pad, e_pad), u32),   # del_dot_counter
+        ],
+        interpret=interpret,
+    )(*ins)
+    vv, proc, p, da, dc, d, dda, ddc = outs
+    return (vv[:num_r, :num_a], proc[:num_r, :num_a], p[:num_r, :num_e],
+            da[:num_r, :num_e], dc[:num_r, :num_e], d[:num_r, :num_e],
+            dda[:num_r, :num_e], ddc[:num_r, :num_e])
+
+
+def pallas_delta_gossip_round(state: AWSetDeltaState, perm, *,
+                              block_e: int = 512,
+                              interpret: bool | None = None
+                              ) -> AWSetDeltaState:
+    """One fused δ anti-entropy round, v2 semantics: drop-in bitwise
+    equivalent of ``parallel.gossip.delta_gossip_round(state, perm,
+    delta_semantics="v2")`` (the production TPU path — that function
+    dispatches here on TPU backends)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    arrays = {
+        name: (getattr(state, name).astype(jnp.uint8)
+               if getattr(state, name).dtype == jnp.bool_
+               else getattr(state, name))
+        for name in state._fields
+    }
+    vv, proc, p, da, dc, d, dda, ddc = _fused_delta_round(
+        arrays, jnp.asarray(perm), block_e, interpret)
+    return AWSetDeltaState(
+        vv=vv, present=p != 0, dot_actor=da, dot_counter=dc,
+        actor=state.actor, deleted=d != 0, del_dot_actor=dda,
+        del_dot_counter=ddc, processed=proc,
+    )

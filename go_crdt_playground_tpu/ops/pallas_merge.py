@@ -29,17 +29,20 @@ a zero dot on an absent lane is "covered by every clock" and the lane's
 ``present`` bits are False on both sides, so every padded lane resolves
 to absent (same canonical zeroing as ops/merge.py).
 
-Measured regime guidance (v5e 1x1, R=10K, E=A=256): the XLA path runs
-~35us/round (near roofline — XLA fuses the permuted-row gather into the
-merge and lowers HasDot through the TPU gather engine), while this
-kernel's one-row-per-grid-step layout costs ~240ns/step of grid
-overhead, i.e. ~2.4ms/round at R=10K.  Prefer the XLA path for large
-replica fleets with small element universes; this kernel's blockwise-E
-streaming wins when E is huge (row state >> VMEM) and R is modest —
-and it is the scaffold for the ring-specialized multi-row variant
-(block-aligned offsets + in-kernel sublane shift) that lifts the
-per-row restriction.  tests/test_pallas_merge.py pins bitwise equality
-either way, so schedulers may pick per shape freely.
+Measured regime guidance (v5e 1x1, R=10K, E=A=256, honest scan-timed
+rounds — the sync scalar must consume every output or XLA dead-codes
+the dot/membership computation and the number measures only the VV
+join):
+  * XLA path: ~56ms/round — the elementwise HasDot gather
+    (take_along_axis with [R, E] indices) hits a pathological lowering
+    inside compiled loops; the VV-join chain alone runs at roofline
+    (~45us/round), so the gather is ~99% of the cost.
+  * this one-row kernel: ~2.4ms/round (grid overhead, ~240ns x R steps).
+  * the multi-row variant below: ~1.4ms/round — the production path.
+Prefer pallas_gossip_round_rows on TPU everywhere; this one-row variant
+remains for huge-E/modest-R streaming (row state >> VMEM) and as the
+scalar-prefetch reference.  tests/test_pallas_merge.py pins bitwise
+equality across all paths, so schedulers may pick per shape freely.
 """
 
 from __future__ import annotations
@@ -60,28 +63,34 @@ def _round_up(n: int, m: int) -> int:
     return (n + m - 1) // m * m
 
 
+def _exact_u32_onehot_dot(values: jnp.ndarray,
+                          onehot_f32: jnp.ndarray) -> jnp.ndarray:
+    """uint32[M, K] x one-hot f32[K, N] -> uint32[M, N] on the MXU,
+    exact over the full uint32 range: each output sums exactly one
+    surviving term and both 16-bit halves are < 2^16 <= 2^24, so the
+    f32 accumulation is exact.  (Mosaic has no u32<->f32 casts; both
+    halves round-trip value-preservingly through an i32 bitcast.)"""
+    as_i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)  # noqa: E731
+    hi = as_i32(values >> 16).astype(jnp.float32)
+    lo = as_i32(values & 0xFFFF).astype(jnp.float32)
+    cnt_hi = jnp.dot(hi, onehot_f32, preferred_element_type=jnp.float32)
+    cnt_lo = jnp.dot(lo, onehot_f32, preferred_element_type=jnp.float32)
+    cnt = (cnt_hi.astype(jnp.int32) << 16) | cnt_lo.astype(jnp.int32)
+    return jax.lax.bitcast_convert_type(cnt, jnp.uint32)
+
+
 def _gather_counter(vv: jnp.ndarray, da: jnp.ndarray) -> jnp.ndarray:
     """``vv[0, da[0, e]]`` for every lane e — HasDot's clock lookup
     (crdt-misc.go:33) as an exact one-hot matvec on the MXU.
 
     vv: uint32[1, A]; da: uint32[1, E] with values < A.  Returns
-    uint32[1, E].  Exactness: the one-hot contraction sums exactly one
-    term per lane and both 16-bit halves are < 2^16 <= 2^24, so the f32
-    accumulation is exact.
+    uint32[1, E].
     """
     a_pad, e_blk = vv.shape[1], da.shape[1]
     a_ids = jax.lax.broadcasted_iota(jnp.uint32, (a_pad, e_blk), 0)
     onehot = (a_ids == jnp.broadcast_to(da, (a_pad, e_blk))).astype(
         jnp.float32)
-    # Mosaic has no u32<->f32 casts; both halves are < 2^16 so a bitcast
-    # through i32 is value-preserving in both directions.
-    as_i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)  # noqa: E731
-    hi = as_i32(vv >> 16).astype(jnp.float32)
-    lo = as_i32(vv & 0xFFFF).astype(jnp.float32)
-    cnt_hi = jnp.dot(hi, onehot, preferred_element_type=jnp.float32)
-    cnt_lo = jnp.dot(lo, onehot, preferred_element_type=jnp.float32)
-    cnt = (cnt_hi.astype(jnp.int32) << 16) | cnt_lo.astype(jnp.int32)
-    return jax.lax.bitcast_convert_type(cnt, jnp.uint32)
+    return _exact_u32_onehot_dot(vv, onehot)
 
 
 def _round_kernel(perm_ref, dvv_ref, svv_ref, dp_ref, sp_ref,
@@ -231,3 +240,129 @@ def pallas_merge_pairwise(dst: AWSetState, src: AWSetState, *,
         _as_arrays(dst), _as_arrays(src), perm, block_e, interpret)
     return AWSetState(vv=vv, present=p != 0, dot_actor=da, dot_counter=dc,
                       actor=dst.actor)
+
+
+# ---------------------------------------------------------------------------
+# Multi-row variant: the production gossip path
+# ---------------------------------------------------------------------------
+#
+# The one-row-per-grid-step layout above pays ~240ns of grid overhead per
+# replica — 2.4ms/round at R=10K, dwarfing the ~0.15ms of HBM traffic.
+# This variant amortizes it 8 rows at a time (Mosaic's sublane rule: the
+# block's second-minor dim must be 8-divisible), which demotes the
+# arbitrary-permutation row gather from the kernel's scalar-prefetch DMA
+# to a plain XLA gather BEFORE the kernel: partner rows of one 8-row
+# block aren't contiguous under a general perm, but the XLA row gather
+# runs at HBM bandwidth (it is the vv-join chain's own layout), so the
+# split costs one extra state read and removes ~85% of the grid steps.
+
+
+def gather_rows(vv: jnp.ndarray, da: jnp.ndarray) -> jnp.ndarray:
+    """In-kernel HasDot gather, multi-row: cnt[r, e] = vv[r, da[r, e]]
+    for a whole row block with ONE 2D MXU matmul.  The vv rows become a
+    block-diagonal [blk_r, blk_r*A] operand and the one-hot selector
+    [blk_r*A, blk_e] row q = r*A + a answers "does row r's lane e name
+    actor a".  Mosaic can't lower batched dot_general and axis-1
+    reductions of [blk_r, A, blk_e] are layout-hostile; both 2D shapes
+    here keep lanes minor.  Exact over the full uint32 range via the
+    16-bit halves (the one-hot contraction sums a single term < 2^16).
+
+    vv: uint32[blk_r, A]; da: uint32[blk_r, blk_e] -> uint32[blk_r, blk_e]
+    """
+    blk_r, a_pad = vv.shape
+    blk_e = da.shape[1]
+    q = blk_r * a_pad
+    q_a = jax.lax.broadcasted_iota(jnp.uint32, (q, blk_e), 0) % a_pad
+    da_rep = jnp.broadcast_to(
+        da[:, None, :], (blk_r, a_pad, blk_e)).reshape(q, blk_e)
+    onehot = (q_a == da_rep).astype(jnp.float32)
+    eye = (jax.lax.broadcasted_iota(jnp.uint32, (blk_r, blk_r, a_pad), 0)
+           == jax.lax.broadcasted_iota(jnp.uint32,
+                                       (blk_r, blk_r, a_pad), 1))
+    tiled = jnp.broadcast_to(vv[None, :, :], (blk_r, blk_r, a_pad))
+    vvd = jnp.where(eye, tiled, jnp.zeros_like(tiled)).reshape(blk_r, q)
+    return _exact_u32_onehot_dot(vvd, onehot)
+
+
+def _rows_kernel(dvv_ref, svv_ref, dp_ref, sp_ref, dda_ref, sda_ref,
+                 ddc_ref, sdc_ref, ovv_ref, op_ref, oda_ref, odc_ref):
+    dvv, svv = dvv_ref[...], svv_ref[...]          # [8, A]
+    dp = dp_ref[...] != 0                           # [8, blk]
+    sp = sp_ref[...] != 0
+    dda, sda = dda_ref[...], sda_ref[...]
+    ddc, sdc = ddc_ref[...], sdc_ref[...]
+
+    seen_by_dst = sdc <= gather_rows(dvv, sda)
+    seen_by_src = ddc <= gather_rows(svv, dda)
+    take_src = sp & (dp | ~seen_by_dst)
+    present = take_src | (dp & ~sp & ~seen_by_src)
+    da = jnp.where(take_src, sda, dda)
+    dc = jnp.where(take_src, sdc, ddc)
+    zero = jnp.zeros_like(da)
+    oda_ref[...] = jnp.where(present, da, zero)
+    odc_ref[...] = jnp.where(present, dc, zero)
+    op_ref[...] = present.astype(jnp.uint8)
+    ovv_ref[...] = jnp.where(dvv < svv, svv, dvv)
+
+
+_BLOCK_R = 8
+
+
+@functools.partial(jax.jit, static_argnames=("block_e", "interpret"))
+def _fused_rows(dst_arrays, src_arrays, block_e: int, interpret: bool):
+    num_r, num_e = dst_arrays[2].shape
+    num_a = dst_arrays[0].shape[1]
+    e_pad = _round_up(num_e, _LANE)
+    a_pad = _round_up(num_a, _LANE)
+    r_pad = _round_up(num_r, _BLOCK_R)
+    blk = min(_round_up(block_e, _LANE), e_pad)
+    while e_pad % blk:
+        blk -= _LANE
+
+    def pad(arrays):
+        vv, p_u8, da, dc = arrays
+        pe = ((0, r_pad - num_r), (0, e_pad - num_e))
+        pa = ((0, r_pad - num_r), (0, a_pad - num_a))
+        return (jnp.pad(vv, pa), jnp.pad(p_u8, pe), jnp.pad(da, pe),
+                jnp.pad(dc, pe))
+
+    vv, p_u8, da, dc = pad(dst_arrays)
+    svv, sp_u8, sda, sdc = pad(src_arrays)
+    grid = (r_pad // _BLOCK_R, e_pad // blk)
+
+    vv_blk = pl.BlockSpec((_BLOCK_R, a_pad), lambda i, j: (i, 0))
+    el_blk = pl.BlockSpec((_BLOCK_R, blk), lambda i, j: (i, j))
+    out_vv, out_p, out_da, out_dc = pl.pallas_call(
+        _rows_kernel,
+        grid=grid,
+        in_specs=[vv_blk, vv_blk, el_blk, el_blk, el_blk, el_blk,
+                  el_blk, el_blk],
+        out_specs=[vv_blk, el_blk, el_blk, el_blk],
+        out_shape=[
+            jax.ShapeDtypeStruct((r_pad, a_pad), jnp.uint32),
+            jax.ShapeDtypeStruct((r_pad, e_pad), jnp.uint8),
+            jax.ShapeDtypeStruct((r_pad, e_pad), jnp.uint32),
+            jax.ShapeDtypeStruct((r_pad, e_pad), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(vv, svv, p_u8, sp_u8, da, sda, dc, sdc)
+    return (out_vv[:num_r, :num_a], out_p[:num_r, :num_e],
+            out_da[:num_r, :num_e], out_dc[:num_r, :num_e])
+
+
+def pallas_gossip_round_rows(state: AWSetState, perm, *,
+                             block_e: int = 512,
+                             interpret: bool | None = None) -> AWSetState:
+    """One anti-entropy round on the multi-row kernel: partner rows are
+    gathered by XLA at HBM bandwidth, then 8 replica rows merge per grid
+    step.  Bitwise-equal to gossip_round / pallas_gossip_round; ~5x
+    faster than the one-row kernel at large R (the production TPU path —
+    parallel.gossip.gossip_round dispatches here on TPU backends).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    src = jax.tree.map(lambda x: x[perm], state)
+    vv, p, da, dc = _fused_rows(_as_arrays(state), _as_arrays(src),
+                                block_e, interpret)
+    return AWSetState(vv=vv, present=p != 0, dot_actor=da, dot_counter=dc,
+                      actor=state.actor)

@@ -89,19 +89,40 @@ def gossip_round(
     state: AWSetState,
     perm: jnp.ndarray,
     drop_mask: Optional[jnp.ndarray] = None,
+    kernel: str = "auto",
 ) -> AWSetState:
     """One full-state anti-entropy round: r <- perm[r] for all r.
 
     drop_mask: bool[R], True = this replica's exchange is lost this round
-    (it keeps its old state) — fault injection as a masked lane."""
-    src = jax.tree.map(lambda x: x[perm], state)
-    merged, _ = merge_pairwise(state, src)
+    (it keeps its old state) — fault injection as a masked lane.
+
+    kernel: "auto" (fused Pallas kernel on single-device TPU processes,
+    XLA elsewhere), "xla", or "pallas".  All choices are bitwise-
+    identical; on TPU the XLA HasDot gather lowers pathologically
+    inside compiled loops (~40x slower, see ops/pallas_merge.py regime
+    notes), so auto picks the multi-row fused kernel there.  auto stays
+    on XLA when more than one device is visible — a bare pallas_call
+    has no GSPMD partitioning rule, so mesh programs must either keep
+    the XLA path or invoke the kernel per-shard inside shard_map
+    (kernel="pallas" explicitly).
+    """
+    if kernel == "auto":
+        kernel = ("pallas" if jax.default_backend() == "tpu"
+                  and jax.device_count() == 1 else "xla")
+    if kernel == "pallas":
+        from go_crdt_playground_tpu.ops.pallas_merge import (
+            pallas_gossip_round_rows)
+
+        merged = pallas_gossip_round_rows(state, perm)
+    else:
+        src = jax.tree.map(lambda x: x[perm], state)
+        merged, _ = merge_pairwise(state, src)
     if drop_mask is not None:
         merged = _select_rows(~drop_mask, merged, state)
     return merged
 
 
-gossip_round_jit = jax.jit(gossip_round)
+gossip_round_jit = jax.jit(gossip_round, static_argnames=("kernel",))
 
 
 def delta_gossip_round(
@@ -110,11 +131,33 @@ def delta_gossip_round(
     drop_mask: Optional[jnp.ndarray] = None,
     delta_semantics: str = "v2",
     strict_reference_semantics: bool = True,
+    kernel: str = "auto",
 ) -> AWSetDeltaState:
-    """One δ anti-entropy round (payload-compressed exchanges)."""
-    src = jax.tree.map(lambda x: x[perm], state)
-    merged = delta_merge_pairwise(state, src, delta_semantics,
-                                  strict_reference_semantics)
+    """One δ anti-entropy round (payload-compressed exchanges).
+
+    kernel: "auto" picks the fused Pallas δ kernel on single-device TPU
+    processes for v2 semantics (bitwise-identical, ~44x faster at fleet
+    scale — the XLA HasDot gathers lower pathologically there,
+    ops/pallas_merge.py regime notes); reference-mode semantics always
+    use the XLA path (the strict empty-δ quirk needs a per-pair cross-E
+    reduction), and mesh programs keep XLA too (same GSPMD caveat as
+    gossip_round — use shard_map + kernel="pallas" per shard instead).
+    """
+    if kernel == "auto":
+        kernel = ("pallas" if delta_semantics == "v2"
+                  and jax.default_backend() == "tpu"
+                  and jax.device_count() == 1 else "xla")
+    if kernel == "pallas":
+        if delta_semantics != "v2":
+            raise ValueError("the fused delta kernel is v2-only")
+        from go_crdt_playground_tpu.ops.pallas_delta import (
+            pallas_delta_gossip_round)
+
+        merged = pallas_delta_gossip_round(state, perm)
+    else:
+        src = jax.tree.map(lambda x: x[perm], state)
+        merged = delta_merge_pairwise(state, src, delta_semantics,
+                                      strict_reference_semantics)
     if drop_mask is not None:
         merged = _select_rows(~drop_mask, merged, state)
     return merged
@@ -122,7 +165,8 @@ def delta_gossip_round(
 
 delta_gossip_round_jit = jax.jit(
     delta_gossip_round,
-    static_argnames=("delta_semantics", "strict_reference_semantics"),
+    static_argnames=("delta_semantics", "strict_reference_semantics",
+                     "kernel"),
 )
 
 

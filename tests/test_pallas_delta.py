@@ -1,0 +1,115 @@
+"""Conformance gate for the fused Pallas δ-gossip kernel.
+
+ops/pallas_delta.py must be bitwise-identical to the XLA δ path
+(ops/delta.py v2 dispatch), which tests/test_delta_kernel.py pins to the
+executable spec — equality here transitively pins the fused kernel to
+the reference δ semantics (awset-delta_test.go:51-166)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from go_crdt_playground_tpu.models import awset_delta
+from go_crdt_playground_tpu.ops import pallas_delta
+from go_crdt_playground_tpu.parallel import gossip
+
+
+def _assert_equal(want, got, ctx=""):
+    for name in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, name)), np.asarray(getattr(got, name)),
+            err_msg=f"{ctx}:{name}")
+
+
+def _scenario_state(rng, R, E, A):
+    """Mixed history: adds, deletions (records), re-adds (resurrections),
+    plus some rows that never wrote (first-contact sources)."""
+    # observer topology when A < R: the aliased trailing rows never write
+    st = awset_delta.init(R, E, A, actors=np.arange(R) % A)
+    writers = min(A, max(1, R - 2))
+    for _ in range(5 * R):
+        r = rng.randrange(writers)                # trailing rows stay silent
+        e = rng.randrange(E)
+        roll = rng.random()
+        if roll < 0.6:
+            st = awset_delta.add_element(st, np.uint32(r), np.uint32(e))
+        else:
+            sel = np.zeros(E, bool)
+            sel[e] = True
+            if rng.random() < 0.3:                # multi-key Del call
+                sel[rng.randrange(E)] = True
+            st = awset_delta.del_elements(st, np.uint32(r), np.asarray(sel))
+    return st
+
+
+@pytest.mark.parametrize(
+    "R,E,A",
+    [
+        (8, 16, 8),       # exact blocks
+        (7, 300, 5),      # ragged everything
+        (12, 640, 16),    # multiple E tiles, R pads to 16
+    ],
+)
+def test_fused_delta_round_matches_xla(R, E, A):
+    import random
+    rng = random.Random(101)
+    st = _scenario_state(rng, R, E, A)
+    for offset in (1, 2, 3):
+        perm = gossip.ring_perm(R, offset)
+        want = gossip.delta_gossip_round(st, perm, delta_semantics="v2",
+                                         kernel="xla")
+        got = pallas_delta.pallas_delta_gossip_round(st, perm)
+        _assert_equal(want, got, f"offset {offset}")
+        st = want   # iterate on merged state (first contacts become delta)
+
+
+def test_fused_delta_first_contact_rows():
+    """Rows whose receiver never saw the sender take the full branch."""
+    import random
+    rng = random.Random(103)
+    st = _scenario_state(rng, 8, 32, 8)
+    # fresh state: every exchange is first contact
+    perm = gossip.ring_perm(8, 1)
+    want = gossip.delta_gossip_round(st, perm, delta_semantics="v2",
+                                     kernel="xla")
+    got = pallas_delta.pallas_delta_gossip_round(st, perm)
+    _assert_equal(want, got, "all-first-contact")
+
+
+def test_fused_delta_large_counters_exact():
+    st = awset_delta.init(6, 64, 6)
+    big = jnp.uint32(0xFFFE0007)
+    st = st._replace(
+        vv=st.vv.at[0, 0].set(big).at[1, 1].set(big + 8),
+        present=st.present.at[0, 3].set(True),
+        dot_actor=st.dot_actor.at[0, 3].set(0),
+        dot_counter=st.dot_counter.at[0, 3].set(big),
+        processed=st.processed.at[0, 0].set(big),
+    )
+    perm = gossip.ring_perm(6, 1)
+    want = gossip.delta_gossip_round(st, perm, delta_semantics="v2",
+                                     kernel="xla")
+    got = pallas_delta.pallas_delta_gossip_round(st, perm)
+    _assert_equal(want, got, "large counters")
+
+
+def test_delta_dispatch_guard():
+    st = awset_delta.init(4, 8, 4)
+    with pytest.raises(ValueError):
+        gossip.delta_gossip_round(st, gossip.ring_perm(4, 1),
+                                  delta_semantics="reference",
+                                  kernel="pallas")
+
+
+def test_fused_delta_converges_like_xla():
+    import random
+    rng = random.Random(107)
+    st = _scenario_state(rng, 8, 32, 8)
+    xla = gossip.all_pairs_converge(st, delta=True, delta_semantics="v2")
+    pal = st
+    for off in gossip.dissemination_offsets(8):
+        pal = pallas_delta.pallas_delta_gossip_round(
+            pal, gossip.ring_perm(8, off))
+    _assert_equal(xla, pal, "converged fixed point")

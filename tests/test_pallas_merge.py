@@ -96,3 +96,53 @@ def test_pairwise_matches_xla_kernel():
     want, _ = merge_ops.merge_pairwise(dst, src)
     got = pallas_merge.pallas_merge_pairwise(dst, src)
     assert_states_equal(want, got)
+
+
+@pytest.mark.parametrize(
+    "num_r,num_e,num_a",
+    [
+        (8, 16, 2),      # reference-shaped world
+        (7, 300, 5),     # row/lane padding: R not a sublane multiple
+        (12, 640, 64),   # multiple E tiles, R pads to 16
+    ],
+)
+def test_multirow_kernel_matches_xla(num_r, num_e, num_a):
+    """The production multi-row kernel (block-diagonal MXU HasDot)
+    against the XLA round, including the ragged padding paths."""
+    rng = np.random.default_rng(23)
+    state = rand_state(rng, num_r, num_e, num_a)
+    for offset in (1, 3):
+        perm = gossip.ring_perm(num_r, offset)
+        want = gossip.gossip_round(state, perm, kernel="xla")
+        got = pallas_merge.pallas_gossip_round_rows(state, perm)
+        assert_states_equal(want, got)
+        state = want
+
+
+def test_multirow_kernel_large_counters_exact():
+    rng = np.random.default_rng(31)
+    state = rand_state(rng, 9, 128, 3)
+    big = np.asarray(state.vv, dtype=np.uint64)
+    vv = jnp.asarray(((big * 97003) + 0xFFFF0000) % (1 << 32),
+                     dtype=jnp.uint32)
+    dc = jnp.where(state.present,
+                   jnp.asarray(rng.integers(0xFFFE0000, 0xFFFFFFFF,
+                                            state.dot_counter.shape,
+                                            dtype=np.uint32)), 0)
+    state = state._replace(vv=vv, dot_counter=dc)
+    perm = gossip.ring_perm(9, 1)
+    want = gossip.gossip_round(state, perm, kernel="xla")
+    got = pallas_merge.pallas_gossip_round_rows(state, perm)
+    assert_states_equal(want, got)
+
+
+def test_gossip_round_kernel_dispatch_equal():
+    """kernel="pallas" (interpreter off-TPU) == kernel="xla" through the
+    public gossip_round entry point, drop-mask included."""
+    rng = np.random.default_rng(37)
+    state = rand_state(rng, 8, 64, 4)
+    perm = gossip.ring_perm(8, 2)
+    drop = jnp.asarray(rng.random(8) < 0.4)
+    want = gossip.gossip_round(state, perm, drop, kernel="xla")
+    got = gossip.gossip_round(state, perm, drop, kernel="pallas")
+    assert_states_equal(want, got)
