@@ -52,11 +52,14 @@ def _cmd_scenario() -> int:
 def _cmd_gossip(num_replicas: int) -> int:
     import numpy as np
 
+    from go_crdt_playground_tpu.config import Config
     from go_crdt_playground_tpu.models import awset
     from go_crdt_playground_tpu.parallel import collectives, gossip
 
-    R, E = num_replicas, 128
-    state = awset.init(R, E, R)
+    cfg = Config(num_replicas=num_replicas, num_elements=128,
+                 num_actors=num_replicas)
+    R, E = cfg.num_replicas, cfg.num_elements
+    state = cfg.init_awset()
     rng = np.random.default_rng(0)
     for r in range(R):             # every replica adds a private slice
         state = awset.add_element(

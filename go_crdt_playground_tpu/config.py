@@ -39,7 +39,8 @@ class Config:
         with the reference's five outcome labels, awset.go:126-156) from
         kernels that support it.
       mesh_shape: (replica_shards, element_shards) for the device mesh used
-        by parallel/.  None = single device.
+        by parallel/.  None = mesh.make_mesh's default: every visible
+        device on the replica axis.
     """
 
     num_replicas: int = 2
@@ -56,6 +57,30 @@ class Config:
             raise ValueError("num_replicas/num_elements/num_actors must be >= 1")
         if self.counter_dtype not in ("uint32", "uint64"):
             raise ValueError(f"unsupported counter dtype {self.counter_dtype}")
+
+    # -- factories (the one place shapes flow from config into states) ----
+
+    def init_awset(self, actors=None):
+        from go_crdt_playground_tpu.models import awset
+
+        return awset.init(self.num_replicas, self.num_elements,
+                          self.num_actors, actors)
+
+    def init_awset_delta(self, actors=None):
+        from go_crdt_playground_tpu.models import awset_delta
+
+        return awset_delta.init(self.num_replicas, self.num_elements,
+                                self.num_actors, actors)
+
+    def element_dict(self, values=None):
+        from go_crdt_playground_tpu.utils.codec import ElementDict
+
+        return ElementDict(capacity=self.num_elements, values=values)
+
+    def make_mesh(self, devices=None):
+        from go_crdt_playground_tpu.parallel import mesh
+
+        return mesh.make_mesh(self.mesh_shape, devices=devices)
 
 
 # The conformance anchor config: BASELINE.md config 1 (AWSet 3 replicas x 16

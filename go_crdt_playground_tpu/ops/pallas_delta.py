@@ -36,10 +36,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
-from go_crdt_playground_tpu.ops.pallas_merge import (_LANE, _round_up,
-                                                     gather_rows)
-
-_BLOCK_R = 8
+from go_crdt_playground_tpu.ops.pallas_merge import (_BLOCK_R, gather_rows,
+                                                     row_block_layout)
 
 
 def _delta_kernel(dvv_ref, svv_ref, dpr_ref, spr_ref, ah_ref,
@@ -121,12 +119,8 @@ def _fused_delta_round(arrays, perm, block_e: int, interpret: bool):
     device arrays (present/deleted as uint8)."""
     num_r, num_e = arrays["present"].shape
     num_a = arrays["vv"].shape[1]
-    e_pad = _round_up(num_e, _LANE)
-    a_pad = _round_up(num_a, _LANE)
-    r_pad = _round_up(num_r, _BLOCK_R)
-    blk = min(_round_up(block_e, _LANE), e_pad)
-    while e_pad % blk:
-        blk -= _LANE
+    r_pad, e_pad, a_pad, blk = row_block_layout(num_r, num_e, num_a,
+                                                block_e)
 
     def pad(x, last):
         return jnp.pad(x, ((0, r_pad - num_r), (0, last - x.shape[1])))

@@ -307,17 +307,42 @@ def _rows_kernel(dvv_ref, svv_ref, dp_ref, sp_ref, dda_ref, sda_ref,
 
 _BLOCK_R = 8
 
+# In-kernel one-hot budget: gather_rows materializes a
+# [_BLOCK_R * a_pad, blk_e] f32 selector (plus the same-shaped da_rep),
+# so blk_e must shrink as A grows to stay inside VMEM.
+_ONEHOT_BUDGET_BYTES = 4 << 20
+
+# Above this actor-axis size even blk_e = one lane group blows the
+# budget — callers (gossip auto-dispatch) fall back to the XLA path.
+MAX_FUSED_ACTORS = _ONEHOT_BUDGET_BYTES // (_BLOCK_R * 4 * _LANE)
+
+
+def row_block_layout(num_r: int, num_e: int, num_a: int, block_e: int):
+    """Padded dims + element block size for the multi-row kernels:
+    (r_pad, e_pad, a_pad, blk).  blk is a lane multiple that divides
+    e_pad and keeps the one-hot selector within the VMEM budget."""
+    e_pad = _round_up(num_e, _LANE)
+    a_pad = _round_up(num_a, _LANE)
+    r_pad = _round_up(num_r, _BLOCK_R)
+    budget_blk = _ONEHOT_BUDGET_BYTES // (_BLOCK_R * a_pad * 4)
+    if budget_blk < _LANE:
+        raise ValueError(
+            f"actor axis A={num_a} too large for the fused row kernels "
+            f"(one-hot selector would exceed the {_ONEHOT_BUDGET_BYTES >> 20}"
+            "MB VMEM budget at the minimum block width); use the XLA path")
+    blk = min(_round_up(block_e, _LANE), e_pad,
+              budget_blk // _LANE * _LANE)
+    while e_pad % blk:
+        blk -= _LANE
+    return r_pad, e_pad, a_pad, blk
+
 
 @functools.partial(jax.jit, static_argnames=("block_e", "interpret"))
 def _fused_rows(dst_arrays, src_arrays, block_e: int, interpret: bool):
     num_r, num_e = dst_arrays[2].shape
     num_a = dst_arrays[0].shape[1]
-    e_pad = _round_up(num_e, _LANE)
-    a_pad = _round_up(num_a, _LANE)
-    r_pad = _round_up(num_r, _BLOCK_R)
-    blk = min(_round_up(block_e, _LANE), e_pad)
-    while e_pad % blk:
-        blk -= _LANE
+    r_pad, e_pad, a_pad, blk = row_block_layout(num_r, num_e, num_a,
+                                                block_e)
 
     def pad(arrays):
         vv, p_u8, da, dc = arrays

@@ -107,8 +107,11 @@ def gossip_round(
     (kernel="pallas" explicitly).
     """
     if kernel == "auto":
+        from go_crdt_playground_tpu.ops.pallas_merge import MAX_FUSED_ACTORS
+
         kernel = ("pallas" if jax.default_backend() == "tpu"
-                  and jax.device_count() == 1 else "xla")
+                  and jax.device_count() == 1
+                  and state.vv.shape[-1] <= MAX_FUSED_ACTORS else "xla")
     if kernel == "pallas":
         from go_crdt_playground_tpu.ops.pallas_merge import (
             pallas_gossip_round_rows)
@@ -144,9 +147,12 @@ def delta_gossip_round(
     gossip_round — use shard_map + kernel="pallas" per shard instead).
     """
     if kernel == "auto":
+        from go_crdt_playground_tpu.ops.pallas_merge import MAX_FUSED_ACTORS
+
         kernel = ("pallas" if delta_semantics == "v2"
                   and jax.default_backend() == "tpu"
-                  and jax.device_count() == 1 else "xla")
+                  and jax.device_count() == 1
+                  and state.vv.shape[-1] <= MAX_FUSED_ACTORS else "xla")
     if kernel == "pallas":
         if delta_semantics != "v2":
             raise ValueError("the fused delta kernel is v2-only")
@@ -168,6 +174,31 @@ delta_gossip_round_jit = jax.jit(
     static_argnames=("delta_semantics", "strict_reference_semantics",
                      "kernel"),
 )
+
+
+def ormap_gossip_round(state, perm: jnp.ndarray, kernel: str = "auto"):
+    """One OR-Map anti-entropy round: the key membership is exactly the
+    AWSet round (fused Pallas kernel on single-device TPU, same dispatch
+    as gossip_round), the value cells join with the elementwise LWW rule.
+    Bitwise-equivalent to ``lattices.gossip_round(lattices.ormap_join,
+    state, perm)`` — that XLA path pays the pathological HasDot-gather
+    lowering at fleet scale, this one doesn't."""
+    from go_crdt_playground_tpu.ops.lattices import ORMapState, _lww_newer
+
+    base = AWSetState(vv=state.vv, present=state.present,
+                      dot_actor=state.dot_actor,
+                      dot_counter=state.dot_counter, actor=state.actor)
+    merged = gossip_round(base, perm, kernel=kernel)
+    src_ts = state.ts[perm]
+    src_wa = state.wr_actor[perm]
+    take = _lww_newer(src_ts, src_wa, state.ts, state.wr_actor)
+    return ORMapState(
+        vv=merged.vv, present=merged.present, dot_actor=merged.dot_actor,
+        dot_counter=merged.dot_counter, actor=state.actor,
+        ts=jnp.where(take, src_ts, state.ts),
+        wr_actor=jnp.where(take, src_wa, state.wr_actor),
+        val=jnp.where(take, state.val[perm], state.val),
+    )
 
 
 def _extract_round(state: AWSetDeltaState, perm: jnp.ndarray):

@@ -213,6 +213,48 @@ def test_ep_ring_rejects_indivisible_actor_axis():
         mesh_mod.shard_state(state, m, shard_actors=True)
 
 
+def test_ormap_gossip_round_matches_lattice_join():
+    """The fast OR-Map round (AWSet kernel for membership + elementwise
+    LWW for cells) is bitwise the generic lattice-join round."""
+    import random
+    from go_crdt_playground_tpu.ops import lattices as L
+
+    rng = random.Random(73)
+    R, E = 8, 16
+    st = L.ormap_init(R, E, R)
+    ts = 0
+    for _ in range(60):
+        r, e = rng.randrange(R), rng.randrange(E)
+        if rng.random() < 0.7:
+            ts += 1
+            st = L.ormap_put(st, np.uint32(r), np.uint32(e),
+                             np.uint32(rng.randrange(1, 99)), np.uint32(ts))
+        else:
+            st = L.ormap_delete(st, np.uint32(r), np.uint32(e))
+    for off in (1, 3):
+        perm = gossip.ring_perm(R, off)
+        want = L.gossip_round(L.ormap_join, st, perm)
+        for kernel in ("xla", "pallas"):
+            got = gossip.ormap_gossip_round(st, perm, kernel=kernel)
+            _assert_states_equal(want, got, f"off {off} kernel {kernel}")
+        st = want
+
+
+def test_config_factories():
+    from go_crdt_playground_tpu.config import REFERENCE_CONFIG, Config
+
+    st = REFERENCE_CONFIG.init_awset()
+    assert st.present.shape == (3, 16) and st.vv.shape == (3, 3)
+    d = REFERENCE_CONFIG.element_dict()
+    assert d.capacity == 16
+    cfg = Config(num_replicas=8, num_elements=32, num_actors=8,
+                 mesh_shape=(4, 2))
+    ds = cfg.init_awset_delta()
+    assert ds.deleted.shape == (8, 32)
+    m = cfg.make_mesh()
+    assert dict(m.shape) == {"replica": 4, "element": 2}
+
+
 def test_gossip_determinism():
     import random
     rng = random.Random(23)
