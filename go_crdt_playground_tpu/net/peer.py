@@ -262,6 +262,47 @@ class Node:
         except (ProtocolError, framing.RemoteError, OSError):
             pass  # connection-scoped failure; anti-entropy self-heals
 
+    # -- crash / recovery ---------------------------------------------------
+
+    def save(self, path: str, metadata: Optional[dict] = None) -> str:
+        """Checkpoint this node's replica state (single-file atomic dump,
+        utils/checkpoint).  State-based CRDTs make recovery trivial: a
+        restored node re-joins with a possibly-stale state and anti-
+        entropy self-heals the gap (SURVEY §5.3-5.4 — the merge IS the
+        fault-tolerance story)."""
+        from go_crdt_playground_tpu.utils.checkpoint import save_checkpoint
+
+        with self._lock:
+            state = self._state
+        meta = dict(metadata or {})
+        meta.update(
+            actor=self.actor,
+            delta_semantics=self.delta_semantics,
+            strict_reference_semantics=self.strict_reference_semantics,
+        )
+        return save_checkpoint(path, state, metadata=meta)
+
+    @classmethod
+    def restore(cls, path: str, recorder=None) -> "Node":
+        """Recover a node from a checkpoint written by ``save`` — state,
+        actor identity, and semantics switches included.  The restored
+        node is not serving; call ``serve()`` to rejoin."""
+        from go_crdt_playground_tpu.utils.checkpoint import (
+            restore_checkpoint)
+
+        ck = restore_checkpoint(path)
+        meta = ck.metadata
+        node = cls(
+            actor=int(meta["actor"]),
+            num_elements=int(ck.state.present.shape[-1]),
+            num_actors=int(ck.state.vv.shape[-1]),
+            delta_semantics=meta["delta_semantics"],
+            strict_reference_semantics=meta["strict_reference_semantics"],
+            recorder=recorder,
+        )
+        node._state = ck.state
+        return node
+
     def close(self) -> None:
         self._closing = True
         if self._server_sock is not None:
