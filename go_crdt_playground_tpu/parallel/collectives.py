@@ -17,14 +17,17 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 # Fibonacci hashing multiplier (2^32 / golden ratio, odd) — good avalanche
-# for sequential element ids.
-_MIX = jnp.uint32(0x9E3779B1)
+# for sequential element ids.  Kept as a plain Python int: a module-scope
+# jnp.uint32(...) would create a device array at import time and initialize
+# whatever backend is the ambient default — which must never happen before
+# the caller has picked a platform (the round-1 dryrun hang).
+_MIX = 0x9E3779B1
 
 
 def _mix32(x: jnp.ndarray) -> jnp.ndarray:
     """xorshift-multiply mix of uint32 lanes."""
     x = x.astype(jnp.uint32)
-    x = (x ^ (x >> 16)) * _MIX
+    x = (x ^ (x >> 16)) * jnp.uint32(_MIX)
     x = (x ^ (x >> 13)) * jnp.uint32(0x85EBCA77)
     return x ^ (x >> 16)
 
