@@ -22,9 +22,15 @@ stand-in for the reference implementation.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+_HEADLINE_METRIC = "replica-pair merges/sec/chip (AWSet, 256 elems)"
+_HEADLINE_UNIT = "merges/sec/chip"
 
 
 def build_state(num_replicas: int, num_elements: int, num_writers: int):
@@ -285,10 +291,18 @@ def measure_config5(num_replicas=1_000_000, num_elements=256,
                   "fused lattice-join round",
         "value": round(2 * num_replicas / per_round, 1),
         "unit": "merges/sec/chip",
+        "note": "counts 2 merges per replica per round (1 full AWSet "
+                "dot-context merge + 1 2P-Set OR-join); the per-family "
+                "AWSet-only rate is value/2 as a lower bound — not "
+                "directly comparable to configs 2-4's single-family "
+                "accounting",
     }
 
 
 def run_ladder():
+    import jax
+
+    platform = jax.default_backend()
     spec_rate = measure_spec_baseline()
     results = [measure_config1(), measure_config2()]
     tpu_rate = measure_tpu()
@@ -301,15 +315,17 @@ def run_ladder():
     results.append(measure_config4())
     results.append(measure_config5())
     for r in results:
+        r["platform"] = platform
         print(json.dumps(r))
     with open("BENCH_LADDER.json", "w") as f:
         json.dump(results, f, indent=2)
     return results
 
 
-def main():
-    import sys
-
+def _child_main():
+    """The actual measurement, run inside a parent-supervised subprocess
+    (it may initialize a flaky remote-TPU backend and hang or die; the
+    parent owns the timeout and the driver-facing output contract)."""
     if "--ladder" in sys.argv:
         results = run_ladder()
         # the conformance anchor is the point of config 1: a ladder run
@@ -319,14 +335,108 @@ def main():
                   file=sys.stderr)
             sys.exit(1)
         return
+    import jax
+
     tpu_rate = measure_tpu()
     spec_rate = measure_spec_baseline()
     print(json.dumps({
-        "metric": "replica-pair merges/sec/chip (AWSet, 256 elems)",
+        "metric": _HEADLINE_METRIC,
         "value": round(tpu_rate, 1),
-        "unit": "merges/sec/chip",
+        "unit": _HEADLINE_UNIT,
         "vs_baseline": round(tpu_rate / spec_rate, 1),
+        "platform": jax.default_backend(),
     }))
+
+
+def _run_child(env, timeout_s):
+    """One supervised measurement attempt.  Returns (ok, stdout, why)."""
+    env = dict(env)
+    env["CRDT_BENCH_CHILD"] = "1"
+    try:
+        # cwd is inherited so artifacts (BENCH_LADDER.json) land in the
+        # invoker's directory, exactly as the pre-supervisor bench did
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+            env=env, timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return False, "", f"timeout after {timeout_s}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        return False, proc.stdout, (
+            f"rc={proc.returncode}: " + " | ".join(tail))
+    # sanity: every non-empty stdout line must be valid JSON
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    try:
+        for ln in lines:
+            json.loads(ln)
+    except ValueError:
+        return False, proc.stdout, "child printed non-JSON output"
+    if not lines:
+        return False, proc.stdout, "child printed nothing"
+    return True, proc.stdout, ""
+
+
+def main():
+    """Driver-facing supervisor.  Never initializes jax in this process;
+    never lets a backend failure surface as a bare traceback.  Attempt
+    ladder (round 1 lost its bench artifact to exactly that):
+
+      1. measure on the ambient platform (the real TPU under the driver),
+         with a hard timeout;
+      2. if that FAILED FAST (backend init error, not a hang), retry once
+         — tunnel flakes are transient;
+      3. default mode only: fall back to a CPU-pinned child so the driver
+         still records a real, honestly-labeled number;
+      4. otherwise print a parseable {"metric", "value": null, "error"}
+         line and exit nonzero.
+    """
+    if os.environ.get("CRDT_BENCH_CHILD") == "1":
+        _child_main()
+        return
+    ladder = "--ladder" in sys.argv
+    timeout_s = int(os.environ.get(
+        "CRDT_BENCH_TIMEOUT_S", "2700" if ladder else "900"))
+    errors = []
+
+    t0 = time.monotonic()
+    ok, out, why = _run_child(os.environ, timeout_s)
+    if ok:
+        sys.stdout.write(out)
+        return
+    errors.append(f"attempt1({why})")
+    if time.monotonic() - t0 < 0.5 * timeout_s:
+        # fast failure => likely transient backend-init error: retry once
+        time.sleep(15)
+        ok, out, why = _run_child(os.environ, timeout_s)
+        if ok:
+            sys.stdout.write(out)
+            return
+        errors.append(f"attempt2({why})")
+
+    if not ladder:
+        # CPU fallback keeps the round's artifact parseable and honest:
+        # the platform field says "cpu", vs_baseline stays the same
+        # single-core spec yardstick.
+        from __graft_entry__ import _scrubbed_cpu_env
+
+        ok, out, why = _run_child(_scrubbed_cpu_env(1), timeout_s)
+        if ok:
+            lines = [ln for ln in out.splitlines() if ln.strip()]
+            rec = json.loads(lines[-1])
+            rec["note"] = ("ambient (TPU) backend unavailable: "
+                           + "; ".join(errors) + " — CPU fallback")
+            print(json.dumps(rec))
+            return
+        errors.append(f"cpu-fallback({why})")
+
+    print(json.dumps({
+        "metric": ("measurement ladder (configs 1-5)" if ladder
+                   else _HEADLINE_METRIC),
+        "value": None,
+        "unit": _HEADLINE_UNIT,
+        "error": "; ".join(errors),
+    }))
+    sys.exit(1)
 
 
 if __name__ == "__main__":
