@@ -375,6 +375,23 @@ def _fused_rows(dst_arrays, src_arrays, block_e: int, interpret: bool):
             out_da[:num_r, :num_e], out_dc[:num_r, :num_e])
 
 
+def pallas_merge_pairwise_rows(dst: AWSetState, src: AWSetState, *,
+                               block_e: int = 512,
+                               interpret: bool | None = None) -> AWSetState:
+    """Batched dst[r] <- src[r] on the multi-row kernel — the pairwise
+    (no-gather) form of pallas_gossip_round_rows, bitwise-equal to
+    ops.merge.merge_pairwise.  This is the per-shard merge primitive for
+    shard_map rings: the partner block arrives by ppermute, so the kernel
+    needs no permutation at all and every grid step reads contiguous
+    rows."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    vv, p, da, dc = _fused_rows(_as_arrays(dst), _as_arrays(src),
+                                block_e, interpret)
+    return AWSetState(vv=vv, present=p != 0, dot_actor=da, dot_counter=dc,
+                      actor=dst.actor)
+
+
 def pallas_gossip_round_rows(state: AWSetState, perm, *,
                              block_e: int = 512,
                              interpret: bool | None = None) -> AWSetState:

@@ -102,9 +102,10 @@ def gossip_round(
     inside compiled loops (~40x slower, see ops/pallas_merge.py regime
     notes), so auto picks the multi-row fused kernel there.  auto stays
     on XLA when more than one device is visible — a bare pallas_call
-    has no GSPMD partitioning rule, so mesh programs must either keep
-    the XLA path or invoke the kernel per-shard inside shard_map
-    (kernel="pallas" explicitly).
+    has no GSPMD partitioning rule under an arbitrary perm; mesh
+    programs get the fused path through ring_round_shardmap (its auto
+    dispatch invokes the kernel per shard inside shard_map, so TPU
+    meshes never pay the XLA HasDot penalty on the ring schedule).
     """
     if kernel == "auto":
         from go_crdt_playground_tpu.ops.pallas_merge import MAX_FUSED_ACTORS
@@ -422,9 +423,16 @@ def rounds_to_convergence(
 
 
 @functools.lru_cache(maxsize=None)
-def _ring_step_compiled(mesh: Mesh, state_cls):
-    """Cached jitted shard_map ring step per (mesh, state type) — a fresh
-    jit per call would recompile the program every round."""
+def _ring_step_compiled(mesh: Mesh, state_cls, kernel: str):
+    """Cached jitted shard_map ring step per (mesh, state type, kernel) —
+    a fresh jit per call would recompile the program every round.
+
+    kernel="pallas" runs the fused multi-row merge kernel PER SHARD: the
+    partner block arrives by ppermute, so each device invokes
+    pallas_merge_pairwise_rows on its local rows — this is how mesh
+    programs get the fused path (a bare pallas_call has no GSPMD
+    partitioning rule, but inside shard_map the kernel only ever sees
+    the local block)."""
     n = mesh.shape[REPLICA_AXIS]
     pairs = [(i, (i + 1) % n) for i in range(n)]
     specs = partition_specs(state_cls)
@@ -432,11 +440,21 @@ def _ring_step_compiled(mesh: Mesh, state_cls):
     def step(local):
         recv = jax.tree.map(
             lambda x: jax.lax.ppermute(x, REPLICA_AXIS, pairs), local)
+        if kernel == "pallas":
+            from go_crdt_playground_tpu.ops.pallas_merge import (
+                pallas_merge_pairwise_rows)
+
+            return pallas_merge_pairwise_rows(local, recv)
         merged, _ = merge_pairwise(local, recv)
         return merged
 
+    # pallas_call's out_shape carries no varying-manual-axes annotation,
+    # so the vma consistency check can't see through it — disable it for
+    # the fused path (the bitwise-equality test vs the checked XLA path
+    # is the stronger guarantee anyway).
     return jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs)
+        jax.shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs,
+                      check_vma=(kernel != "pallas"))
     )
 
 
@@ -487,16 +505,27 @@ def ep_ring_round_shardmap(state: AWSetState, mesh: Mesh) -> AWSetState:
     return _ep_ring_step_compiled(mesh, type(state))(state)
 
 
-def ring_round_shardmap(state: AWSetState, mesh: Mesh) -> AWSetState:
+def ring_round_shardmap(state: AWSetState, mesh: Mesh,
+                        kernel: str = "auto") -> AWSetState:
     """One ring round with the communication pinned explicitly: each
     replica-shard ppermutes its whole block to the next device over the
     ring (ICI neighbor), then every replica merges with the received
     peer — the ring-anti-entropy schedule of SURVEY §5.7b, the set-merge
     analogue of ring attention's neighbor exchange.
 
+    kernel: "auto" runs the fused Pallas merge per shard on TPU meshes
+    (the v5e-4 fast path — no 40x XLA HasDot penalty on mesh programs),
+    XLA elsewhere; "pallas"/"xla" force a path.  All bitwise-identical
+    (pinned by tests/test_gossip.py on the CPU mesh in interpret mode).
+
     Full-state AWSet only: the merge kernel has no cross-element
     reductions, so an element-sharded block is self-contained.  (The δ
     kernel's strict mode reduces over E — route δ gossip through
     delta_gossip_round under jit instead, where XLA inserts the psum.)
     """
-    return _ring_step_compiled(mesh, type(state))(state)
+    if kernel == "auto":
+        from go_crdt_playground_tpu.ops.pallas_merge import MAX_FUSED_ACTORS
+
+        kernel = ("pallas" if jax.default_backend() == "tpu"
+                  and state.vv.shape[-1] <= MAX_FUSED_ACTORS else "xla")
+    return _ring_step_compiled(mesh, type(state), kernel)(state)

@@ -182,6 +182,27 @@ def test_ring_shardmap_matches_equivalent_gather_round():
     _assert_states_equal(ring, expected)
 
 
+def test_ring_shardmap_pallas_matches_xla():
+    """The per-shard fused Pallas ring (the TPU-mesh fast path,
+    VERDICT r1 #3) must agree bitwise with the XLA shard_map ring AND
+    the unsharded gather round — on the CPU test mesh the kernel runs
+    in interpret mode, on real TPU it is the Mosaic program."""
+    import random
+    rng = random.Random(23)
+    R = 16
+    for shape in ((8, 1), (4, 2)):
+        state = _random_state(rng, R=R, E=32)
+        m = mesh_mod.make_mesh(shape)
+        sharded = mesh_mod.shard_state(state, m)
+        fused = gossip.ring_round_shardmap(sharded, m, kernel="pallas")
+        plain = gossip.ring_round_shardmap(sharded, m, kernel="xla")
+        _assert_states_equal(fused, plain, f"mesh {shape}")
+        shard_size = R // shape[0]
+        perm = (jnp.arange(R, dtype=jnp.uint32) - shard_size) % R
+        _assert_states_equal(fused, gossip.gossip_round_jit(state, perm),
+                             f"mesh {shape} vs gather")
+
+
 def test_ep_ring_matches_replicated_actor_ring():
     """EP layout (vv's actor axis sharded over the mesh element dim,
     SURVEY §2.3 EP row) must be invisible in the results: the EP ring
