@@ -144,11 +144,18 @@ def execute_merge(req: pb.MergeRequest) -> pb.MergeResponse:
 class MergerServer:
     """Serve the Merger service over the Go-friendly TCP framing."""
 
+    # Half-open clients must not pin threads forever (a partial frame
+    # used to park recv_frame indefinitely), and connection threads are
+    # capped so a misbehaving client can't grow one thread per dial.
+    CONN_TIMEOUT_S = 120.0
+    MAX_CONNS = 64
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.host = host
         self.port = port
         self._sock: Optional[socket.socket] = None
         self._closing = threading.Event()
+        self._conn_slots = threading.BoundedSemaphore(self.MAX_CONNS)
 
     def serve(self) -> Tuple[str, int]:
         """Bind + start accepting on a daemon thread; returns (host, port)."""
@@ -164,20 +171,32 @@ class MergerServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return  # closed
+            if not self._conn_slots.acquire(blocking=False):
+                conn.close()  # at capacity: shed load instead of queueing
+                continue
             # daemonic and unretained: connection threads die with their
             # socket, so a long-lived server doesn't accumulate objects
             threading.Thread(
                 target=self._handle_conn, args=(conn,), daemon=True).start()
 
     def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            self._serve_conn(conn)
+        finally:
+            self._conn_slots.release()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.settimeout(self.CONN_TIMEOUT_S)
         with conn:
             while True:
                 try:
                     method, body = recv_frame(conn)
                 except (ConnectionError, OSError):
+                    # includes socket.timeout: an idle/half-open client is
+                    # disconnected instead of pinning this thread forever
                     return
                 if method == METHOD_PING:
-                    send_frame(conn, METHOD_PING, b"")
+                    reply = (METHOD_PING, b"")
                 elif method == METHOD_MERGE:
                     req = pb.MergeRequest()
                     try:
@@ -185,10 +204,16 @@ class MergerServer:
                         resp = execute_merge(req)
                     except Exception as exc:  # malformed proto, kernel error
                         resp = pb.MergeResponse(error=repr(exc))
-                    send_frame(conn, METHOD_MERGE, resp.SerializeToString())
+                    reply = (METHOD_MERGE, resp.SerializeToString())
                 else:
                     resp = pb.MergeResponse(error=f"unknown method {method}")
-                    send_frame(conn, method, resp.SerializeToString())
+                    reply = (method, resp.SerializeToString())
+                try:
+                    send_frame(conn, *reply)
+                except (ConnectionError, OSError):
+                    # a client that stops reading (full TCP window) times
+                    # out here too — drop it, don't kill the thread noisily
+                    return
 
     def close(self) -> None:
         self._closing.set()

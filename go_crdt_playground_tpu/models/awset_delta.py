@@ -121,6 +121,35 @@ def add_element(state: AWSetDeltaState, replica: jnp.ndarray,
 
 
 @jax.jit
+def add_elements(state: AWSetDeltaState, replica: jnp.ndarray,
+                 elements: jnp.ndarray) -> AWSetDeltaState:
+    """Batched ``Add(k...)``: ONE dispatch for the whole call, exactly
+    the per-key loop semantics of awset.go:89-94 — the clock ticks once
+    per key occurrence (position i gets counter vv[r,a]+1+i), and a key
+    appearing twice keeps its LAST occurrence's dot (the loop overwrites).
+
+    elements: uint32[K] element ids (K static per call shape)."""
+    r = replica.astype(jnp.int32)
+    a = state.actor[r].astype(jnp.int32)
+    base = state.vv[r, a]
+    k = elements.shape[0]
+    # last-occurrence position (1-based) per touched element lane
+    pos1 = jnp.zeros(state.num_elements, jnp.uint32).at[elements].max(
+        jnp.arange(1, k + 1, dtype=jnp.uint32))
+    touched = pos1 > 0
+    new_vv = base + jnp.uint32(k)
+    return state._replace(
+        vv=state.vv.at[r, a].set(new_vv),
+        present=state.present.at[r].set(state.present[r] | touched),
+        dot_actor=state.dot_actor.at[r].set(
+            jnp.where(touched, state.actor[r], state.dot_actor[r])),
+        dot_counter=state.dot_counter.at[r].set(
+            jnp.where(touched, base + pos1, state.dot_counter[r])),
+        processed=state.processed.at[r, a].set(new_vv),
+    )
+
+
+@jax.jit
 def del_elements(state: AWSetDeltaState, replica: jnp.ndarray,
                  selector: jnp.ndarray) -> AWSetDeltaState:
     """δ-state ``Del`` (awset-delta_test.go:14-33): ticks the clock ONCE

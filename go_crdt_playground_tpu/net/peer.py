@@ -80,7 +80,9 @@ class Node:
     # -- local ops (reference Add/Del, awset.go:89-101 δ-variant) ----------
 
     def add(self, *element_ids: int) -> None:
-        """Add elements; each ticks the clock once (awset.go:89-94)."""
+        """Add elements; each ticks the clock once (awset.go:89-94).
+        One fused add_elements dispatch for the whole call (the
+        del_elements selector pattern applied to the add path)."""
         import jax.numpy as jnp
 
         from go_crdt_playground_tpu.models import awset_delta
@@ -89,10 +91,12 @@ class Node:
             if not 0 <= e < self.num_elements:
                 raise ValueError(f"element id {e} outside universe "
                                  f"{self.num_elements}")
+        if not element_ids:
+            return
         with self._lock:
-            for e in element_ids:
-                self._state = awset_delta.add_element(
-                    self._state, jnp.uint32(0), jnp.uint32(e))
+            self._state = awset_delta.add_elements(
+                self._state, jnp.uint32(0),
+                jnp.asarray(element_ids, jnp.uint32))
 
     def delete(self, *element_ids: int) -> None:
         """δ-Del: one clock tick per call, one shared deletion dot for all
@@ -292,6 +296,15 @@ class Node:
 
         ck = restore_checkpoint(path)
         meta = ck.metadata
+        missing = [k for k in
+                   ("actor", "delta_semantics", "strict_reference_semantics")
+                   if k not in meta]
+        if missing:
+            raise ValueError(
+                f"checkpoint at {path!r} lacks node metadata {missing}: "
+                "Node.restore requires a checkpoint written by Node.save "
+                "(a bare utils.checkpoint.save_checkpoint file has state "
+                "only — restore it with restore_checkpoint instead)")
         node = cls(
             actor=int(meta["actor"]),
             num_elements=int(ck.state.present.shape[-1]),

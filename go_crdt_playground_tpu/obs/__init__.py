@@ -16,7 +16,7 @@ from go_crdt_playground_tpu.obs.metrics import Recorder, payload_metrics  # noqa
 # (net.Node defers jax the same way) light by lazy-loading the renderers.
 _TRACE_EXPORTS = frozenset({
     "format_event", "render_spec_trace", "render_tensor_trace",
-    "render_delta_tensor_trace", "trace_counts",
+    "render_delta_tensor_trace", "trace_counts", "printstate",
 })
 
 __all__ = ["Recorder", "payload_metrics", *sorted(_TRACE_EXPORTS)]

@@ -191,6 +191,25 @@ def render_delta_tensor_trace(
     return lines
 
 
+# The fixtures' boxed dump rule: exactly 48 em-dashes (awset_test.go:170).
+_BOX_RULE = "—" * 48
+
+
+def printstate(replicas, names: Optional[Sequence[str]] = None) -> str:
+    """The test fixtures' boxed replica dump (awset_test.go:169-174),
+    byte-identical for two replicas named A and B and generalized to any
+    replica count.  ``replicas`` are spec AWSets (rendered via their
+    canonical String) or pre-rendered strings (e.g. utils.codec.
+    render_packed output for the tensor path)."""
+    if names is None:
+        names = [chr(ord("A") + i) for i in range(len(replicas))]
+    lines = [_BOX_RULE]
+    for name, rep in zip(names, replicas):
+        lines.append(f"Replica {name}: {rep}")
+    lines.append(_BOX_RULE)
+    return "\n".join(lines) + "\n"
+
+
 def trace_counts(trace: MergeTrace) -> Dict[str, Dict[str, int]]:
     """Outcome histograms per phase — the aggregate view that replaces
     stdout-scraping for bulk merges (works on batched traces too)."""

@@ -57,18 +57,28 @@ def save_checkpoint_sharded(
         {f: getattr(state, f) for f in fields},
         force=True,
     )
-    manifest = {
-        "format_version": _FORMAT_VERSION,
-        "state_type": type(state).__name__,
-        "fields": list(fields),
-        "step": step,
-        "metadata": metadata or {},
-        "dictionary": dictionary.state_dict() if dictionary else None,
-    }
-    tmp = os.path.join(path, ".manifest-tmp")
-    with open(tmp, "w") as f:
-        json.dump(manifest, f, sort_keys=True)
-    os.replace(tmp, os.path.join(path, "manifest.json"))
+    # On a multi-host mesh over shared storage only process 0 writes the
+    # manifest (orbax already coordinates a single writer internally; the
+    # manifest must not race N hosts on one file).
+    if jax.process_index() == 0:
+        manifest = {
+            "format_version": _FORMAT_VERSION,
+            "state_type": type(state).__name__,
+            "fields": list(fields),
+            "step": step,
+            "metadata": metadata or {},
+            "dictionary": dictionary.state_dict() if dictionary else None,
+        }
+        tmp = os.path.join(path, ".manifest-tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, sort_keys=True)
+        os.replace(tmp, os.path.join(path, "manifest.json"))
+    if jax.process_count() > 1:
+        # no host may return (and e.g. signal "checkpoint done" or start
+        # a restore) before process 0's manifest is on shared storage
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("crdt_sharded_ckpt_manifest")
     return path
 
 

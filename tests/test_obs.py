@@ -189,3 +189,29 @@ def test_payload_metrics():
     assert m["changed_lanes"] == 2
     assert m["deleted_lanes"] == 0
     assert 0 < m["wire_bytes"] < m["dense_bytes"]
+
+
+def test_printstate_box_dump_parity():
+    """The fixtures' boxed dump (awset_test.go:169-174): 48-em-dash rule,
+    'Replica A: %s' lines with the canonical String — byte-identical for
+    the 2-replica fixture shape, and the tensor path's render_packed
+    strings drop in for the spec renderings."""
+    from go_crdt_playground_tpu.obs import printstate
+
+    a = AWSet(actor=0, version_vector=VersionVector([0, 0]))
+    b = AWSet(actor=1, version_vector=VersionVector([0, 0]))
+    a.add("Anne", "Bob")
+    b.merge(a)
+    b.del_("Bob")
+    out = printstate([a, b])
+    rule = "—" * 48
+    expected = (f"{rule}\n"
+                f"Replica A: {a}\n"
+                f"Replica B: {b}\n"
+                f"{rule}\n")
+    assert out == expected
+    # the packed tensor path renders identically (codec canonical String)
+    dictionary = codec.ElementDict(capacity=4)
+    packed = awset.from_arrays(codec.pack_awsets([a, b], dictionary, 2))
+    rendered = codec.render_packed(awset.to_arrays(packed), dictionary)
+    assert printstate(rendered) == expected
