@@ -1,0 +1,3 @@
+module crdtbridge-client
+
+go 1.21

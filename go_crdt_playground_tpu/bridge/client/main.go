@@ -1,0 +1,518 @@
+// Command crdtbridge-client drives the go_crdt_playground_tpu Merger
+// bridge from Go, replaying the reference repository's full-state AWSet
+// scenarios (/root/reference/awset_test.go:10-122 — TestAWSetXXX,
+// TestAWSet, TestAWSetConcurrentAddWinsOverDelete) with EVERY
+// dst.Merge(src) executed by the framework's packed TPU merge kernel,
+// reached over the plain-TCP framing of bridge/service.py:
+//
+//	frame = method(1 byte) | length(uint32 big-endian) | proto body
+//	merge = method 0x01, body crdtbridge.MergeRequest
+//	ping  = method 0x02, empty body, echoed
+//
+// Local ops (Add/Del/Clone) run client-side exactly as the reference
+// fixture does (awset_test.go:156-174); the merge decision logic never
+// runs here — the point is that the framework, not this client, computes
+// every merge, and this program checks memberships and the canonical
+// rendering against the reference tests' expectations.
+//
+// The proto bytes are emitted DETERMINISTICALLY so that
+// tests/test_bridge_client.py can replay the byte-identical stream from
+// Python against a live MergerServer:
+//   - fields in ascending tag order;
+//   - map entries sorted by key before encoding;
+//   - proto3 zero values omitted; repeated uint64 packed.
+//
+// No Go toolchain exists in the build image (SURVEY preamble), so CI
+// exercises this byte stream via tests/test_bridge_client.py; run it for
+// real with:
+//
+//	python -m go_crdt_playground_tpu serve   # prints host:port
+//	cd go_crdt_playground_tpu/bridge/client && go run . -addr HOST:PORT
+package main
+
+import (
+	"bytes"
+	"encoding/binary"
+	"flag"
+	"fmt"
+	"net"
+	"os"
+	"sort"
+	"strconv"
+)
+
+const (
+	methodMerge = 0x01
+	methodPing  = 0x02
+)
+
+// ---------------------------------------------------------------------------
+// Client-side replica model: local ops only (awset.go:89-101 semantics).
+// ---------------------------------------------------------------------------
+
+type dot struct {
+	Actor   uint32
+	Counter uint64
+}
+
+type replica struct {
+	Actor   uint32
+	VV      []uint64
+	Entries map[string]dot
+}
+
+func newReplica(actor uint32, actors int) *replica {
+	return &replica{
+		Actor:   actor,
+		VV:      make([]uint64, actors),
+		Entries: map[string]dot{},
+	}
+}
+
+// add ticks the clock once per key and stamps the birth dot
+// (awset.go:89-94; re-add overwrites the dot).
+func (r *replica) add(keys ...string) {
+	for _, k := range keys {
+		r.VV[r.Actor]++
+		r.Entries[k] = dot{r.Actor, r.VV[r.Actor]}
+	}
+}
+
+// del removes without ticking the clock (awset.go:96-101: the increment
+// is commented out in the reference; causality rides on the VV).
+func (r *replica) del(keys ...string) {
+	for _, k := range keys {
+		delete(r.Entries, k)
+	}
+}
+
+func (r *replica) clone() *replica {
+	c := newReplica(r.Actor, len(r.VV))
+	copy(c.VV, r.VV)
+	for k, d := range r.Entries {
+		c.Entries[k] = d
+	}
+	return c
+}
+
+func (r *replica) sortedValues() []string {
+	vals := make([]string, 0, len(r.Entries))
+	for k := range r.Entries {
+		vals = append(vals, k)
+	}
+	sort.Strings(vals)
+	return vals
+}
+
+// String reproduces the canonical rendering (awset.go:163-171,
+// crdt-misc.go:17-19,57-68):  [(A 1), (B 2)]\n  (A 1)  "Alice"\n  ...
+func (r *replica) String() string {
+	var b bytes.Buffer
+	b.WriteByte('[')
+	for i, n := range r.VV {
+		if i > 0 {
+			b.WriteString(", ")
+		}
+		fmt.Fprintf(&b, "(%c %d)", rune('A'+i), n)
+	}
+	b.WriteByte(']')
+	for _, k := range r.sortedValues() {
+		d := r.Entries[k]
+		fmt.Fprintf(&b, "\n  (%c %d)  %s",
+			rune('A'+d.Actor), d.Counter, strconv.Quote(k))
+	}
+	return b.String()
+}
+
+// ---------------------------------------------------------------------------
+// Minimal deterministic proto3 wire encoding (merger.proto messages only).
+// ---------------------------------------------------------------------------
+
+func putVarint(b *bytes.Buffer, v uint64) {
+	var tmp [binary.MaxVarintLen64]byte
+	b.Write(tmp[:binary.PutUvarint(tmp[:], v)])
+}
+
+func putTag(b *bytes.Buffer, field, wire uint64) {
+	putVarint(b, field<<3|wire)
+}
+
+func putLenField(b *bytes.Buffer, field uint64, payload []byte) {
+	putTag(b, field, 2)
+	putVarint(b, uint64(len(payload)))
+	b.Write(payload)
+}
+
+func encodeDot(d dot) []byte {
+	var b bytes.Buffer
+	if d.Actor != 0 {
+		putTag(&b, 1, 0)
+		putVarint(&b, uint64(d.Actor))
+	}
+	if d.Counter != 0 {
+		putTag(&b, 2, 0)
+		putVarint(&b, d.Counter)
+	}
+	return b.Bytes()
+}
+
+func encodeEntry(key string, d dot) []byte {
+	var b bytes.Buffer
+	putLenField(&b, 1, []byte(key))
+	putLenField(&b, 2, encodeDot(d))
+	return b.Bytes()
+}
+
+func encodeReplica(r *replica) []byte {
+	var b bytes.Buffer
+	if r.Actor != 0 {
+		putTag(&b, 1, 0)
+		putVarint(&b, uint64(r.Actor))
+	}
+	if len(r.VV) > 0 { // repeated uint64 -> packed
+		var packed bytes.Buffer
+		for _, n := range r.VV {
+			putVarint(&packed, n)
+		}
+		putLenField(&b, 2, packed.Bytes())
+	}
+	for _, k := range r.sortedValues() { // deterministic entry order
+		putLenField(&b, 3, encodeEntry(k, r.Entries[k]))
+	}
+	return b.Bytes()
+}
+
+func encodeMergeRequest(dst, src *replica) []byte {
+	var b bytes.Buffer
+	putLenField(&b, 1, encodeReplica(dst))
+	putLenField(&b, 2, encodeReplica(src))
+	// delta=false, delta_semantics="", strict=false: proto3 zero values,
+	// omitted — the full-state AWSet.Merge path (awset.go:103).
+	return b.Bytes()
+}
+
+// ---------------------------------------------------------------------------
+// Minimal proto3 wire decoding for MergeResponse.
+// ---------------------------------------------------------------------------
+
+type wireReader struct {
+	buf []byte
+	pos int
+}
+
+func (w *wireReader) done() bool { return w.pos >= len(w.buf) }
+
+func (w *wireReader) varint() uint64 {
+	v, n := binary.Uvarint(w.buf[w.pos:])
+	if n <= 0 {
+		fatalf("malformed varint at %d", w.pos)
+	}
+	w.pos += n
+	return v
+}
+
+func (w *wireReader) lenField() []byte {
+	n := int(w.varint())
+	if w.pos+n > len(w.buf) {
+		fatalf("truncated length-delimited field at %d", w.pos)
+	}
+	out := w.buf[w.pos : w.pos+n]
+	w.pos += n
+	return out
+}
+
+func (w *wireReader) skip(wire uint64) {
+	switch wire {
+	case 0:
+		w.varint()
+	case 1:
+		w.pos += 8
+	case 2:
+		w.lenField()
+	case 5:
+		w.pos += 4
+	default:
+		fatalf("unsupported wire type %d", wire)
+	}
+}
+
+func decodeDot(buf []byte) dot {
+	w := wireReader{buf: buf}
+	var d dot
+	for !w.done() {
+		tag := w.varint()
+		switch tag >> 3 {
+		case 1:
+			d.Actor = uint32(w.varint())
+		case 2:
+			d.Counter = w.varint()
+		default:
+			w.skip(tag & 7)
+		}
+	}
+	return d
+}
+
+func decodeReplica(buf []byte) *replica {
+	w := wireReader{buf: buf}
+	r := &replica{Entries: map[string]dot{}}
+	for !w.done() {
+		tag := w.varint()
+		switch tag >> 3 {
+		case 1:
+			r.Actor = uint32(w.varint())
+		case 2:
+			if tag&7 == 2 { // packed
+				p := wireReader{buf: w.lenField()}
+				for !p.done() {
+					r.VV = append(r.VV, p.varint())
+				}
+			} else { // unpacked writer
+				r.VV = append(r.VV, w.varint())
+			}
+		case 3:
+			e := wireReader{buf: w.lenField()}
+			var key string
+			var d dot
+			for !e.done() {
+				etag := e.varint()
+				switch etag >> 3 {
+				case 1:
+					key = string(e.lenField())
+				case 2:
+					d = decodeDot(e.lenField())
+				default:
+					e.skip(etag & 7)
+				}
+			}
+			r.Entries[key] = d
+		default:
+			w.skip(tag & 7)
+		}
+	}
+	return r
+}
+
+type mergeResponse struct {
+	Merged       *replica
+	SortedValues []string
+	Canonical    string
+	Err          string
+}
+
+func decodeMergeResponse(buf []byte) mergeResponse {
+	w := wireReader{buf: buf}
+	var resp mergeResponse
+	for !w.done() {
+		tag := w.varint()
+		switch tag >> 3 {
+		case 1:
+			resp.Merged = decodeReplica(w.lenField())
+		case 2:
+			resp.SortedValues = append(resp.SortedValues,
+				string(w.lenField()))
+		case 3:
+			resp.Canonical = string(w.lenField())
+		case 4:
+			resp.Err = string(w.lenField())
+		default:
+			w.skip(tag & 7)
+		}
+	}
+	return resp
+}
+
+// ---------------------------------------------------------------------------
+// Framing + the remote Merge call.
+// ---------------------------------------------------------------------------
+
+func sendFrame(conn net.Conn, method byte, body []byte) {
+	hdr := make([]byte, 5)
+	hdr[0] = method
+	binary.BigEndian.PutUint32(hdr[1:], uint32(len(body)))
+	if _, err := conn.Write(append(hdr, body...)); err != nil {
+		fatalf("send: %v", err)
+	}
+}
+
+func recvFrame(conn net.Conn) (byte, []byte) {
+	hdr := make([]byte, 5)
+	if _, err := readFull(conn, hdr); err != nil {
+		fatalf("recv header: %v", err)
+	}
+	body := make([]byte, binary.BigEndian.Uint32(hdr[1:]))
+	if _, err := readFull(conn, body); err != nil {
+		fatalf("recv body: %v", err)
+	}
+	return hdr[0], body
+}
+
+func readFull(conn net.Conn, buf []byte) (int, error) {
+	total := 0
+	for total < len(buf) {
+		n, err := conn.Read(buf[total:])
+		if err != nil {
+			return total, err
+		}
+		total += n
+	}
+	return total, nil
+}
+
+// merge performs dst.Merge(src) on the server: the framework's packed
+// kernel computes the result, which replaces dst's state client-side.
+func merge(conn net.Conn, dst, src *replica) {
+	sendFrame(conn, methodMerge, encodeMergeRequest(dst, src))
+	method, body := recvFrame(conn)
+	if method != methodMerge {
+		fatalf("unexpected reply method %#x", method)
+	}
+	resp := decodeMergeResponse(body)
+	if resp.Err != "" {
+		fatalf("server merge error: %s", resp.Err)
+	}
+	dst.VV = resp.Merged.VV
+	dst.Entries = resp.Merged.Entries
+	// cross-language rendering parity: the server's canonical String
+	// (utils/codec.render_packed) must equal this client's Go rendering
+	if got := dst.String(); got != resp.Canonical {
+		fatalf("canonical mismatch:\nserver: %q\nclient: %q",
+			resp.Canonical, got)
+	}
+}
+
+// ---------------------------------------------------------------------------
+// Scenario replay (awset_test.go:10-122).
+// ---------------------------------------------------------------------------
+
+var failures int
+
+func fatalf(format string, args ...interface{}) {
+	fmt.Fprintf(os.Stderr, "FATAL: "+format+"\n", args...)
+	os.Exit(1)
+}
+
+func assertEntries(name string, r *replica, expected ...string) {
+	sort.Strings(expected)
+	got := r.sortedValues()
+	ok := len(got) == len(expected)
+	if ok {
+		for i := range got {
+			if got[i] != expected[i] {
+				ok = false
+				break
+			}
+		}
+	}
+	if !ok {
+		failures++
+		fmt.Fprintf(os.Stderr, "FAIL %s: expected %v, got %v\n",
+			name, expected, got)
+	}
+}
+
+// testAWSetXXX replays awset_test.go:10-29.
+func testAWSetXXX(conn net.Conn) {
+	A, B := newReplica(0, 2), newReplica(1, 2)
+	A.add("A", "B", "C")
+	B.add("A", "B", "C")
+	merge(conn, A, B)
+	merge(conn, B, A)
+	assertEntries("XXX/A", A, "A", "B", "C")
+	assertEntries("XXX/B", B, "A", "B", "C")
+
+	A.del("B")
+	B.add("B")
+	merge(conn, B, A)
+	merge(conn, A, B)
+	assertEntries("XXX/A2", A, "A", "B", "C")
+	assertEntries("XXX/B2", B, "A", "B", "C") // concurrent writer wins
+}
+
+// testAWSet replays awset_test.go:31-83.
+func testAWSet(conn net.Conn) {
+	A, B := newReplica(0, 2), newReplica(1, 2)
+	assertEntries("AWSet/A-empty", A)
+	assertEntries("AWSet/B-empty", B)
+
+	A.add("Shelly")
+	assertEntries("AWSet/A1", A, "Shelly")
+	merge(conn, B, A)
+	assertEntries("AWSet/B1", B, "Shelly")
+
+	B.add("Bob", "Phil", "Pete")
+	merge(conn, A, B)
+	assertEntries("AWSet/A2", A, "Shelly", "Bob", "Phil", "Pete")
+
+	A.del("Phil")
+	A.add("Bob") // update
+	A.add("Anna")
+	merge(conn, B, A)
+	assertEntries("AWSet/A3", A, "Shelly", "Bob", "Pete", "Anna")
+	assertEntries("AWSet/B3", B, "Shelly", "Bob", "Pete", "Anna")
+
+	A.del("Bob", "Pete")
+	B.del("Bob", "Shelly")
+	merge(conn, A, B)
+	merge(conn, B, A)
+	assertEntries("AWSet/A4", A, "Anna")
+	assertEntries("AWSet/B4", B, "Anna")
+
+	A.add("A", "B", "C")
+	A.del("A")
+	A.add("A")
+	merge(conn, B, A)
+	assertEntries("AWSet/A5", A, "Anna", "A", "B", "C")
+	assertEntries("AWSet/B5", B, "Anna", "A", "B", "C")
+}
+
+// testConcurrentAddWins replays awset_test.go:85-122.
+func testConcurrentAddWins(conn net.Conn) {
+	A, B := newReplica(0, 2), newReplica(1, 2)
+	A.add("Anne", "Bob")
+	B.add("Anne")
+	// fork state: concurrent add vs delete -> writer wins
+	A2, B2 := A.clone(), B.clone()
+	B2.add("Bob")
+	A2.del("Bob")
+	merge(conn, B2, A2)
+	merge(conn, A2, B2)
+	assertEntries("Conc/B-fork", B2, "Anne", "Bob")
+	assertEntries("Conc/A-fork", A2, "Anne", "Bob")
+
+	// merge before delete: non-concurrent delete sticks
+	B.add("Bob")
+	merge(conn, B, A)
+	A.del("Bob")
+	merge(conn, B, A)
+	merge(conn, A, B)
+	assertEntries("Conc/B-seq", B, "Anne")
+	assertEntries("Conc/A-seq", A, "Anne")
+}
+
+func main() {
+	addr := flag.String("addr", "127.0.0.1:7777",
+		"MergerServer host:port (python -m go_crdt_playground_tpu serve)")
+	flag.Parse()
+
+	conn, err := net.Dial("tcp", *addr)
+	if err != nil {
+		fatalf("dial %s: %v", *addr, err)
+	}
+	defer conn.Close()
+
+	sendFrame(conn, methodPing, nil)
+	if m, _ := recvFrame(conn); m != methodPing {
+		fatalf("ping not echoed (method %#x)", m)
+	}
+
+	testAWSetXXX(conn)
+	testAWSet(conn)
+	testConcurrentAddWins(conn)
+
+	if failures > 0 {
+		fmt.Fprintf(os.Stderr, "%d assertion(s) failed\n", failures)
+		os.Exit(1)
+	}
+	fmt.Println("ok: T1-T3 replayed through the framework merge kernel")
+}

@@ -22,6 +22,11 @@ One TCP connection carries any number of frames.  When grpcio is
 installed the same messages are served as proper gRPC instead
 (``serve_grpc``); the proto file carries the service definition either
 way.
+
+A complete Go client lives at ``bridge/client/main.go``: it replays the
+reference's T1-T3 test scenarios with every merge computed by this
+server.  CI (which has no Go toolchain) exercises its exact byte stream
+via tests/test_bridge_client.py.
 """
 
 from __future__ import annotations
@@ -147,15 +152,22 @@ class MergerServer:
     # Half-open clients must not pin threads forever (a partial frame
     # used to park recv_frame indefinitely), and connection threads are
     # capped so a misbehaving client can't grow one thread per dial.
+    # Long-lived deployments whose clients legitimately idle past the
+    # default should raise conn_timeout_s (or send periodic PING frames).
     CONN_TIMEOUT_S = 120.0
     MAX_CONNS = 64
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 conn_timeout_s: Optional[float] = None,
+                 max_conns: Optional[int] = None):
         self.host = host
         self.port = port
+        self.conn_timeout_s = (self.CONN_TIMEOUT_S if conn_timeout_s is None
+                               else conn_timeout_s)
         self._sock: Optional[socket.socket] = None
         self._closing = threading.Event()
-        self._conn_slots = threading.BoundedSemaphore(self.MAX_CONNS)
+        self._conn_slots = threading.BoundedSemaphore(
+            self.MAX_CONNS if max_conns is None else max_conns)
 
     def serve(self) -> Tuple[str, int]:
         """Bind + start accepting on a daemon thread; returns (host, port)."""
@@ -186,14 +198,15 @@ class MergerServer:
             self._conn_slots.release()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        conn.settimeout(self.CONN_TIMEOUT_S)
+        conn.settimeout(self.conn_timeout_s)
         with conn:
             while True:
                 try:
                     method, body = recv_frame(conn)
-                except (ConnectionError, OSError):
-                    # includes socket.timeout: an idle/half-open client is
-                    # disconnected instead of pinning this thread forever
+                except (ConnectionError, OSError, ValueError):
+                    # OSError includes socket.timeout (idle/half-open
+                    # client); ValueError is an oversized frame length —
+                    # all are shed quietly instead of killing the thread
                     return
                 if method == METHOD_PING:
                     reply = (METHOD_PING, b"")

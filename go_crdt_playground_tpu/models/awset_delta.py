@@ -122,22 +122,31 @@ def add_element(state: AWSetDeltaState, replica: jnp.ndarray,
 
 @jax.jit
 def add_elements(state: AWSetDeltaState, replica: jnp.ndarray,
-                 elements: jnp.ndarray) -> AWSetDeltaState:
+                 elements: jnp.ndarray,
+                 count: jnp.ndarray | None = None) -> AWSetDeltaState:
     """Batched ``Add(k...)``: ONE dispatch for the whole call, exactly
     the per-key loop semantics of awset.go:89-94 — the clock ticks once
     per key occurrence (position i gets counter vv[r,a]+1+i), and a key
     appearing twice keeps its LAST occurrence's dot (the loop overwrites).
 
-    elements: uint32[K] element ids (K static per call shape)."""
+    elements: uint32[K] element ids (K static per call shape).  count:
+    optional traced scalar — only the first ``count`` positions are real,
+    the rest padding; callers bucket K (e.g. to powers of two) so varying
+    arities reuse one compiled program instead of one per K."""
     r = replica.astype(jnp.int32)
     a = state.actor[r].astype(jnp.int32)
     base = state.vv[r, a]
     k = elements.shape[0]
+    pos = jnp.arange(1, k + 1, dtype=jnp.uint32)
+    if count is None:
+        count = jnp.uint32(k)
+    else:
+        count = count.astype(jnp.uint32)
+        pos = jnp.where(pos <= count, pos, 0)  # padding: max-identity
     # last-occurrence position (1-based) per touched element lane
-    pos1 = jnp.zeros(state.num_elements, jnp.uint32).at[elements].max(
-        jnp.arange(1, k + 1, dtype=jnp.uint32))
+    pos1 = jnp.zeros(state.num_elements, jnp.uint32).at[elements].max(pos)
     touched = pos1 > 0
-    new_vv = base + jnp.uint32(k)
+    new_vv = base + count
     return state._replace(
         vv=state.vv.at[r, a].set(new_vv),
         present=state.present.at[r].set(state.present[r] | touched),

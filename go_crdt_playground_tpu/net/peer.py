@@ -93,10 +93,16 @@ class Node:
                                  f"{self.num_elements}")
         if not element_ids:
             return
+        # bucket the call shape to the next power of two so varying
+        # arities reuse one compiled program per bucket, not one per K
+        k = len(element_ids)
+        bucket = 1 << (k - 1).bit_length()
+        padded = np.zeros(bucket, np.uint32)
+        padded[:k] = element_ids
         with self._lock:
             self._state = awset_delta.add_elements(
-                self._state, jnp.uint32(0),
-                jnp.asarray(element_ids, jnp.uint32))
+                self._state, jnp.uint32(0), jnp.asarray(padded),
+                jnp.uint32(k))
 
     def delete(self, *element_ids: int) -> None:
         """δ-Del: one clock tick per call, one shared deletion dot for all

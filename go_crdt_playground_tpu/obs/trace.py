@@ -203,6 +203,10 @@ def printstate(replicas, names: Optional[Sequence[str]] = None) -> str:
     render_packed output for the tensor path)."""
     if names is None:
         names = [chr(ord("A") + i) for i in range(len(replicas))]
+    elif len(names) != len(replicas):
+        raise ValueError(
+            f"{len(names)} names for {len(replicas)} replicas — a debug "
+            "dump must never silently drop state")
     lines = [_BOX_RULE]
     for name, rep in zip(names, replicas):
         lines.append(f"Replica {name}: {rep}")

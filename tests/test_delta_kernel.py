@@ -212,27 +212,32 @@ def test_add_elements_batch_matches_sequential_adds():
     analogue of the del_elements selector — VERDICT r1 #8) must be
     bitwise the per-key add_element loop, including the duplicate-key
     case where the loop's later tick overwrites the earlier dot."""
-    for ids in ([3, 7, 1], [5], [2, 9, 2, 4, 2], list(range(12))):
-        seq = awset_delta.init(2, 16, 2)
-        bat = awset_delta.init(2, 16, 2)
+    def seed(st):
         # pre-existing foreign-actor dot with a high counter: the batched
         # overwrite must NOT keep it (Add overwrites unconditionally)
-        for st_name in ("seq", "bat"):
-            st = locals()[st_name]
-            st = st._replace(
-                present=st.present.at[0, 9].set(True),
-                dot_actor=st.dot_actor.at[0, 9].set(1),
-                dot_counter=st.dot_counter.at[0, 9].set(100),
-            )
-            if st_name == "seq":
-                seq = st
-            else:
-                bat = st
+        return st._replace(
+            present=st.present.at[0, 9].set(True),
+            dot_actor=st.dot_actor.at[0, 9].set(1),
+            dot_counter=st.dot_counter.at[0, 9].set(100),
+        )
+
+    for ids in ([3, 7, 1], [5], [2, 9, 2, 4, 2], list(range(12))):
+        seq = seed(awset_delta.init(2, 16, 2))
+        bat = seed(awset_delta.init(2, 16, 2))
+        pad = seed(awset_delta.init(2, 16, 2))
         for e in ids:
             seq = awset_delta.add_element(seq, np.uint32(0), np.uint32(e))
         bat = awset_delta.add_elements(
             bat, np.uint32(0), np.asarray(ids, np.uint32))
+        # the arity-bucketed form Node.add uses: zero-padded + count
+        k = len(ids)
+        bucket = 1 << (k - 1).bit_length()
+        padded = np.zeros(bucket, np.uint32)
+        padded[:k] = ids
+        pad = awset_delta.add_elements(
+            pad, np.uint32(0), padded, np.uint32(k))
         for name in DualWorldDelta.ARRAYS:
             a = np.asarray(getattr(seq, name))
-            b = np.asarray(getattr(bat, name))
-            assert np.array_equal(a, b), (ids, name, a, b)
+            for variant, other in (("batch", bat), ("padded", pad)):
+                b = np.asarray(getattr(other, name))
+                assert np.array_equal(a, b), (ids, variant, name, a, b)
