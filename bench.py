@@ -61,7 +61,8 @@ def build_state(num_replicas: int, num_elements: int, num_writers: int):
     )
 
 
-def measure_tpu(num_replicas=10_000, num_elements=256, num_writers=256):
+def measure_tpu(num_replicas=10_000, num_elements=256, num_writers=256,
+                full=False):
     """True sustained device rate for the headline config: rounds fused
     with ``lax.scan`` and timed by the adaptive two-point fit
     (_scan_round_rate), which cancels the fixed dispatch/transfer
@@ -73,9 +74,12 @@ def measure_tpu(num_replicas=10_000, num_elements=256, num_writers=256):
     state = build_state(num_replicas, num_elements, num_writers)
     offsets = gossip.dissemination_offsets(num_replicas)
     perms = jnp.stack([gossip.ring_perm(num_replicas, o) for o in offsets])
-    per_round = _scan_round_rate(gossip.gossip_round, state, perms,
-                                 start=64)
-    return num_replicas / per_round
+    meas = _scan_round_rate(gossip.gossip_round, state, perms,
+                            start=64, full=True)
+    rate = num_replicas / meas.per_round_s
+    if full:
+        return rate, meas.stats(num_replicas)
+    return rate
 
 
 def measure_spec_baseline(num_elements=256, merges=60):
@@ -99,8 +103,49 @@ def measure_spec_baseline(num_elements=256, merges=60):
     return n / dt
 
 
+class RateMeasurement:
+    """One overhead-cancelled rate with its full evidence trail.
+
+    per_round_s is the min-based two-point fit (the headline number);
+    per_repeat_rates are the per-repeat-index fits (repeat i of the large
+    count minus repeat i of the half count), whose min/median/spread
+    quantify run-to-run variance; raw_timings_s maps round-count -> the
+    repeat wall times, persisted so the ladder numbers are auditable."""
+
+    def __init__(self, per_round_s, fit_counts, raw_timings_s):
+        self.per_round_s = per_round_s
+        self.fit_counts = fit_counts            # (n_half, n_full)
+        self.raw_timings_s = raw_timings_s      # {n: [t_repeat...]}
+
+    def per_repeat_per_round_s(self):
+        lo, hi = self.fit_counts
+        gap = hi - lo
+        return [(b - a) / gap
+                for a, b in zip(self.raw_timings_s[lo],
+                                self.raw_timings_s[hi])
+                if (b - a) > 0]
+
+    def stats(self, work_per_round):
+        """Rate fields for a ladder record: min/median across repeats plus
+        relative spread, all in work-units/sec."""
+        rates = sorted(work_per_round / t
+                       for t in self.per_repeat_per_round_s())
+        if not rates:  # degenerate repeats; fall back to the min-fit
+            rates = [work_per_round / self.per_round_s]
+        median = rates[len(rates) // 2]
+        return {
+            "rate_min": round(rates[0], 1),
+            "rate_median": round(median, 1),
+            "spread": round((rates[-1] - rates[0]) / median, 3),
+            "repeats": len(rates),
+            "raw_timings_s": {str(n): [round(t, 6) for t in ts]
+                              for n, ts in sorted(self.raw_timings_s.items())},
+            "fit_counts": list(self.fit_counts),
+        }
+
+
 def _scan_round_rate(round_fn, state, aux, start=16, max_n=1 << 17,
-                     min_delta=0.25, repeats=3):
+                     min_delta=0.25, repeats=3, full=False):
     """Sustained per-round seconds for ``state <- round_fn(state, aux[i])``
     rounds fused with lax.scan, overhead-cancelled by a two-point fit.
 
@@ -108,7 +153,8 @@ def _scan_round_rate(round_fn, state, aux, start=16, max_n=1 << 17,
     clears ``min_delta`` seconds, so the fit cannot drown in the fixed
     dispatch/transfer overhead (~60ms through the remote-TPU tunnel) the
     way a fixed pair of counts can for very cheap or very expensive
-    rounds."""
+    rounds.  full=True returns the RateMeasurement (repeats + raw
+    timings) instead of the scalar."""
     import functools
 
     import jax
@@ -131,13 +177,13 @@ def _scan_round_rate(round_fn, state, aux, start=16, max_n=1 << 17,
     def timed(n):
         if n not in memo:  # each doubling reuses the previous full count
             float(run(state, n))
-            best = float("inf")
+            times = []
             for _ in range(repeats):
                 t0 = time.perf_counter()
                 float(run(state, n))
-                best = min(best, time.perf_counter() - t0)
-            memo[n] = best
-        return memo[n]
+                times.append(time.perf_counter() - t0)
+            memo[n] = times
+        return min(memo[n])
 
     n = max(2, start)
     while True:
@@ -146,7 +192,11 @@ def _scan_round_rate(round_fn, state, aux, start=16, max_n=1 << 17,
             if delta <= 0:
                 raise RuntimeError(
                     f"timing fit degenerate at n={n} (delta {delta:.4f}s)")
-            return delta / (n - n // 2)
+            per_round = delta / (n - n // 2)
+            if full:
+                return RateMeasurement(per_round, (n // 2, n),
+                                       {k: memo[k] for k in (n // 2, n)})
+            return per_round
         n *= 2
 
 
@@ -215,14 +265,15 @@ def measure_config2(num_replicas=1000, num_actors=256):
         actor=jnp.arange(num_replicas, dtype=jnp.uint32) % num_actors)
     offsets = gossip.dissemination_offsets(num_replicas)
     perms = jnp.stack([gossip.ring_perm(num_replicas, o) for o in offsets])
-    per_round = _scan_round_rate(
+    meas = _scan_round_rate(
         lambda s, perm: lattices.gossip_round(lattices.gcounter_join, s,
                                               perm),
-        state, perms, start=256)
+        state, perms, start=256, full=True)
     return {
         "metric": "config2: GCounter 1K replicas, elementwise-max join",
-        "value": round(num_replicas / per_round, 1),
+        "value": round(num_replicas / meas.per_round_s, 1),
         "unit": "merges/sec/chip",
+        **meas.stats(num_replicas),
     }
 
 
@@ -245,14 +296,15 @@ def measure_config4(num_replicas=100_000, num_elements=256,
         del_dot_actor=zE, del_dot_counter=zE, processed=base.vv)
     offsets = gossip.dissemination_offsets(num_replicas)
     perms = jnp.stack([gossip.ring_perm(num_replicas, o) for o in offsets])
-    per_round = _scan_round_rate(
+    meas = _scan_round_rate(
         lambda s, perm: gossip.delta_gossip_round(s, perm,
                                                   delta_semantics="v2"),
-        state, perms, start=8, max_n=256)
+        state, perms, start=8, max_n=256, full=True)
     return {
         "metric": "config4: delta-AWSet 100K replicas, v2 delta gossip",
-        "value": round(num_replicas / per_round, 1),
+        "value": round(num_replicas / meas.per_round_s, 1),
         "unit": "delta-merges/sec/chip",
+        **meas.stats(num_replicas),
     }
 
 
@@ -284,13 +336,14 @@ def measure_config5(num_replicas=1_000_000, num_elements=256,
         return (gossip.gossip_round(a, perm),
                 lattices.gossip_round(lattices.twopset_join, t, perm))
 
-    per_round = _scan_round_rate(both, (aw, tp), perms, start=4,
-                                 max_n=64, repeats=2)
+    meas = _scan_round_rate(both, (aw, tp), perms, start=4,
+                            max_n=64, repeats=3, full=True)
     return {
         "metric": "config5: mixed AWSet + 2P-Set 1M replicas, "
                   "fused lattice-join round",
-        "value": round(2 * num_replicas / per_round, 1),
+        "value": round(2 * num_replicas / meas.per_round_s, 1),
         "unit": "merges/sec/chip",
+        **meas.stats(2 * num_replicas),
         "note": "counts 2 merges per replica per round (1 full AWSet "
                 "dot-context merge + 1 2P-Set OR-join); the per-family "
                 "AWSet-only rate is value/2 as a lower bound — not "
@@ -299,18 +352,156 @@ def measure_config5(num_replicas=1_000_000, num_elements=256,
     }
 
 
+def measure_droprate(num_replicas=1024, num_elements=256, num_writers=256,
+                     drop_rates=(0.0, 0.1, 0.2, 0.3, 0.4, 0.5), seeds=3):
+    """Rounds-to-convergence under per-replica exchange drop — the
+    north-star resilience metric (BASELINE.json; SURVEY §5.3: lost
+    exchanges self-heal, drops only delay convergence).  Dissemination
+    schedule; each (drop_rate, seed) is an independent run on the same
+    divergent initial fleet."""
+    import jax
+
+    from go_crdt_playground_tpu.parallel import gossip
+
+    state0 = build_state(num_replicas, num_elements, num_writers)
+    table = []
+    for rate in drop_rates:
+        rounds = []
+        for seed in range(seeds):
+            r, final = gossip.rounds_to_convergence(
+                state0, key=jax.random.key(seed), drop_rate=rate,
+                max_rounds=600, schedule="dissemination")
+            assert bool(gossip.converged_jit(final.present, final.vv))
+            rounds.append(r)
+        rounds.sort()
+        table.append({
+            "drop_rate": rate,
+            "rounds_min": rounds[0],
+            "rounds_median": rounds[len(rounds) // 2],
+            "rounds_max": rounds[-1],
+            "seeds": seeds,
+        })
+    return {
+        "metric": f"rounds-to-convergence vs drop rate "
+                  f"(AWSet {num_replicas}x{num_elements}, dissemination "
+                  "schedule, converged digest verified)",
+        "value": table[0]["rounds_median"],
+        "unit": "rounds (at drop 0)",
+        "curve": table,
+        "platform": jax.default_backend(),
+    }
+
+
+def _delta_fleet(num_replicas, num_elements, num_writers):
+    """A divergent δ-AWSet fleet (the config-4/north-star initial state)."""
+    import jax.numpy as jnp
+
+    from go_crdt_playground_tpu.models import awset_delta
+
+    base = build_state(num_replicas, num_elements, num_writers)
+    # every field gets its OWN buffer: aliased leaves (processed sharing
+    # vv, the two del arrays sharing one zeros) break buffer donation
+    # ("attempt to donate the same buffer twice")
+    return awset_delta.AWSetDeltaState(
+        vv=base.vv, present=base.present, dot_actor=base.dot_actor,
+        dot_counter=base.dot_counter, actor=base.actor,
+        deleted=jnp.zeros((num_replicas, num_elements), bool),
+        del_dot_actor=jnp.zeros((num_replicas, num_elements), jnp.uint32),
+        del_dot_counter=jnp.zeros((num_replicas, num_elements), jnp.uint32),
+        processed=base.vv + jnp.uint32(0))
+
+
+def measure_northstar(num_replicas=None, num_elements=256, num_writers=256):
+    """The north-star point (BASELINE.md): 1M x 256-element δ-AWSet
+    replicas, all-pairs-converged via ceil(log2 R) dissemination rounds
+    of v2 δ gossip, single chip, with the convergence digest VERIFIED.
+
+    The v5e-4 target is <1 s; this measures the single-chip wall time
+    (the driver environment has one chip) and reports the 4-chip number
+    only as an explicitly-labeled linear-DP extrapolation."""
+    import jax
+
+    from go_crdt_playground_tpu.parallel import gossip
+
+    if num_replicas is None:
+        num_replicas = int(os.environ.get(
+            "CRDT_NORTHSTAR_REPLICAS", str(1 << 20)))
+    offsets = gossip.dissemination_offsets(num_replicas)
+
+    # donate the state so XLA reuses the ~6.5GB of buffers in place
+    round_fn = jax.jit(
+        lambda s, perm: gossip.delta_gossip_round(
+            s, perm, delta_semantics="v2"),
+        donate_argnums=0)
+
+    # compile warmup on a throwaway fleet (donation consumes it)
+    warm = _delta_fleet(num_replicas, num_elements, num_writers)
+    warm = round_fn(warm, gossip.ring_perm(num_replicas, 1))
+    jax.block_until_ready(warm)
+    del warm
+
+    state = _delta_fleet(num_replicas, num_elements, num_writers)
+    jax.block_until_ready(state)
+    times = []
+    t_total0 = time.perf_counter()
+    for off in offsets:
+        perm = gossip.ring_perm(num_replicas, off)
+        t0 = time.perf_counter()
+        state = round_fn(state, perm)
+        jax.block_until_ready(state)
+        times.append(time.perf_counter() - t0)
+    total_s = time.perf_counter() - t_total0
+    converged = bool(gossip.converged_jit(state.present, state.vv))
+    return {
+        "metric": f"north star: {num_replicas} x {num_elements}-element "
+                  "delta-AWSet replicas, all-pairs converged "
+                  f"({len(offsets)} dissemination rounds, v2 delta gossip)",
+        "value": round(total_s, 4),
+        "unit": "seconds (single chip)",
+        "converged": converged,
+        "rounds": len(offsets),
+        "per_round_s": [round(t, 4) for t in times],
+        "v5e4_extrapolation_s": round(total_s / 4, 4),
+        "extrapolation_note": "linear DP scaling over 4 chips assumed; "
+                              "ICI ring overhead excluded — an estimate, "
+                              "not a measurement (one chip available)",
+        "target_s": 1.0,
+        "platform": jax.default_backend(),
+    }
+
+
+def run_northstar():
+    result = measure_northstar()
+    if not result["converged"]:
+        print("FATAL: fleet did not converge", file=sys.stderr)
+        sys.exit(1)
+    print(json.dumps(result))
+    with open("NORTHSTAR.json", "w") as f:
+        json.dump(result, f, indent=2)
+    return result
+
+
+def run_droprate():
+    result = measure_droprate()
+    print(json.dumps(result))
+    with open("DROP_CURVE.json", "w") as f:
+        json.dump(result, f, indent=2)
+    return result
+
+
 def run_ladder():
     import jax
 
     platform = jax.default_backend()
     spec_rate = measure_spec_baseline()
     results = [measure_config1(), measure_config2()]
-    tpu_rate = measure_tpu()
+    tpu_rate, stats3 = measure_tpu(full=True)
     results.append({
         "metric": "config3: AWSet 10K x 256 vmapped dot-context merge",
         "value": round(tpu_rate, 1),
         "unit": "merges/sec/chip",
         "vs_baseline": round(tpu_rate / spec_rate, 1),
+        **stats3,
     })
     results.append(measure_config4())
     results.append(measure_config5())
@@ -326,6 +517,12 @@ def _child_main():
     """The actual measurement, run inside a parent-supervised subprocess
     (it may initialize a flaky remote-TPU backend and hang or die; the
     parent owns the timeout and the driver-facing output contract)."""
+    if "--northstar" in sys.argv:
+        run_northstar()
+        return
+    if "--droprate" in sys.argv:
+        run_droprate()
+        return
     if "--ladder" in sys.argv:
         results = run_ladder()
         # the conformance anchor is the point of config 1: a ladder run
@@ -393,7 +590,8 @@ def main():
     if os.environ.get("CRDT_BENCH_CHILD") == "1":
         _child_main()
         return
-    ladder = "--ladder" in sys.argv
+    ladder = ("--ladder" in sys.argv or "--droprate" in sys.argv
+              or "--northstar" in sys.argv)
     timeout_s = int(os.environ.get(
         "CRDT_BENCH_TIMEOUT_S", "2700" if ladder else "900"))
     errors = []
@@ -430,7 +628,10 @@ def main():
         errors.append(f"cpu-fallback({why})")
 
     print(json.dumps({
-        "metric": ("measurement ladder (configs 1-5)" if ladder
+        "metric": ("north-star convergence run" if "--northstar" in sys.argv
+                   else "drop-rate convergence curve"
+                   if "--droprate" in sys.argv
+                   else "measurement ladder (configs 1-5)" if ladder
                    else _HEADLINE_METRIC),
         "value": None,
         "unit": _HEADLINE_UNIT,
