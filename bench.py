@@ -61,20 +61,30 @@ def build_state(num_replicas: int, num_elements: int, num_writers: int):
     )
 
 
-def measure_tpu(num_replicas=10_000, num_elements=256, num_writers=256,
+def measure_tpu(num_replicas=10_048, num_elements=256, num_writers=256,
                 full=False):
     """True sustained device rate for the headline config: rounds fused
     with ``lax.scan`` and timed by the adaptive two-point fit
     (_scan_round_rate), which cancels the fixed dispatch/transfer
-    overhead (~60ms through the remote-TPU tunnel)."""
+    overhead (~60ms through the remote-TPU tunnel).
+
+    num_replicas defaults to 10,048 — the nearest _BLOCK_R (64) multiple
+    to the ladder's nominal 10K, which ring_supported() requires for the
+    ring-FUSED kernel; at 10,000 exactly the dispatch would silently
+    fall back to the gather-path kernel and measure a different (slower)
+    program than production schedules run.  Rates are per-merge, so the
+    0.5% size change is comparison-neutral."""
     import jax.numpy as jnp
 
     from go_crdt_playground_tpu.parallel import gossip
 
     state = build_state(num_replicas, num_elements, num_writers)
-    offsets = gossip.dissemination_offsets(num_replicas)
-    perms = jnp.stack([gossip.ring_perm(num_replicas, o) for o in offsets])
-    meas = _scan_round_rate(gossip.gossip_round, state, perms,
+    offsets = jnp.asarray(gossip.dissemination_offsets(num_replicas),
+                          jnp.uint32)
+    # offset-based ring rounds: the fused ring kernel reads partner rows
+    # in place (no state[perm] copy) and takes the offset as data, so
+    # the whole dissemination schedule is one compiled program
+    meas = _scan_round_rate(gossip.ring_gossip_round, state, offsets,
                             start=64, full=True)
     rate = num_replicas / meas.per_round_s
     if full:
@@ -277,11 +287,15 @@ def measure_config2(num_replicas=1000, num_actors=256):
     }
 
 
-def measure_config4(num_replicas=100_000, num_elements=256,
+def measure_config4(num_replicas=100_032, num_elements=256,
                     num_writers=256):
     """delta-AWSet 100K replicas: payload-compressed gossip rounds (the
     single-chip rate of the program that runs on a v5e-4 mesh via
-    parallel/mesh.py; the driver environment has one chip)."""
+    parallel/mesh.py; the driver environment has one chip).
+
+    100,032 = the nearest _BLOCK_R multiple to the nominal 100K (see
+    measure_tpu: exact 100,000 would silently fall back off the
+    ring-fused kernel)."""
     import jax.numpy as jnp
 
     from go_crdt_playground_tpu.models import awset_delta
@@ -294,12 +308,12 @@ def measure_config4(num_replicas=100_000, num_elements=256,
         dot_counter=base.dot_counter, actor=base.actor,
         deleted=jnp.zeros((num_replicas, num_elements), bool),
         del_dot_actor=zE, del_dot_counter=zE, processed=base.vv)
-    offsets = gossip.dissemination_offsets(num_replicas)
-    perms = jnp.stack([gossip.ring_perm(num_replicas, o) for o in offsets])
+    offsets = jnp.asarray(gossip.dissemination_offsets(num_replicas),
+                          jnp.uint32)
     meas = _scan_round_rate(
-        lambda s, perm: gossip.delta_gossip_round(s, perm,
-                                                  delta_semantics="v2"),
-        state, perms, start=8, max_n=256, full=True)
+        lambda s, off: gossip.delta_ring_gossip_round(
+            s, off, delta_semantics="v2"),
+        state, offsets, start=8, max_n=256, full=True)
     return {
         "metric": "config4: delta-AWSet 100K replicas, v2 delta gossip",
         "value": round(num_replicas / meas.per_round_s, 1),
@@ -327,16 +341,16 @@ def measure_config5(num_replicas=1_000_000, num_elements=256,
             0, 100, (num_replicas, num_elements), dtype=np.uint8) < 30),
         removed=jnp.asarray(rng.integers(
             0, 100, (num_replicas, num_elements), dtype=np.uint8) < 5))
-    offsets = gossip.dissemination_offsets(num_replicas)
-    perms = jnp.stack([gossip.ring_perm(num_replicas, o)
-                       for o in offsets[:8]])
+    offsets = jnp.asarray(
+        gossip.dissemination_offsets(num_replicas)[:8], jnp.uint32)
 
-    def both(state, perm):
+    def both(state, off):
         a, t = state
-        return (gossip.gossip_round(a, perm),
+        perm = gossip.ring_perm(a.present.shape[0], off)
+        return (gossip.ring_gossip_round(a, off),
                 lattices.gossip_round(lattices.twopset_join, t, perm))
 
-    meas = _scan_round_rate(both, (aw, tp), perms, start=4,
+    meas = _scan_round_rate(both, (aw, tp), offsets, start=4,
                             max_n=64, repeats=3, full=True)
     return {
         "metric": "config5: mixed AWSet + 2P-Set 1M replicas, "
@@ -420,6 +434,7 @@ def measure_northstar(num_replicas=None, num_elements=256, num_writers=256):
     (the driver environment has one chip) and reports the 4-chip number
     only as an explicitly-labeled linear-DP extrapolation."""
     import jax
+    import jax.numpy as jnp
 
     from go_crdt_playground_tpu.parallel import gossip
 
@@ -428,15 +443,20 @@ def measure_northstar(num_replicas=None, num_elements=256, num_writers=256):
             "CRDT_NORTHSTAR_REPLICAS", str(1 << 20)))
     offsets = gossip.dissemination_offsets(num_replicas)
 
-    # donate the state so XLA reuses the ~6.5GB of buffers in place
+    # Ring rounds through the ring-FUSED δ kernel: partner rows are read
+    # in place (no state[perm] gather copy — with one, peak HBM is
+    # ~3 x 6.5GB and a 16GB v5e OOMs at compile), the offset is DATA so
+    # all ceil(log2 R) rounds share one compiled program, and donation
+    # lets each round's freed input buffer carry the next round's output
+    # (steady-state peak = state + outputs ~ 13GB).
     round_fn = jax.jit(
-        lambda s, perm: gossip.delta_gossip_round(
-            s, perm, delta_semantics="v2"),
+        lambda s, off: gossip.delta_ring_gossip_round(
+            s, off, delta_semantics="v2"),
         donate_argnums=0)
 
     # compile warmup on a throwaway fleet (donation consumes it)
     warm = _delta_fleet(num_replicas, num_elements, num_writers)
-    warm = round_fn(warm, gossip.ring_perm(num_replicas, 1))
+    warm = round_fn(warm, jnp.uint32(1))
     jax.block_until_ready(warm)
     del warm
 
@@ -445,9 +465,8 @@ def measure_northstar(num_replicas=None, num_elements=256, num_writers=256):
     times = []
     t_total0 = time.perf_counter()
     for off in offsets:
-        perm = gossip.ring_perm(num_replicas, off)
         t0 = time.perf_counter()
-        state = round_fn(state, perm)
+        state = round_fn(state, jnp.uint32(off))
         jax.block_until_ready(state)
         times.append(time.perf_counter() - t0)
     total_s = time.perf_counter() - t_total0

@@ -52,10 +52,20 @@ class Node:
     """A single networked replica.  Thread-safe; one lock serializes local
     mutations, payload extraction, and payload application."""
 
+    # Server-side concurrency bounds (the MergerServer pattern,
+    # bridge/service.py): connection threads are capped so a misbehaving
+    # fleet can't grow one thread per dial, and half-open clients can't
+    # pin a thread forever.  At capacity new dials are shed, not queued —
+    # anti-entropy self-heals a dropped exchange (SURVEY §5.3), so
+    # shedding is semantically a lost gossip round, never lost data.
+    CONN_TIMEOUT_S = 30.0
+    MAX_CONNS = 64
+
     def __init__(self, actor: int, num_elements: int, num_actors: int,
                  delta_semantics: str = "v2",
                  strict_reference_semantics: bool = True,
-                 recorder=None):
+                 recorder=None, conn_timeout_s: Optional[float] = None,
+                 max_conns: Optional[int] = None):
         """recorder: optional obs.Recorder; when given, every exchange
         counts sync.exchanges / sync.bytes_sent / sync.bytes_received /
         sync.full_payloads on it (served and initiated alike)."""
@@ -76,6 +86,10 @@ class Node:
         self._server_sock: Optional[socket.socket] = None
         self._server_thread: Optional[threading.Thread] = None
         self._closing = False
+        self.conn_timeout_s = (self.CONN_TIMEOUT_S if conn_timeout_s is None
+                               else conn_timeout_s)
+        self._conn_slots = threading.BoundedSemaphore(
+            self.MAX_CONNS if max_conns is None else max_conns)
 
     # -- local ops (reference Add/Del, awset.go:89-101 δ-variant) ----------
 
@@ -223,13 +237,30 @@ class Node:
                 conn, _ = sock.accept()
             except OSError:
                 return  # socket closed
-            threading.Thread(target=self._handle, args=(conn,),
-                             daemon=True).start()
+            if not self._conn_slots.acquire(blocking=False):
+                conn.close()  # at capacity: shed load instead of queueing
+                continue
+            # daemonic and unretained: connection threads die with their
+            # socket, so a long-lived node doesn't accumulate objects
+            try:
+                threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True).start()
+            except RuntimeError:
+                # OS thread exhaustion: shed this dial and keep serving —
+                # without the release the slot leaks and capacity decays
+                conn.close()
+                self._conn_slots.release()
 
     def _handle(self, conn: socket.socket) -> None:
         try:
+            self._serve_conn(conn)
+        finally:
+            self._conn_slots.release()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
             with conn:
-                conn.settimeout(30.0)
+                conn.settimeout(self.conn_timeout_s)
                 msg_type, body = framing.recv_frame(conn)
                 if msg_type != MSG_HELLO:
                     framing.send_frame(conn, framing.MSG_ERROR,

@@ -1,4 +1,4 @@
-"""Fused Pallas TPU kernel for the δ-AWSet gossip round (v2 semantics).
+"""Fused Pallas TPU kernels for the δ-AWSet gossip round (v2 semantics).
 
 One δ exchange is extract → dispatch → apply (ops/delta.py): the sender
 compresses against the receiver's VV (awset-delta_test.go:79-105), the
@@ -8,7 +8,7 @@ records and joins the causal-stability vectors.  On the XLA path each of
 those steps re-gathers HasDot with [R, E] indices, which lowers
 pathologically inside compiled loops (see ops/pallas_merge.py regime
 notes) — at R=100K a round costs over a second.  Fusing the whole
-exchange into one kernel with the block-diagonal MXU gather
+exchange into one kernel with the native lane-gather HasDot
 (pallas_merge.gather_rows) brings it to HBM-bandwidth order.
 
 Fusion also simplifies the algebra: extraction and application see the
@@ -16,15 +16,22 @@ SAME receiver VV, so phase-1's "take" mask collapses to the changed mask
 (a changed lane is by construction not covered by the receiver's clock,
 awset-delta_test.go:84-92 vs 126-147).
 
+Two variants share one algebra body:
+
+  * ``pallas_delta_gossip_round(state, perm)`` — arbitrary pairing;
+    partner rows pre-gathered by XLA (one extra state copy in HBM).
+  * ``pallas_delta_ring_round(state, offset)`` — ring pairing
+    (r absorbs (r+offset) mod R, every production schedule here);
+    partner rows are read IN PLACE via prefetch-driven block index maps
+    (pallas_merge.ring_block_specs), so peak HBM is state + outputs.
+    This is what lets the 1M-replica north star fit on one chip: with
+    the gather path it needs state + gathered copy + outputs ~ 3 x
+    6.5GB and OOMs a 16GB v5e.
+
 v2 δ semantics only — the strict-reference quirk path (empty-δ VV skip,
 awset-delta_test.go:60-64) needs a cross-E reduction per pair and stays
-on the XLA path, which is also the conformance reference this kernel is
-pinned against bitwise (tests/test_pallas_delta.py).
-
-Layout contract mirrors pallas_merge._fused_rows: 8 replica rows per
-grid step, partner rows pre-gathered by XLA at HBM bandwidth, E in
-lane-multiple tiles, A padded to a lane multiple (zero slots are "never
-seen", crdt-misc.go:29-41).
+on the XLA path, which is also the conformance reference these kernels
+are pinned against bitwise (tests/test_pallas_delta.py).
 """
 
 from __future__ import annotations
@@ -34,28 +41,37 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
-from go_crdt_playground_tpu.ops.pallas_merge import (_BLOCK_R, gather_rows,
-                                                     row_block_layout)
+from go_crdt_playground_tpu.ops.pallas_merge import (
+    _BLOCK_R, _ring_window, gather_rows, ring_block_specs, ring_meta,
+    ring_supported, row_block_layout)
+
+_A_NAMED = ("vv", "processed")
+_E_NAMED = ("present", "dot_actor", "dot_counter", "deleted",
+            "del_dot_actor", "del_dot_counter")
 
 
-def _delta_kernel(dvv_ref, svv_ref, dpr_ref, spr_ref, ah_ref,
-                  dp_ref, sp_ref, dda_ref, sda_ref, ddc_ref, sdc_ref,
-                  dd_ref, sd_ref, ddda_ref, sdda_ref, dddc_ref, sddc_ref,
-                  ovv_ref, opr_ref, op_ref, oda_ref, odc_ref,
-                  od_ref, odda_ref, oddc_ref):
-    dvv, svv = dvv_ref[...], svv_ref[...]            # uint32[8, A]
-    dproc, sproc = dpr_ref[...], spr_ref[...]        # uint32[8, A]
-    aonehot = ah_ref[...] != 0                       # bool[8, A]: sender slot
-    dp, sp = dp_ref[...] != 0, sp_ref[...] != 0      # bool[8, blk]
-    dda, sda = dda_ref[...], sda_ref[...]
-    ddc, sdc = ddc_ref[...], sdc_ref[...]
-    dd, sd = dd_ref[...] != 0, sd_ref[...] != 0      # deletion logs
-    ddda, sdda = ddda_ref[...], sdda_ref[...]        # deletion dots
-    dddc, sddc = dddc_ref[...], sddc_ref[...]
+def _delta_algebra(dst, src, s_actor):
+    """The fused δ exchange on value tuples.
+
+    dst/src: dicts of [blk_r, A]- and [blk_r, blk_e]-shaped values
+    (present/deleted as uint8); s_actor: uint32[blk_r, 1] — the sender's
+    actor id per row.  Returns the 8 output arrays in state order.
+    """
+    dvv, svv = dst["vv"], src["vv"]
+    dproc, sproc = dst["processed"], src["processed"]
+    dp, sp = dst["present"] != 0, src["present"] != 0
+    dda, sda = dst["dot_actor"], src["dot_actor"]
+    ddc, sdc = dst["dot_counter"], src["dot_counter"]
+    dd, sd = dst["deleted"] != 0, src["deleted"] != 0
+    ddda, sdda = dst["del_dot_actor"], src["del_dot_actor"]
+    dddc, sddc = dst["del_dot_counter"], src["del_dot_counter"]
 
     as_i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)  # noqa: E731
+    aonehot = (jax.lax.broadcasted_iota(jnp.uint32, dvv.shape, 1)
+               == jnp.broadcast_to(s_actor, dvv.shape))
 
     # first contact: receiver's counter for the sender's actor is zero
     # (awset-delta_test.go:53).  Single-term masked sum, bit-exact via
@@ -63,7 +79,7 @@ def _delta_kernel(dvv_ref, svv_ref, dpr_ref, spr_ref, ah_ref,
     sender_cnt = jnp.sum(
         jnp.where(aonehot, as_i32(dvv), jnp.zeros_like(as_i32(dvv))),
         axis=1, keepdims=True)
-    fc = sender_cnt == 0                             # bool[8, 1]
+    fc = sender_cnt == 0                             # bool[blk_r, 1]
 
     # shared HasDot gathers
     seen_s_by_d = sdc <= gather_rows(dvv, sda)       # receiver covers src dot
@@ -99,24 +115,52 @@ def _delta_kernel(dvv_ref, svv_ref, dpr_ref, spr_ref, ah_ref,
     # ---- select per row; A-shaped outputs are branch-independent ----
     # (select between i1 vectors doesn't lower on Mosaic — "Unsupported
     # target bitwidth for truncation" — so widen the operands first)
-    op_ref[...] = jnp.where(fc, present_f.astype(jnp.uint8),
-                            present_d.astype(jnp.uint8))
-    oda_ref[...] = jnp.where(fc, da_f, da_d)
-    odc_ref[...] = jnp.where(fc, dc_f, dc_d)
-    od_ref[...] = jnp.where(fc, deleted_f.astype(jnp.uint8),
-                            deleted_d.astype(jnp.uint8))
-    odda_ref[...] = jnp.where(fc, del_da_f, del_da_d)
-    oddc_ref[...] = jnp.where(fc, del_dc_f, del_dc_d)
-    ovv_ref[...] = jnp.where(dvv < svv, svv, dvv)
+    out_p = jnp.where(fc, present_f.astype(jnp.uint8),
+                      present_d.astype(jnp.uint8))
+    out_da = jnp.where(fc, da_f, da_d)
+    out_dc = jnp.where(fc, dc_f, dc_d)
+    out_d = jnp.where(fc, deleted_f.astype(jnp.uint8),
+                      deleted_d.astype(jnp.uint8))
+    out_dda = jnp.where(fc, del_da_f, del_da_d)
+    out_ddc = jnp.where(fc, del_dc_f, del_dc_d)
+    out_vv = jnp.where(dvv < svv, svv, dvv)
     proc = jnp.where(dproc < sproc, sproc, dproc)
     # the sender's own slot advances to its clock (spec _join_processed)
-    opr_ref[...] = jnp.where(aonehot & (proc < svv), svv, proc)
+    out_proc = jnp.where(aonehot & (proc < svv), svv, proc)
+    return (out_vv, out_proc, out_p, out_da, out_dc, out_d, out_dda,
+            out_ddc)
+
+
+def _delta_kernel(sact_ref, dvv_ref, svv_ref, dpr_ref, spr_ref,
+                  dp_ref, sp_ref, dda_ref, sda_ref, ddc_ref, sdc_ref,
+                  dd_ref, sd_ref, ddda_ref, sdda_ref, dddc_ref, sddc_ref,
+                  ovv_ref, opr_ref, op_ref, oda_ref, odc_ref,
+                  od_ref, odda_ref, oddc_ref):
+    """General-perm kernel: partner rows pre-gathered, dst-aligned."""
+    refs = [dvv_ref, svv_ref, dpr_ref, spr_ref, dp_ref, sp_ref, dda_ref,
+            sda_ref, ddc_ref, sdc_ref, dd_ref, sd_ref, ddda_ref, sdda_ref,
+            dddc_ref, sddc_ref]
+    names = [n for name in _A_NAMED + _E_NAMED for n in (name, name)]
+    dst = {n: r[...] for n, r in zip(names[0::2], refs[0::2])}
+    src = {n: r[...] for n, r in zip(names[1::2], refs[1::2])}
+    outs = _delta_algebra(dst, src, sact_ref[...])
+    for ref, val in zip([ovv_ref, opr_ref, op_ref, oda_ref, odc_ref,
+                         od_ref, odda_ref, oddc_ref], outs):
+        ref[...] = val
+
+
+def _out_shapes(num_r, a_pad, e_pad):
+    u32, u8 = jnp.uint32, jnp.uint8
+    dts = [u32, u32, u8, u32, u32, u8, u32, u32]
+    widths = [a_pad, a_pad] + [e_pad] * 6
+    return [jax.ShapeDtypeStruct((num_r, w), d)
+            for w, d in zip(widths, dts)]
 
 
 @functools.partial(jax.jit, static_argnames=("block_e", "interpret"))
 def _fused_delta_round(arrays, perm, block_e: int, interpret: bool):
-    """arrays: the 9 AWSetDeltaState fields as a dict of padded 2D
-    device arrays (present/deleted as uint8)."""
+    """arrays: the 9 AWSetDeltaState fields as a dict of 2D device
+    arrays (present/deleted as uint8)."""
     num_r, num_e = arrays["present"].shape
     num_a = arrays["vv"].shape[1]
     r_pad, e_pad, a_pad, blk = row_block_layout(num_r, num_e, num_a,
@@ -126,54 +170,117 @@ def _fused_delta_round(arrays, perm, block_e: int, interpret: bool):
         return jnp.pad(x, ((0, r_pad - num_r), (0, last - x.shape[1])))
 
     perm = perm.astype(jnp.int32)
-    aonehot = (jnp.arange(a_pad, dtype=jnp.uint32)[None, :]
-               == arrays["actor"][perm].astype(jnp.uint32)[:, None]
-               ).astype(jnp.uint8)
-    aonehot = jnp.pad(aonehot, ((0, r_pad - num_r), (0, 0)))
+    s_actor = pad(arrays["actor"][perm].astype(jnp.uint32)[:, None], 1)
 
-    a_named = ("vv", "processed")
-    e_named = ("present", "dot_actor", "dot_counter", "deleted",
-               "del_dot_actor", "del_dot_counter")
     dst, src = {}, {}
-    for name in a_named + e_named:
+    for name in _A_NAMED + _E_NAMED:
         x = arrays[name]
-        last = a_pad if name in a_named else e_pad
+        last = a_pad if name in _A_NAMED else e_pad
         dst[name] = pad(x, last)
         src[name] = pad(x[perm], last)
 
     grid = (r_pad // _BLOCK_R, e_pad // blk)
     a_blk = pl.BlockSpec((_BLOCK_R, a_pad), lambda i, j: (i, 0))
     e_blk = pl.BlockSpec((_BLOCK_R, blk), lambda i, j: (i, j))
+    s_blk = pl.BlockSpec((_BLOCK_R, 1), lambda i, j: (i, 0))
 
-    ins = [dst["vv"], src["vv"], dst["processed"], src["processed"],
-           aonehot]
-    in_specs = [a_blk] * 5
-    for name in e_named:
+    ins, in_specs = [s_actor], [s_blk]
+    for name in _A_NAMED + _E_NAMED:
         ins += [dst[name], src[name]]
-        in_specs += [e_blk, e_blk]
+        in_specs += [a_blk, a_blk] if name in _A_NAMED else [e_blk, e_blk]
 
-    u32 = jnp.uint32
     outs = pl.pallas_call(
         _delta_kernel,
         grid=grid,
         in_specs=in_specs,
         out_specs=[a_blk, a_blk, e_blk, e_blk, e_blk, e_blk, e_blk, e_blk],
-        out_shape=[
-            jax.ShapeDtypeStruct((r_pad, a_pad), u32),   # vv
-            jax.ShapeDtypeStruct((r_pad, a_pad), u32),   # processed
-            jax.ShapeDtypeStruct((r_pad, e_pad), jnp.uint8),  # present
-            jax.ShapeDtypeStruct((r_pad, e_pad), u32),   # dot_actor
-            jax.ShapeDtypeStruct((r_pad, e_pad), u32),   # dot_counter
-            jax.ShapeDtypeStruct((r_pad, e_pad), jnp.uint8),  # deleted
-            jax.ShapeDtypeStruct((r_pad, e_pad), u32),   # del_dot_actor
-            jax.ShapeDtypeStruct((r_pad, e_pad), u32),   # del_dot_counter
-        ],
+        out_shape=_out_shapes(r_pad, a_pad, e_pad),
         interpret=interpret,
     )(*ins)
     vv, proc, p, da, dc, d, dda, ddc = outs
     return (vv[:num_r, :num_a], proc[:num_r, :num_a], p[:num_r, :num_e],
             da[:num_r, :num_e], dc[:num_r, :num_e], d[:num_r, :num_e],
             dda[:num_r, :num_e], ddc[:num_r, :num_e])
+
+
+def _make_delta_ring_kernel(interpret: bool):
+    def kernel(meta_ref, sact_ref, *refs):
+        o = meta_ref[1]
+        win = functools.partial(_ring_window, o_mod=o, interpret=interpret)
+        n_a, n_e = len(_A_NAMED), len(_E_NAMED)
+        dst, src = {}, {}
+        for k, name in enumerate(_A_NAMED + _E_NAMED):
+            d_ref, lo_ref, hi_ref = refs[3 * k: 3 * k + 3]
+            dst[name] = d_ref[...]
+            src[name] = win(lo_ref[...], hi_ref[...])
+        out_refs = refs[3 * (n_a + n_e):]
+        outs = _delta_algebra(dst, src, sact_ref[...])
+        for ref, val in zip(out_refs, outs):
+            ref[...] = val
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("block_e", "interpret"))
+def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool):
+    num_r, num_e = arrays["present"].shape
+    num_a = arrays["vv"].shape[1]
+    r_pad, e_pad, a_pad, blk = row_block_layout(num_r, num_e, num_a,
+                                                block_e)
+    assert r_pad == num_r, "callers must check ring_supported()"
+    nb = num_r // _BLOCK_R
+
+    offset = offset % num_r
+    # the sender-actor column is dst-aligned and tiny ([R, 1]): compute
+    # it with a plain XLA roll instead of threading it through the
+    # window machinery
+    s_actor = jnp.roll(arrays["actor"].astype(jnp.uint32),
+                       -offset)[:, None]
+    meta = ring_meta(offset, num_r)
+
+    def pad(x, last):
+        return jnp.pad(x, ((0, 0), (0, last - x.shape[1])))
+
+    ins = [s_actor]
+    for name in _A_NAMED + _E_NAMED:
+        x = pad(arrays[name], a_pad if name in _A_NAMED else e_pad)
+        ins += [x, x, x]
+
+    in_specs, out_specs = ring_block_specs(
+        nb, blk, a_pad, a_named=len(_A_NAMED), e_named=len(_E_NAMED))
+    s_blk = pl.BlockSpec((_BLOCK_R, 1), lambda i, j, meta: (i, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb, e_pad // blk),
+        in_specs=[s_blk] + in_specs,
+        out_specs=out_specs,
+    )
+    outs = pl.pallas_call(
+        _make_delta_ring_kernel(interpret),
+        grid_spec=grid_spec,
+        out_shape=_out_shapes(num_r, a_pad, e_pad),
+        interpret=interpret,
+    )(meta, *ins)
+    vv, proc, p, da, dc, d, dda, ddc = outs
+    return (vv[:, :num_a], proc[:, :num_a], p[:, :num_e], da[:, :num_e],
+            dc[:, :num_e], d[:, :num_e], dda[:, :num_e], ddc[:, :num_e])
+
+
+def _state_as_arrays(state: AWSetDeltaState):
+    return {
+        name: (getattr(state, name).astype(jnp.uint8)
+               if getattr(state, name).dtype == jnp.bool_
+               else getattr(state, name))
+        for name in state._fields
+    }
+
+
+def _rebuild(state, vv, proc, p, da, dc, d, dda, ddc):
+    return AWSetDeltaState(
+        vv=vv, present=p != 0, dot_actor=da, dot_counter=dc,
+        actor=state.actor, deleted=d != 0, del_dot_actor=dda,
+        del_dot_counter=ddc, processed=proc,
+    )
 
 
 def pallas_delta_gossip_round(state: AWSetDeltaState, perm, *,
@@ -186,16 +293,29 @@ def pallas_delta_gossip_round(state: AWSetDeltaState, perm, *,
     dispatches here on TPU backends)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    arrays = {
-        name: (getattr(state, name).astype(jnp.uint8)
-               if getattr(state, name).dtype == jnp.bool_
-               else getattr(state, name))
-        for name in state._fields
-    }
-    vv, proc, p, da, dc, d, dda, ddc = _fused_delta_round(
-        arrays, jnp.asarray(perm), block_e, interpret)
-    return AWSetDeltaState(
-        vv=vv, present=p != 0, dot_actor=da, dot_counter=dc,
-        actor=state.actor, deleted=d != 0, del_dot_actor=dda,
-        del_dot_counter=ddc, processed=proc,
-    )
+    outs = _fused_delta_round(_state_as_arrays(state), jnp.asarray(perm),
+                              block_e, interpret)
+    return _rebuild(state, *outs)
+
+
+def pallas_delta_ring_round(state: AWSetDeltaState, offset, *,
+                            block_e: int = 512,
+                            interpret: bool | None = None
+                            ) -> AWSetDeltaState:
+    """One fused δ ring round against partner (r + offset) mod R with
+    partner rows read in place — no materialized ``state[perm]`` copy
+    (peak HBM = state + outputs; the 1M-replica north-star enabler).
+    ``offset`` may be traced: one compiled program serves a whole
+    dissemination schedule.  Bitwise-equal to
+    ``pallas_delta_gossip_round(state, ring_perm(R, offset))``."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not ring_supported(state.present.shape[0]):
+        from go_crdt_playground_tpu.parallel.gossip import ring_perm
+
+        return pallas_delta_gossip_round(
+            state, ring_perm(state.present.shape[0], offset),
+            block_e=block_e, interpret=interpret)
+    outs = _fused_delta_ring(_state_as_arrays(state), offset, block_e,
+                             interpret)
+    return _rebuild(state, *outs)

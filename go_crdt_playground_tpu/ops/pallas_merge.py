@@ -258,40 +258,48 @@ def pallas_merge_pairwise(dst: AWSetState, src: AWSetState, *,
 
 
 def gather_rows(vv: jnp.ndarray, da: jnp.ndarray) -> jnp.ndarray:
-    """In-kernel HasDot gather, multi-row: cnt[r, e] = vv[r, da[r, e]]
-    for a whole row block with ONE 2D MXU matmul.  The vv rows become a
-    block-diagonal [blk_r, blk_r*A] operand and the one-hot selector
-    [blk_r*A, blk_e] row q = r*A + a answers "does row r's lane e name
-    actor a".  Mosaic can't lower batched dot_general and axis-1
-    reductions of [blk_r, A, blk_e] are layout-hostile; both 2D shapes
-    here keep lanes minor.  Exact over the full uint32 range via the
-    16-bit halves (the one-hot contraction sums a single term < 2^16).
+    """In-kernel HasDot gather, multi-row: cnt[r, e] = vv[r, da[r, e]].
 
-    vv: uint32[blk_r, A]; da: uint32[blk_r, blk_e] -> uint32[blk_r, blk_e]
+    Mosaic lowers ``jnp.take_along_axis`` to the VPU's native lane
+    gather, but ONLY for operands exactly one lane group (128) wide —
+    wider shapes crash the compiler (probed empirically on v5e).  So the
+    gather runs per (128-lane A-chunk x 128-lane E-slice): chunk c
+    serves the lanes whose actor id lives in [128c, 128(c+1)), selected
+    by mask.  O(A/128 x E) VPU work per row block — ~A/128 elementwise
+    passes — which replaces the previous one-hot MXU formulation's
+    O(A x E) selector materialization (the 9x-off-roofline culprit at
+    A=256, see the regime notes below).
+
+    vv: uint32[blk_r, A (128-multiple)]; da: uint32[blk_r, blk_e]
+    -> uint32[blk_r, blk_e]
     """
     blk_r, a_pad = vv.shape
     blk_e = da.shape[1]
-    q = blk_r * a_pad
-    q_a = jax.lax.broadcasted_iota(jnp.uint32, (q, blk_e), 0) % a_pad
-    da_rep = jnp.broadcast_to(
-        da[:, None, :], (blk_r, a_pad, blk_e)).reshape(q, blk_e)
-    onehot = (q_a == da_rep).astype(jnp.float32)
-    eye = (jax.lax.broadcasted_iota(jnp.uint32, (blk_r, blk_r, a_pad), 0)
-           == jax.lax.broadcasted_iota(jnp.uint32,
-                                       (blk_r, blk_r, a_pad), 1))
-    tiled = jnp.broadcast_to(vv[None, :, :], (blk_r, blk_r, a_pad))
-    vvd = jnp.where(eye, tiled, jnp.zeros_like(tiled)).reshape(blk_r, q)
-    return _exact_u32_onehot_dot(vvd, onehot)
+    chunk_shift = _LANE.bit_length() - 1   # log2(_LANE): da // _LANE
+    out_slices = []
+    for e0 in range(0, blk_e, _LANE):
+        da_s = jax.lax.slice(da, (0, e0), (blk_r, e0 + _LANE))
+        idx = da_s & jnp.uint32(_LANE - 1)     # in-chunk lane, all chunks
+        chunk = da_s >> chunk_shift
+        cnt = jnp.zeros((blk_r, _LANE), jnp.uint32)
+        for c in range(a_pad // _LANE):
+            vv_c = jax.lax.slice(vv, (0, c * _LANE),
+                                 (blk_r, (c + 1) * _LANE))
+            g = jnp.take_along_axis(vv_c, idx, axis=1)
+            cnt = jnp.where(chunk == c, g, cnt)
+        out_slices.append(cnt)
+    if len(out_slices) == 1:
+        return out_slices[0]
+    return jnp.concatenate(out_slices, axis=1)
 
 
-def _rows_kernel(dvv_ref, svv_ref, dp_ref, sp_ref, dda_ref, sda_ref,
-                 ddc_ref, sdc_ref, ovv_ref, op_ref, oda_ref, odc_ref):
-    dvv, svv = dvv_ref[...], svv_ref[...]          # [8, A]
-    dp = dp_ref[...] != 0                           # [8, blk]
-    sp = sp_ref[...] != 0
-    dda, sda = dda_ref[...], sda_ref[...]
-    ddc, sdc = ddc_ref[...], sdc_ref[...]
-
+def _merge_algebra(dvv, svv, dp_u8, sp_u8, dda, sda, ddc, sdc):
+    """The two-phase merge as closed-form masks on value blocks
+    (awset.go:122-159, SURVEY §7.2) — shared by the gather-path and
+    ring-path multi-row kernels so the bitwise-pinned semantics live in
+    exactly one place.  Returns (vv, present_u8, dot_actor,
+    dot_counter)."""
+    dp, sp = dp_u8 != 0, sp_u8 != 0
     seen_by_dst = sdc <= gather_rows(dvv, sda)
     seen_by_src = ddc <= gather_rows(svv, dda)
     take_src = sp & (dp | ~seen_by_dst)
@@ -299,39 +307,59 @@ def _rows_kernel(dvv_ref, svv_ref, dp_ref, sp_ref, dda_ref, sda_ref,
     da = jnp.where(take_src, sda, dda)
     dc = jnp.where(take_src, sdc, ddc)
     zero = jnp.zeros_like(da)
-    oda_ref[...] = jnp.where(present, da, zero)
-    odc_ref[...] = jnp.where(present, dc, zero)
-    op_ref[...] = present.astype(jnp.uint8)
-    ovv_ref[...] = jnp.where(dvv < svv, svv, dvv)
+    # VV join (crdt-misc.go:43-55); Mosaic can't legalize unsigned max,
+    # so spell it as compare+select
+    return (jnp.where(dvv < svv, svv, dvv),
+            present.astype(jnp.uint8),
+            jnp.where(present, da, zero),
+            jnp.where(present, dc, zero))
 
 
-_BLOCK_R = 8
+def _rows_kernel(dvv_ref, svv_ref, dp_ref, sp_ref, dda_ref, sda_ref,
+                 ddc_ref, sdc_ref, ovv_ref, op_ref, oda_ref, odc_ref):
+    outs = _merge_algebra(dvv_ref[...], svv_ref[...], dp_ref[...],
+                          sp_ref[...], dda_ref[...], sda_ref[...],
+                          ddc_ref[...], sdc_ref[...])
+    for ref, val in zip((ovv_ref, op_ref, oda_ref, odc_ref), outs):
+        ref[...] = val
 
-# In-kernel one-hot budget: gather_rows materializes a
-# [_BLOCK_R * a_pad, blk_e] f32 selector (plus the same-shaped da_rep),
-# so blk_e must shrink as A grows to stay inside VMEM.
-_ONEHOT_BUDGET_BYTES = 4 << 20
 
-# Above this actor-axis size even blk_e = one lane group blows the
-# budget — callers (gossip auto-dispatch) fall back to the XLA path.
-MAX_FUSED_ACTORS = _ONEHOT_BUDGET_BYTES // (_BLOCK_R * 4 * _LANE)
+# 64 rows per grid step: large enough that the ~µs-order per-step grid
+# overhead amortizes to noise (the previous 8-row blocks left the kernel
+# ~9x off its own HBM streaming bound at R=10K — grid steps, not bytes,
+# dominated), small enough that a full operand set stays ~2MB of VMEM.
+# Mosaic's sublane rule (second-minor block dim 8-divisible) holds.
+_BLOCK_R = 64
+
+# VMEM budget for one grid step's operand blocks (in + out).  The
+# gather-based HasDot materializes nothing beyond the operands, so this
+# is the only sizing constraint left.
+_VMEM_BUDGET_BYTES = 8 << 20
+
+# Actor-axis cap for the fused row kernels: vv/processed blocks are
+# [_BLOCK_R, a_pad] u32 and the chunked gather does A/128 passes per
+# E-slice, so very large actor axes belong on the XLA path.
+MAX_FUSED_ACTORS = 4096
 
 
 def row_block_layout(num_r: int, num_e: int, num_a: int, block_e: int):
     """Padded dims + element block size for the multi-row kernels:
     (r_pad, e_pad, a_pad, blk).  blk is a lane multiple that divides
-    e_pad and keeps the one-hot selector within the VMEM budget."""
+    e_pad and keeps one grid step's operand blocks within the VMEM
+    budget."""
     e_pad = _round_up(num_e, _LANE)
     a_pad = _round_up(num_a, _LANE)
     r_pad = _round_up(num_r, _BLOCK_R)
-    budget_blk = _ONEHOT_BUDGET_BYTES // (_BLOCK_R * a_pad * 4)
-    if budget_blk < _LANE:
+    if num_a > MAX_FUSED_ACTORS:
         raise ValueError(
             f"actor axis A={num_a} too large for the fused row kernels "
-            f"(one-hot selector would exceed the {_ONEHOT_BUDGET_BYTES >> 20}"
-            "MB VMEM budget at the minimum block width); use the XLA path")
-    blk = min(_round_up(block_e, _LANE), e_pad,
-              budget_blk // _LANE * _LANE)
+            f"(cap {MAX_FUSED_ACTORS}); use the XLA path")
+    # ~13 element-shaped operand blocks (dst+src+out across both kernels)
+    # of [_BLOCK_R, blk] u32 plus the A-shaped vv blocks
+    budget_blk = (_VMEM_BUDGET_BYTES - 6 * _BLOCK_R * a_pad * 4) // (
+        13 * _BLOCK_R * 4)
+    blk = max(_LANE, min(_round_up(block_e, _LANE), e_pad,
+                         budget_blk // _LANE * _LANE))
     while e_pad % blk:
         blk -= _LANE
     return r_pad, e_pad, a_pad, blk
@@ -390,6 +418,173 @@ def pallas_merge_pairwise_rows(dst: AWSetState, src: AWSetState, *,
                                 block_e, interpret)
     return AWSetState(vv=vv, present=p != 0, dot_actor=da, dot_counter=dc,
                       actor=dst.actor)
+
+
+# ---------------------------------------------------------------------------
+# Ring-fused variant: partner rows via prefetch-driven block index maps
+# ---------------------------------------------------------------------------
+#
+# Every production schedule here is a ring: gossip_round's dissemination
+# offsets, the shard_map ICI ring, the north-star convergence loop — all
+# pair replica r with (r + offset) mod R.  For a ring the partner rows of
+# one 64-row block are CONTIGUOUS (rows [i*64+o, i*64+o+64) mod R), so
+# instead of materializing state[perm] with an XLA gather (a full extra
+# state copy in HBM — the allocation that OOMed the 1M-replica north
+# star: state + gathered src + outputs ~ 3x 6.5GB), the kernel fetches
+# the two aligned blocks the window spans via scalar-prefetch block
+# index maps and shifts them into place with one dynamic sublane roll.
+# The offset rides in as data (an int32[2] = [offset//64, offset%64]
+# prefetch operand), so ONE compiled kernel serves every round of a
+# dissemination schedule.
+
+
+def _ring_window(lo, hi, o_mod, interpret: bool):
+    """Rows [o_mod, o_mod + _BLOCK_R) of the stacked [2*_BLOCK_R, X]
+    pair of adjacent blocks.  pltpu.roll lowers to the native dynamic
+    sublane rotate; the interpreter has no rule for it, so interpret
+    mode uses the jnp equivalent (identical semantics)."""
+    stacked = jnp.concatenate([lo, hi], axis=0)
+    roll = jnp.roll if interpret else pltpu.roll
+    if stacked.dtype.itemsize != 4:  # Mosaic rotates 32-bit data only
+        wide = roll(stacked.astype(jnp.uint32), -o_mod, 0)[:_BLOCK_R]
+        return wide.astype(stacked.dtype)
+    return roll(stacked, -o_mod, 0)[:_BLOCK_R]
+
+
+def _make_ring_kernel(interpret: bool):
+    def kernel(meta_ref, dvv_ref, avv_ref, bvv_ref, dp_ref, ap_ref, bp_ref,
+               dda_ref, ada_ref, bda_ref, ddc_ref, adc_ref, bdc_ref,
+               ovv_ref, op_ref, oda_ref, odc_ref):
+        o = meta_ref[1]
+        win = functools.partial(_ring_window, o_mod=o, interpret=interpret)
+        outs = _merge_algebra(
+            dvv_ref[...], win(avv_ref[...], bvv_ref[...]),
+            dp_ref[...], win(ap_ref[...], bp_ref[...]),
+            dda_ref[...], win(ada_ref[...], bda_ref[...]),
+            ddc_ref[...], win(adc_ref[...], bdc_ref[...]))
+        for ref, val in zip((ovv_ref, op_ref, oda_ref, odc_ref), outs):
+            ref[...] = val
+
+    return kernel
+
+
+def ring_block_specs(nb: int, blk: int, a_pad: int, a_named: int,
+                     e_named: int):
+    """(in_specs, out_specs) for a ring-fused kernel: per A-shaped array
+    one dst block + the two partner blocks the window spans, likewise
+    per E-shaped array; outputs are dst-aligned.  Block index maps read
+    the prefetched [offset//_BLOCK_R, offset%_BLOCK_R] meta operand."""
+    def dst_a(i, j, meta):
+        del j, meta
+        return (i, 0)
+
+    def src_a_lo(i, j, meta):
+        del j
+        return ((i + meta[0]) % nb, 0)
+
+    def src_a_hi(i, j, meta):
+        del j
+        return ((i + meta[0] + 1) % nb, 0)
+
+    def dst_e(i, j, meta):
+        del meta
+        return (i, j)
+
+    def src_e_lo(i, j, meta):
+        return ((i + meta[0]) % nb, j)
+
+    def src_e_hi(i, j, meta):
+        return ((i + meta[0] + 1) % nb, j)
+
+    a_blk = lambda m: pl.BlockSpec((_BLOCK_R, a_pad), m)   # noqa: E731
+    e_blk = lambda m: pl.BlockSpec((_BLOCK_R, blk), m)     # noqa: E731
+    in_specs = ([a_blk(dst_a), a_blk(src_a_lo), a_blk(src_a_hi)] * a_named
+                + [e_blk(dst_e), e_blk(src_e_lo), e_blk(src_e_hi)] * e_named)
+    out_specs = [a_blk(dst_a)] * a_named + [e_blk(dst_e)] * e_named
+    return in_specs, out_specs
+
+
+def ring_supported(num_r: int) -> bool:
+    """The ring-fused kernels need whole aligned blocks on both sides of
+    the window: an exact multiple of _BLOCK_R rows and at least two
+    blocks."""
+    return num_r % _BLOCK_R == 0 and num_r >= 2 * _BLOCK_R
+
+
+def ring_meta(offset, num_r: int) -> jnp.ndarray:
+    """The scalar-prefetch operand the ring kernels' index maps and
+    window roll consume: int32[2] = [offset // _BLOCK_R (whole blocks),
+    offset % _BLOCK_R (intra-window roll)].  Load-bearing for
+    ring_block_specs — every ring kernel must build it here."""
+    offset = offset % num_r
+    return jnp.stack([offset // _BLOCK_R, offset % _BLOCK_R]).astype(
+        jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("block_e", "interpret"))
+def _fused_rows_ring(dst_arrays, offset, block_e: int, interpret: bool):
+    num_r, num_e = dst_arrays[2].shape
+    num_a = dst_arrays[0].shape[1]
+    r_pad, e_pad, a_pad, blk = row_block_layout(num_r, num_e, num_a,
+                                                block_e)
+    assert r_pad == num_r, "callers must check ring_supported()"
+    nb = num_r // _BLOCK_R
+
+    def pad_e(x):
+        return jnp.pad(x, ((0, 0), (0, e_pad - num_e)))
+
+    vv, p_u8, da, dc = dst_arrays
+    if a_pad != num_a:
+        vv = jnp.pad(vv, ((0, 0), (0, a_pad - num_a)))
+    p_u8, da, dc = pad_e(p_u8), pad_e(da), pad_e(dc)
+
+    meta = ring_meta(offset, num_r)
+    in_specs, out_specs = ring_block_specs(nb, blk, a_pad, a_named=1,
+                                           e_named=3)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb, e_pad // blk),
+        in_specs=in_specs,
+        out_specs=out_specs,
+    )
+    out_vv, out_p, out_da, out_dc = pl.pallas_call(
+        _make_ring_kernel(interpret),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((num_r, a_pad), jnp.uint32),
+            jax.ShapeDtypeStruct((num_r, e_pad), jnp.uint8),
+            jax.ShapeDtypeStruct((num_r, e_pad), jnp.uint32),
+            jax.ShapeDtypeStruct((num_r, e_pad), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(meta, vv, vv, vv, p_u8, p_u8, p_u8, da, da, da, dc, dc, dc)
+    return (out_vv[:, :num_a], out_p[:, :num_e],
+            out_da[:, :num_e], out_dc[:, :num_e])
+
+
+def pallas_ring_round_rows(state: AWSetState, offset, *,
+                           block_e: int = 512,
+                           interpret: bool | None = None) -> AWSetState:
+    """One anti-entropy round against partner (r + offset) mod R, fully
+    fused: partner rows are read in place via block index maps — no
+    materialized ``state[perm]`` copy, so peak HBM is state + outputs
+    (vs 3x state for the gather path; what lets the 1M-replica north
+    star fit on one chip) and HBM traffic drops by a full state read.
+    ``offset`` may be a traced scalar: one compiled program serves every
+    offset of a dissemination schedule.  Bitwise-equal to
+    ``gossip_round(state, ring_perm(R, offset))``."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not ring_supported(state.present.shape[0]):
+        from go_crdt_playground_tpu.parallel.gossip import ring_perm
+
+        return pallas_gossip_round_rows(
+            state, ring_perm(state.present.shape[0], offset),
+            block_e=block_e, interpret=interpret)
+    vv, p, da, dc = _fused_rows_ring(_as_arrays(state), offset, block_e,
+                                     interpret)
+    return AWSetState(vv=vv, present=p != 0, dot_actor=da, dot_counter=dc,
+                      actor=state.actor)
 
 
 def pallas_gossip_round_rows(state: AWSetState, perm, *,

@@ -76,6 +76,25 @@ def random_perm(key: jax.Array, num_replicas: int) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _auto_kernel(state, delta_semantics: Optional[str] = None,
+                 single_device: bool = True) -> str:
+    """The fused-kernel auto-dispatch rule, in ONE place: Pallas on TPU
+    backends (single-device processes unless the caller runs per shard
+    inside shard_map) when the actor axis fits the fused row kernels —
+    and, for δ rounds, only under v2 semantics (the strict-reference
+    quirk needs a cross-E reduction the fused kernel doesn't do).  All
+    choices are bitwise-identical; on TPU the XLA HasDot gather lowers
+    pathologically inside compiled loops (~40x slower, see
+    ops/pallas_merge.py regime notes)."""
+    from go_crdt_playground_tpu.ops.pallas_merge import MAX_FUSED_ACTORS
+
+    ok = (jax.default_backend() == "tpu"
+          and (not single_device or jax.device_count() == 1)
+          and state.vv.shape[-1] <= MAX_FUSED_ACTORS
+          and (delta_semantics is None or delta_semantics == "v2"))
+    return "pallas" if ok else "xla"
+
+
 def _select_rows(mask_r: jnp.ndarray, new, old):
     """Per-replica select between two state pytrees (mask True -> new)."""
     return jax.tree.map(
@@ -108,11 +127,7 @@ def gossip_round(
     meshes never pay the XLA HasDot penalty on the ring schedule).
     """
     if kernel == "auto":
-        from go_crdt_playground_tpu.ops.pallas_merge import MAX_FUSED_ACTORS
-
-        kernel = ("pallas" if jax.default_backend() == "tpu"
-                  and jax.device_count() == 1
-                  and state.vv.shape[-1] <= MAX_FUSED_ACTORS else "xla")
+        kernel = _auto_kernel(state)
     if kernel == "pallas":
         from go_crdt_playground_tpu.ops.pallas_merge import (
             pallas_gossip_round_rows)
@@ -127,6 +142,40 @@ def gossip_round(
 
 
 gossip_round_jit = jax.jit(gossip_round, static_argnames=("kernel",))
+
+
+def ring_gossip_round(
+    state: AWSetState,
+    offset,
+    drop_mask: Optional[jnp.ndarray] = None,
+    kernel: str = "auto",
+) -> AWSetState:
+    """One full-state ring round: r <- (r + offset) mod R, the pairing
+    every production schedule here uses (dissemination offsets, ICI
+    rings).  Bitwise-equal to ``gossip_round(state, ring_perm(R,
+    offset))`` but on TPU it dispatches the ring-FUSED kernel: partner
+    rows are read in place via block index maps, so no ``state[perm]``
+    copy is materialized — peak HBM drops from ~3x to ~2x state and a
+    full state read of HBM traffic disappears (ops/pallas_merge.py).
+    ``offset`` may be a traced scalar: one compiled program serves a
+    whole dissemination schedule."""
+    if kernel == "auto":
+        kernel = _auto_kernel(state)
+    if kernel == "pallas":
+        from go_crdt_playground_tpu.ops.pallas_merge import (
+            pallas_ring_round_rows)
+
+        merged = pallas_ring_round_rows(state, offset)
+    else:
+        merged = gossip_round(state, ring_perm(state.vv.shape[0], offset),
+                              kernel=kernel)
+    if drop_mask is not None:
+        merged = _select_rows(~drop_mask, merged, state)
+    return merged
+
+
+ring_gossip_round_jit = jax.jit(ring_gossip_round,
+                                static_argnames=("kernel",))
 
 
 def delta_gossip_round(
@@ -148,12 +197,7 @@ def delta_gossip_round(
     gossip_round — use shard_map + kernel="pallas" per shard instead).
     """
     if kernel == "auto":
-        from go_crdt_playground_tpu.ops.pallas_merge import MAX_FUSED_ACTORS
-
-        kernel = ("pallas" if delta_semantics == "v2"
-                  and jax.default_backend() == "tpu"
-                  and jax.device_count() == 1
-                  and state.vv.shape[-1] <= MAX_FUSED_ACTORS else "xla")
+        kernel = _auto_kernel(state, delta_semantics)
     if kernel == "pallas":
         if delta_semantics != "v2":
             raise ValueError("the fused delta kernel is v2-only")
@@ -172,6 +216,47 @@ def delta_gossip_round(
 
 delta_gossip_round_jit = jax.jit(
     delta_gossip_round,
+    static_argnames=("delta_semantics", "strict_reference_semantics",
+                     "kernel"),
+)
+
+
+def delta_ring_gossip_round(
+    state: AWSetDeltaState,
+    offset,
+    drop_mask: Optional[jnp.ndarray] = None,
+    delta_semantics: str = "v2",
+    strict_reference_semantics: bool = True,
+    kernel: str = "auto",
+) -> AWSetDeltaState:
+    """One δ ring round: r absorbs (r + offset) mod R.  On TPU (v2
+    semantics) this dispatches the ring-fused δ kernel, which reads
+    partner rows in place — no materialized ``state[perm]`` copy.  That
+    is what lets the 1M-replica north star fit on one 16GB chip: the
+    gather path peaks at ~3x the 6.5GB state and OOMs.  Bitwise-equal
+    to ``delta_gossip_round(state, ring_perm(R, offset), ...)``."""
+    if kernel == "auto":
+        kernel = _auto_kernel(state, delta_semantics)
+    if kernel == "pallas":
+        if delta_semantics != "v2":
+            raise ValueError("the fused delta kernel is v2-only")
+        from go_crdt_playground_tpu.ops.pallas_delta import (
+            pallas_delta_ring_round)
+
+        merged = pallas_delta_ring_round(state, offset)
+    else:
+        merged = delta_gossip_round(
+            state, ring_perm(state.vv.shape[0], offset),
+            delta_semantics=delta_semantics,
+            strict_reference_semantics=strict_reference_semantics,
+            kernel=kernel)
+    if drop_mask is not None:
+        merged = _select_rows(~drop_mask, merged, state)
+    return merged
+
+
+delta_ring_gossip_round_jit = jax.jit(
+    delta_ring_gossip_round,
     static_argnames=("delta_semantics", "strict_reference_semantics",
                      "kernel"),
 )
@@ -356,12 +441,11 @@ def all_pairs_converge(state, delta: bool = False,
     rounds instead of O(R^2) work (SURVEY §5.7c)."""
     R = state.vv.shape[0]
     for off in dissemination_offsets(R):
-        perm = ring_perm(R, off)
         if delta:
-            state = delta_gossip_round(state, perm,
-                                       delta_semantics=delta_semantics)
+            state = delta_ring_gossip_round(
+                state, off, delta_semantics=delta_semantics)
         else:
-            state = gossip_round(state, perm)
+            state = ring_gossip_round(state, off)
     return state
 
 
@@ -383,14 +467,19 @@ def rounds_to_convergence(
     R = state.vv.shape[0]
     offsets = dissemination_offsets(R) or [1]
     round_fn = delta_gossip_round_jit if delta else gossip_round_jit
+    # ring-schedule rounds go through the offset form: the fused ring
+    # kernel takes the offset as DATA, so every round reuses one
+    # compiled program and no permuted state copy is materialized
+    ring_fn = delta_ring_gossip_round_jit if delta else ring_gossip_round_jit
 
     for rnd in range(max_rounds):
         if bool(converged_jit(state.present, state.vv)):
             return rnd, state
+        offset = None
         if schedule == "dissemination":
-            perm = ring_perm(R, offsets[rnd % len(offsets)])
+            offset = offsets[rnd % len(offsets)]
         elif schedule == "ring":
-            perm = ring_perm(R, 1)
+            offset = 1
         elif schedule == "random":
             if key is None:
                 raise ValueError("random schedule requires a key")
@@ -404,11 +493,11 @@ def rounds_to_convergence(
                 raise ValueError("drop_rate requires a key")
             key, sub = jax.random.split(key)
             drop = jax.random.bernoulli(sub, drop_rate, (R,))
-        if delta:
-            state = round_fn(state, perm, drop,
-                             delta_semantics=delta_semantics)
+        kw = {"delta_semantics": delta_semantics} if delta else {}
+        if offset is not None:
+            state = ring_fn(state, jnp.uint32(offset), drop, **kw)
         else:
-            state = round_fn(state, perm, drop)
+            state = round_fn(state, perm, drop, **kw)
     if not bool(converged_jit(state.present, state.vv)):
         raise RuntimeError(
             f"no convergence within {max_rounds} rounds "
@@ -524,8 +613,5 @@ def ring_round_shardmap(state: AWSetState, mesh: Mesh,
     delta_gossip_round under jit instead, where XLA inserts the psum.)
     """
     if kernel == "auto":
-        from go_crdt_playground_tpu.ops.pallas_merge import MAX_FUSED_ACTORS
-
-        kernel = ("pallas" if jax.default_backend() == "tpu"
-                  and state.vv.shape[-1] <= MAX_FUSED_ACTORS else "xla")
+        kernel = _auto_kernel(state, single_device=False)
     return _ring_step_compiled(mesh, type(state), kernel)(state)

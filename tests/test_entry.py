@@ -25,7 +25,7 @@ fn, args = entry()
 out = jax.jit(fn)(*args)
 jax.block_until_ready(out)
 merged, converged = out
-assert merged.present.shape == (64, 256)
+assert merged.present.shape == (256, 256)
 assert converged.shape == ()
 print("ENTRY_OK", jax.devices()[0].platform)
 """
@@ -63,3 +63,17 @@ def test_dryrun_multichip_odd_device_count():
         capture_output=True, text=True)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "mesh=(3, 1)" in proc.stdout
+
+
+def test_entry_shape_triggers_fused_dispatch():
+    """The driver probe must exercise the production kernel: entry()'s
+    example shape satisfies every condition of ring_gossip_round's
+    pallas auto-dispatch (single-device TPU picks the ring-fused path)."""
+    from __graft_entry__ import entry
+    from go_crdt_playground_tpu.ops.pallas_merge import (
+        MAX_FUSED_ACTORS, ring_supported)
+
+    _, (state, offset) = entry()
+    assert ring_supported(state.present.shape[0])
+    assert state.vv.shape[-1] <= MAX_FUSED_ACTORS
+    assert int(offset) < state.present.shape[0]

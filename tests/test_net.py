@@ -246,3 +246,87 @@ def test_frame_size_matches_send():
     assert framing.frame_size(127) == 4 + 127
     assert framing.frame_size(128) == 5 + 128
     assert framing.frame_size(1 << 20) == 2 + 1 + 3 + (1 << 20)
+
+
+def test_soak_concurrent_clients_bounded_threads():
+    """32 concurrent clients x repeated sync_with against one server node:
+    every exchange converges (the reference's per-replica isolation,
+    awset_test.go:159-168, held under real concurrency) and the server's
+    connection-thread population stays bounded by MAX_CONNS."""
+    import threading
+
+    n_clients, n_rounds = 32, 4
+    num_actors = n_clients + 1
+    e_soak = 64  # the universe must hold one element per participant
+    server = Node(0, e_soak, num_actors)
+    clients = [Node(i + 1, e_soak, num_actors) for i in range(n_clients)]
+    errors = []
+    peak_threads = [threading.active_count()]
+
+    with server:
+        addr = server.serve()
+        server.add(0)
+
+        def run(i, node):
+            try:
+                node.add(i + 1)
+                for _ in range(n_rounds):
+                    node.sync_with(addr)
+                    peak_threads[0] = max(peak_threads[0],
+                                          threading.active_count())
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=run, args=(i, c))
+                   for i, c in enumerate(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors[:3]
+        # the server absorbed every client's element
+        assert set(server.members()) == set(range(n_clients + 1))
+        # one final pull so every client sees the fully-merged server
+        for c in clients:
+            c.sync_with(addr)
+        for c in clients:
+            assert set(c.members()) == set(range(n_clients + 1))
+    # baseline + 32 client threads + server accept/conn threads; the cap
+    # keeps connection threads <= MAX_CONNS even under the burst
+    assert peak_threads[0] <= threading.active_count() + n_clients \
+        + server.MAX_CONNS + 8
+
+
+def test_server_sheds_connections_at_capacity():
+    """At max_conns the accept loop closes new dials instead of queueing
+    (a shed exchange is a lost gossip round, which anti-entropy heals)."""
+    import socket as socket_mod
+
+    server = Node(0, E, A, max_conns=1, conn_timeout_s=5.0)
+    with server:
+        addr = server.serve()
+        # occupy the single slot with a half-open connection
+        hog = socket_mod.create_connection(addr, timeout=5.0)
+        try:
+            time.sleep(0.1)  # let the handler thread claim the slot
+            # the next dial must be shed: the server closes it without a
+            # byte, so the client's recv sees EOF quickly
+            probe = socket_mod.create_connection(addr, timeout=5.0)
+            with probe:
+                probe.settimeout(5.0)
+                assert probe.recv(1) == b""  # closed, not served
+        finally:
+            hog.close()
+        # slot released: a real exchange works again
+        peer = Node(1, E, A)
+        peer.add(3)
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                peer.sync_with(addr)
+                break
+            except (OSError, framing.ProtocolError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+        assert 3 in server.members()

@@ -113,3 +113,54 @@ def test_fused_delta_converges_like_xla():
         pal = pallas_delta.pallas_delta_gossip_round(
             pal, gossip.ring_perm(8, off))
     _assert_equal(xla, pal, "converged fixed point")
+
+
+@pytest.mark.parametrize("offset", [1, 63, 64, 65, 120])
+def test_delta_ring_round_matches_xla(offset):
+    """Ring-fused δ kernel (in-place partner windows) vs the XLA v2 δ
+    round over the same ring perm: block-aligned, misaligned, and
+    wraparound offsets."""
+    import random
+
+    from go_crdt_playground_tpu.ops import pallas_merge
+
+    rng = random.Random(111)
+    num_r = 2 * pallas_merge._BLOCK_R  # ring path needs aligned blocks
+    st = _scenario_state(rng, num_r, 128, 8)
+    want = gossip.delta_gossip_round(
+        st, gossip.ring_perm(num_r, offset), delta_semantics="v2",
+        kernel="xla")
+    got = pallas_delta.pallas_delta_ring_round(st, offset)
+    _assert_equal(want, got, f"ring offset {offset}")
+
+
+def test_delta_ring_fallback_unaligned_rows():
+    """R not a _BLOCK_R multiple falls back to the gather-path kernel
+    with identical results."""
+    import random
+
+    rng = random.Random(112)
+    st = _scenario_state(rng, 12, 64, 5)
+    want = gossip.delta_gossip_round(
+        st, gossip.ring_perm(12, 5), delta_semantics="v2", kernel="xla")
+    got = pallas_delta.pallas_delta_ring_round(st, 5)
+    _assert_equal(want, got, "fallback")
+
+
+def test_delta_ring_gossip_round_dispatch_equal():
+    """parallel.gossip.delta_ring_gossip_round: kernel choices and the
+    drop-mask lane agree bitwise."""
+    import random
+
+    from go_crdt_playground_tpu.ops import pallas_merge
+
+    rng = random.Random(113)
+    num_r = 2 * pallas_merge._BLOCK_R
+    st = _scenario_state(rng, num_r, 64, 8)
+    drop = jnp.asarray(np.random.default_rng(0).random(num_r) < 0.3)
+    want = gossip.delta_gossip_round(
+        st, gossip.ring_perm(num_r, 5), drop, delta_semantics="v2",
+        kernel="xla")
+    for kernel in ("xla", "pallas"):
+        got = gossip.delta_ring_gossip_round(st, 5, drop, kernel=kernel)
+        _assert_equal(want, got, kernel)

@@ -146,3 +146,61 @@ def test_gossip_round_kernel_dispatch_equal():
     want = gossip.gossip_round(state, perm, drop, kernel="xla")
     got = gossip.gossip_round(state, perm, drop, kernel="pallas")
     assert_states_equal(want, got)
+
+
+@pytest.mark.parametrize("offset", [0, 1, 63, 64, 65, 127, 500])
+def test_ring_round_matches_xla(offset):
+    """Ring-fused kernel (in-place partner windows via block index maps
+    + dynamic sublane roll) vs the XLA round over the same ring perm,
+    across block-aligned and misaligned offsets incl. the wraparound."""
+    rng = np.random.default_rng(7)
+    num_r = 8 * pallas_merge._BLOCK_R  # ring path needs aligned blocks
+    state = rand_state(rng, num_r, 256, 5)
+    want = gossip.gossip_round(state, gossip.ring_perm(num_r, offset))
+    got = pallas_merge.pallas_ring_round_rows(state, offset)
+    assert_states_equal(want, got)
+
+
+def test_ring_round_fallback_unaligned_rows():
+    """R not a _BLOCK_R multiple falls back to the gather path with
+    identical results."""
+    rng = np.random.default_rng(8)
+    state = rand_state(rng, 70, 128, 3)
+    want = gossip.gossip_round(state, gossip.ring_perm(70, 9))
+    got = pallas_merge.pallas_ring_round_rows(state, 9)
+    assert_states_equal(want, got)
+
+
+def test_ring_round_traced_offset_one_program():
+    """The offset is data: a lax.scan over different offsets reuses one
+    compiled ring program and matches the per-offset XLA rounds."""
+    import jax
+
+    rng = np.random.default_rng(9)
+    num_r = 4 * pallas_merge._BLOCK_R
+    state = rand_state(rng, num_r, 128, 4)
+    offsets = jnp.asarray([1, 64, 65, 200], jnp.uint32)
+
+    @jax.jit
+    def run(s):
+        def body(c, off):
+            return pallas_merge.pallas_ring_round_rows(c, off), None
+        return jax.lax.scan(body, s, offsets)[0]
+
+    want = state
+    for off in [1, 64, 65, 200]:
+        want = gossip.gossip_round(want, gossip.ring_perm(num_r, off))
+    assert_states_equal(want, run(state))
+
+
+def test_ring_gossip_round_dispatch_equal():
+    """parallel.gossip.ring_gossip_round: every kernel choice and the
+    drop-mask lane agree bitwise with the perm-based round."""
+    rng = np.random.default_rng(10)
+    num_r = 2 * pallas_merge._BLOCK_R
+    state = rand_state(rng, num_r, 128, 4)
+    drop = jnp.asarray(rng.random(num_r) < 0.3)
+    want = gossip.gossip_round(state, gossip.ring_perm(num_r, 3), drop)
+    for kernel in ("xla", "pallas"):
+        got = gossip.ring_gossip_round(state, 3, drop, kernel=kernel)
+        assert_states_equal(want, got)
