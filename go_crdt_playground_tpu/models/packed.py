@@ -1,0 +1,80 @@
+"""Bitpacked state layouts: membership as uint32[R, ceil(E/32)].
+
+SURVEY §7.1/§7.3 step 5: the Pallas variant packs ``present`` (and the
+δ state's ``deleted``) 32 lanes per word — the ``Entries`` map keys
+(awset.go:58) as bits, not bytes.  8x less HBM traffic and checkpoint/
+wire footprint for those arrays; kernels unpack to bool lanes in VMEM
+(ops/pallas_merge._kernel_unpack_bits) and run the identical, bitwise-
+pinned merge algebra.
+
+The packed forms are pytrees of arrays only; the element count is not
+recoverable from the packed width (ceil rounds), so ``unpack_*`` take
+``num_elements`` explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from go_crdt_playground_tpu.models.awset import AWSetState
+from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
+from go_crdt_playground_tpu.ops.pallas_merge import pack_bits, unpack_bits
+
+
+class PackedAWSetState(NamedTuple):
+    vv: jnp.ndarray            # uint32[R, A]
+    present_bits: jnp.ndarray  # uint32[R, ceil(E/32)]
+    dot_actor: jnp.ndarray     # uint32[R, E]
+    dot_counter: jnp.ndarray   # uint32[R, E]
+    actor: jnp.ndarray         # uint32[R]
+
+
+class PackedAWSetDeltaState(NamedTuple):
+    vv: jnp.ndarray
+    present_bits: jnp.ndarray
+    dot_actor: jnp.ndarray
+    dot_counter: jnp.ndarray
+    actor: jnp.ndarray
+    deleted_bits: jnp.ndarray  # uint32[R, ceil(E/32)]
+    del_dot_actor: jnp.ndarray
+    del_dot_counter: jnp.ndarray
+    processed: jnp.ndarray
+
+
+def pack_awset(state: AWSetState) -> PackedAWSetState:
+    return PackedAWSetState(
+        vv=state.vv, present_bits=pack_bits(state.present),
+        dot_actor=state.dot_actor, dot_counter=state.dot_counter,
+        actor=state.actor)
+
+
+def unpack_awset(packed: PackedAWSetState, num_elements: int) -> AWSetState:
+    return AWSetState(
+        vv=packed.vv,
+        present=unpack_bits(packed.present_bits, num_elements),
+        dot_actor=packed.dot_actor, dot_counter=packed.dot_counter,
+        actor=packed.actor)
+
+
+def pack_awset_delta(state: AWSetDeltaState) -> PackedAWSetDeltaState:
+    return PackedAWSetDeltaState(
+        vv=state.vv, present_bits=pack_bits(state.present),
+        dot_actor=state.dot_actor, dot_counter=state.dot_counter,
+        actor=state.actor, deleted_bits=pack_bits(state.deleted),
+        del_dot_actor=state.del_dot_actor,
+        del_dot_counter=state.del_dot_counter, processed=state.processed)
+
+
+def unpack_awset_delta(packed: PackedAWSetDeltaState,
+                       num_elements: int) -> AWSetDeltaState:
+    return AWSetDeltaState(
+        vv=packed.vv,
+        present=unpack_bits(packed.present_bits, num_elements),
+        dot_actor=packed.dot_actor, dot_counter=packed.dot_counter,
+        actor=packed.actor,
+        deleted=unpack_bits(packed.deleted_bits, num_elements),
+        del_dot_actor=packed.del_dot_actor,
+        del_dot_counter=packed.del_dot_counter,
+        processed=packed.processed)

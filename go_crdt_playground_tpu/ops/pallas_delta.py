@@ -203,31 +203,54 @@ def _fused_delta_round(arrays, perm, block_e: int, interpret: bool):
             dda[:num_r, :num_e], ddc[:num_r, :num_e])
 
 
-def _make_delta_ring_kernel(interpret: bool):
+_PACKED_NAMES = ("present", "deleted")
+
+
+def _make_delta_ring_kernel(interpret: bool, packed_w: int = 0):
+    """packed_w > 0: ``present``/``deleted`` operands/outputs are
+    bitpacked uint32[blk_r, packed_w]; unpack after windowing, repack
+    before writing (pallas_merge bit helpers)."""
+    from go_crdt_playground_tpu.ops.pallas_merge import (
+        _kernel_pack_bits, _kernel_unpack_bits)
+
     def kernel(meta_ref, sact_ref, *refs):
         o = meta_ref[1]
         win = functools.partial(_ring_window, o_mod=o, interpret=interpret)
         n_a, n_e = len(_A_NAMED), len(_E_NAMED)
+        blk_e = refs[3 * len(_A_NAMED) + 3].shape[-1]  # a dot_actor block
         dst, src = {}, {}
         for k, name in enumerate(_A_NAMED + _E_NAMED):
             d_ref, lo_ref, hi_ref = refs[3 * k: 3 * k + 3]
-            dst[name] = d_ref[...]
-            src[name] = win(lo_ref[...], hi_ref[...])
+            d, s = d_ref[...], win(lo_ref[...], hi_ref[...])
+            if packed_w and name in _PACKED_NAMES:
+                d = _kernel_unpack_bits(d, blk_e).astype(jnp.uint8)
+                s = _kernel_unpack_bits(s, blk_e).astype(jnp.uint8)
+            dst[name] = d
+            src[name] = s
         out_refs = refs[3 * (n_a + n_e):]
         outs = _delta_algebra(dst, src, sact_ref[...])
-        for ref, val in zip(out_refs, outs):
+        for ref, name, val in zip(out_refs, _A_NAMED + _E_NAMED, outs):
+            if packed_w and name in _PACKED_NAMES:
+                val = _kernel_pack_bits(val, packed_w)
             ref[...] = val
 
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("block_e", "interpret"))
-def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool):
-    num_r, num_e = arrays["present"].shape
+@functools.partial(jax.jit,
+                   static_argnames=("block_e", "interpret", "packed_w"))
+def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
+                      packed_w: int = 0):
+    """packed_w > 0: arrays["present"]/["deleted"] are bitpacked
+    uint32[R, packed_w] (models.packed layout); the grid is then
+    single-j (each step repacks its full membership row)."""
+    num_r, num_e = arrays["dot_actor"].shape
     num_a = arrays["vv"].shape[1]
     r_pad, e_pad, a_pad, blk = row_block_layout(num_r, num_e, num_a,
                                                 block_e)
     assert r_pad == num_r, "callers must check ring_supported()"
+    if packed_w:
+        blk = e_pad  # packed words can't be lane-tiled; one j step
     nb = num_r // _BLOCK_R
 
     offset = offset % num_r
@@ -241,13 +264,28 @@ def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool):
     def pad(x, last):
         return jnp.pad(x, ((0, 0), (0, last - x.shape[1])))
 
-    ins = [s_actor]
-    for name in _A_NAMED + _E_NAMED:
-        x = pad(arrays[name], a_pad if name in _A_NAMED else e_pad)
-        ins += [x, x, x]
-
     in_specs, out_specs = ring_block_specs(
         nb, blk, a_pad, a_named=len(_A_NAMED), e_named=len(_E_NAMED))
+    b_blk = lambda m: pl.BlockSpec((_BLOCK_R, packed_w), m)  # noqa: E731
+    dst_m, lo_m, hi_m = (in_specs[0].index_map, in_specs[1].index_map,
+                         in_specs[2].index_map)
+    ins = [s_actor]
+    for k, name in enumerate(_A_NAMED + _E_NAMED):
+        if packed_w and name in _PACKED_NAMES:
+            x = arrays[name]
+            in_specs[3 * k: 3 * k + 3] = [b_blk(dst_m), b_blk(lo_m),
+                                          b_blk(hi_m)]
+            out_specs[k] = b_blk(dst_m)
+        else:
+            x = pad(arrays[name], a_pad if name in _A_NAMED else e_pad)
+        ins += [x, x, x]
+
+    out_shape = _out_shapes(num_r, a_pad, e_pad)
+    if packed_w:
+        for k, name in enumerate(_A_NAMED + _E_NAMED):
+            if name in _PACKED_NAMES:
+                out_shape[k] = jax.ShapeDtypeStruct((num_r, packed_w),
+                                                    jnp.uint32)
     s_blk = pl.BlockSpec((_BLOCK_R, 1), lambda i, j, meta: (i, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -256,14 +294,15 @@ def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool):
         out_specs=out_specs,
     )
     outs = pl.pallas_call(
-        _make_delta_ring_kernel(interpret),
+        _make_delta_ring_kernel(interpret, packed_w),
         grid_spec=grid_spec,
-        out_shape=_out_shapes(num_r, a_pad, e_pad),
+        out_shape=out_shape,
         interpret=interpret,
     )(meta, *ins)
     vv, proc, p, da, dc, d, dda, ddc = outs
-    return (vv[:, :num_a], proc[:, :num_a], p[:, :num_e], da[:, :num_e],
-            dc[:, :num_e], d[:, :num_e], dda[:, :num_e], ddc[:, :num_e])
+    trim_p = (lambda x: x) if packed_w else (lambda x: x[:, :num_e])
+    return (vv[:, :num_a], proc[:, :num_a], trim_p(p), da[:, :num_e],
+            dc[:, :num_e], trim_p(d), dda[:, :num_e], ddc[:, :num_e])
 
 
 def _state_as_arrays(state: AWSetDeltaState):
@@ -319,3 +358,34 @@ def pallas_delta_ring_round(state: AWSetDeltaState, offset, *,
     outs = _fused_delta_ring(_state_as_arrays(state), offset, block_e,
                              interpret)
     return _rebuild(state, *outs)
+
+
+def pallas_delta_ring_round_packed(state, offset, *,
+                                   interpret: bool | None = None):
+    """One fused δ ring round on the BITPACKED layout
+    (models.packed.PackedAWSetDeltaState): ``present``/``deleted``
+    cross HBM as uint32[R, E/32] — 8x less traffic and footprint for
+    the two membership arrays (at the north-star fleet that is ~0.5GB
+    of state and ~1GB of peak HBM).  Bitwise-equal through pack/unpack
+    to pallas_delta_ring_round; pinned by tests/test_packed.py."""
+    from go_crdt_playground_tpu.models.packed import PackedAWSetDeltaState
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not ring_supported(state.present_bits.shape[0]):
+        raise ValueError("packed ring kernel needs ring_supported(R); "
+                         "unpack and use the bool-layout paths instead")
+    arrays = {
+        "vv": state.vv, "processed": state.processed,
+        "present": state.present_bits, "dot_actor": state.dot_actor,
+        "dot_counter": state.dot_counter, "deleted": state.deleted_bits,
+        "del_dot_actor": state.del_dot_actor,
+        "del_dot_counter": state.del_dot_counter, "actor": state.actor,
+    }
+    w = state.present_bits.shape[1]
+    vv, proc, pb, da, dc, db, dda, ddc = _fused_delta_ring(
+        arrays, offset, 512, interpret, packed_w=w)
+    return PackedAWSetDeltaState(
+        vv=vv, present_bits=pb, dot_actor=da, dot_counter=dc,
+        actor=state.actor, deleted_bits=db, del_dot_actor=dda,
+        del_dot_counter=ddc, processed=proc)

@@ -336,10 +336,17 @@ _BLOCK_R = 64
 # is the only sizing constraint left.
 _VMEM_BUDGET_BYTES = 8 << 20
 
-# Actor-axis cap for the fused row kernels: vv/processed blocks are
-# [_BLOCK_R, a_pad] u32 and the chunked gather does A/128 passes per
-# E-slice, so very large actor axes belong on the XLA path.
-MAX_FUSED_ACTORS = 4096
+# Worst-case block counts across every kernel this layout sizes: the
+# ring δ kernel holds 8 A-shaped blocks (vv/processed x dst+lo+hi+out)
+# and 24 E-shaped blocks (6 arrays x dst+lo+hi+out).
+_A_BLOCKS_WORST = 8
+_E_BLOCKS_WORST = 24
+
+# Actor-axis cap for the fused row kernels: the A-shaped blocks alone
+# must leave room for at least one lane group of E-blocks within the
+# budget (2048 -> 4MB of A-blocks at _BLOCK_R=64); beyond it, use the
+# XLA path.
+MAX_FUSED_ACTORS = 2048
 
 
 def row_block_layout(num_r: int, num_e: int, num_a: int, block_e: int):
@@ -354,10 +361,9 @@ def row_block_layout(num_r: int, num_e: int, num_a: int, block_e: int):
         raise ValueError(
             f"actor axis A={num_a} too large for the fused row kernels "
             f"(cap {MAX_FUSED_ACTORS}); use the XLA path")
-    # ~13 element-shaped operand blocks (dst+src+out across both kernels)
-    # of [_BLOCK_R, blk] u32 plus the A-shaped vv blocks
-    budget_blk = (_VMEM_BUDGET_BYTES - 6 * _BLOCK_R * a_pad * 4) // (
-        13 * _BLOCK_R * 4)
+    budget_blk = (
+        _VMEM_BUDGET_BYTES - _A_BLOCKS_WORST * _BLOCK_R * a_pad * 4
+    ) // (_E_BLOCKS_WORST * _BLOCK_R * 4)
     blk = max(_LANE, min(_round_up(block_e, _LANE), e_pad,
                          budget_blk // _LANE * _LANE))
     while e_pad % blk:
@@ -421,6 +427,89 @@ def pallas_merge_pairwise_rows(dst: AWSetState, src: AWSetState, *,
 
 
 # ---------------------------------------------------------------------------
+# Bitpacked membership (SURVEY §7.1/§7.3 step 5)
+# ---------------------------------------------------------------------------
+#
+# ``present``/``deleted`` as uint32[R, E/32] — 8x less HBM and wire
+# traffic than the u8 layout for two of the per-element arrays.  The
+# packed form is the STORAGE layout; kernels unpack to bool lanes in
+# VMEM (one lane gather + per-lane shift), run the identical merge
+# algebra, and repack on the way out (an exact one-hot-weighted matmul:
+# each 16-bit half sums < 2^24 so f32 accumulation is exact).
+
+_WORD = 32
+
+
+def packed_width(num_e: int) -> int:
+    """Packed lane count for an element axis: ceil(E/32)."""
+    return (num_e + _WORD - 1) // _WORD
+
+
+def pack_bits(mask) -> jnp.ndarray:
+    """bool[R, E] -> uint32[R, ceil(E/32)] (bit e%32 of word e//32).
+    XLA-side helper for building/converting packed states."""
+    num_r, num_e = mask.shape
+    w = packed_width(num_e)
+    pad = w * _WORD - num_e
+    m = jnp.pad(mask.astype(jnp.uint32), ((0, 0), (0, pad)))
+    m = m.reshape(num_r, w, _WORD)
+    weights = (jnp.uint32(1) << jnp.arange(_WORD, dtype=jnp.uint32))
+    return (m * weights).sum(axis=2, dtype=jnp.uint32)
+
+
+def unpack_bits(bits, num_e: int) -> jnp.ndarray:
+    """uint32[R, ceil(E/32)] -> bool[R, E] (inverse of pack_bits)."""
+    num_r, w = bits.shape
+    shifts = jnp.arange(_WORD, dtype=jnp.uint32)
+    out = (bits[:, :, None] >> shifts[None, None, :]) & 1
+    return out.reshape(num_r, w * _WORD)[:, :num_e] != 0
+
+
+def _kernel_unpack_bits(bits, blk_e: int):
+    """In-kernel unpack: uint32[blk_r, W<=128] -> bool[blk_r, blk_e].
+    Word lookup is the same native lane gather HasDot uses; the bit
+    extract is a per-lane variable shift."""
+    blk_r, w = bits.shape
+    if w > _LANE:  # the word gather is one lane group wide
+        raise ValueError(
+            f"packed membership caps E at {32 * _LANE} (one gather lane "
+            f"group of words); got packed width {w}")
+    if w < _LANE:  # gather operands must be exactly one lane group wide
+        bits = jnp.concatenate(
+            [bits, jnp.zeros((blk_r, _LANE - w), jnp.uint32)], axis=1)
+    out = []
+    for e0 in range(0, blk_e, _LANE):
+        lane = jax.lax.broadcasted_iota(jnp.uint32, (blk_r, _LANE), 1)
+        eids = lane + jnp.uint32(e0)
+        word = jnp.take_along_axis(bits, eids >> 5, axis=1)
+        out.append((word >> (eids & 31)) & 1)
+    bit = out[0] if len(out) == 1 else jnp.concatenate(out, axis=1)
+    return bit != 0
+
+
+def _kernel_pack_bits(mask_u8, w: int) -> jnp.ndarray:
+    """In-kernel repack: uint8/bool[blk_r, blk_e] -> uint32[blk_r, W]
+    via two exact f32 matmuls (low/high 16 bits of each word; each
+    product sums <= 16 terms < 2^16, exact in f32)."""
+    blk_r, blk_e = mask_u8.shape
+    as_i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)  # noqa: E731
+    m = mask_u8.astype(jnp.float32)
+    e_ids = jax.lax.broadcasted_iota(jnp.uint32, (blk_e, w), 0)
+    word = jax.lax.broadcasted_iota(jnp.uint32, (blk_e, w), 1)
+    in_word = (e_ids >> 5) == word
+    bit = e_ids & 31
+    w_lo = jnp.where(in_word & (bit < 16),
+                     jnp.uint32(1) << (bit & 15), 0)
+    w_hi = jnp.where(in_word & (bit >= 16),
+                     jnp.uint32(1) << (bit & 15), 0)
+    lo = jnp.dot(m, as_i32(w_lo).astype(jnp.float32),
+                 preferred_element_type=jnp.float32).astype(jnp.int32)
+    hi = jnp.dot(m, as_i32(w_hi).astype(jnp.float32),
+                 preferred_element_type=jnp.float32).astype(jnp.int32)
+    return jax.lax.bitcast_convert_type(lo | (hi << 16), jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
 # Ring-fused variant: partner rows via prefetch-driven block index maps
 # ---------------------------------------------------------------------------
 #
@@ -451,19 +540,28 @@ def _ring_window(lo, hi, o_mod, interpret: bool):
     return roll(stacked, -o_mod, 0)[:_BLOCK_R]
 
 
-def _make_ring_kernel(interpret: bool):
+def _make_ring_kernel(interpret: bool, packed_w: int = 0):
+    """packed_w > 0: the membership operand/output is bitpacked
+    uint32[blk_r, packed_w]; unpack after windowing, repack before
+    writing."""
     def kernel(meta_ref, dvv_ref, avv_ref, bvv_ref, dp_ref, ap_ref, bp_ref,
                dda_ref, ada_ref, bda_ref, ddc_ref, adc_ref, bdc_ref,
                ovv_ref, op_ref, oda_ref, odc_ref):
         o = meta_ref[1]
         win = functools.partial(_ring_window, o_mod=o, interpret=interpret)
-        outs = _merge_algebra(
-            dvv_ref[...], win(avv_ref[...], bvv_ref[...]),
-            dp_ref[...], win(ap_ref[...], bp_ref[...]),
+        dp, sp = dp_ref[...], win(ap_ref[...], bp_ref[...])
+        if packed_w:
+            blk_e = dda_ref.shape[-1]
+            dp = _kernel_unpack_bits(dp, blk_e).astype(jnp.uint8)
+            sp = _kernel_unpack_bits(sp, blk_e).astype(jnp.uint8)
+        vv, p_u8, da, dc = _merge_algebra(
+            dvv_ref[...], win(avv_ref[...], bvv_ref[...]), dp, sp,
             dda_ref[...], win(ada_ref[...], bda_ref[...]),
             ddc_ref[...], win(adc_ref[...], bdc_ref[...]))
-        for ref, val in zip((ovv_ref, op_ref, oda_ref, odc_ref), outs):
-            ref[...] = val
+        ovv_ref[...] = vv
+        op_ref[...] = _kernel_pack_bits(p_u8, packed_w) if packed_w else p_u8
+        oda_ref[...] = da
+        odc_ref[...] = dc
 
     return kernel
 
@@ -521,26 +619,44 @@ def ring_meta(offset, num_r: int) -> jnp.ndarray:
         jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("block_e", "interpret"))
-def _fused_rows_ring(dst_arrays, offset, block_e: int, interpret: bool):
+@functools.partial(jax.jit,
+                   static_argnames=("block_e", "interpret", "packed_w"))
+def _fused_rows_ring(dst_arrays, offset, block_e: int, interpret: bool,
+                     packed_w: int = 0):
+    """dst_arrays: (vv, present, da, dc) — present as uint8[R, E], or
+    bitpacked uint32[R, packed_w] when packed_w > 0 (the grid is then
+    single-j: packed words can't be lane-tiled and each step repacks
+    its full membership row)."""
     num_r, num_e = dst_arrays[2].shape
     num_a = dst_arrays[0].shape[1]
     r_pad, e_pad, a_pad, blk = row_block_layout(num_r, num_e, num_a,
                                                 block_e)
     assert r_pad == num_r, "callers must check ring_supported()"
+    if packed_w:
+        blk = e_pad
     nb = num_r // _BLOCK_R
 
     def pad_e(x):
         return jnp.pad(x, ((0, 0), (0, e_pad - num_e)))
 
-    vv, p_u8, da, dc = dst_arrays
+    vv, pres, da, dc = dst_arrays
     if a_pad != num_a:
         vv = jnp.pad(vv, ((0, 0), (0, a_pad - num_a)))
-    p_u8, da, dc = pad_e(p_u8), pad_e(da), pad_e(dc)
+    if not packed_w:
+        pres = pad_e(pres)
+    da, dc = pad_e(da), pad_e(dc)
 
     meta = ring_meta(offset, num_r)
     in_specs, out_specs = ring_block_specs(nb, blk, a_pad, a_named=1,
                                            e_named=3)
+    p_shape = jax.ShapeDtypeStruct((num_r, e_pad), jnp.uint8)
+    if packed_w:
+        b_blk = lambda m: pl.BlockSpec((_BLOCK_R, packed_w), m)  # noqa: E731
+        dst_m, lo_m, hi_m = (in_specs[0].index_map, in_specs[1].index_map,
+                             in_specs[2].index_map)
+        in_specs[3:6] = [b_blk(dst_m), b_blk(lo_m), b_blk(hi_m)]
+        out_specs[1] = b_blk(dst_m)
+        p_shape = jax.ShapeDtypeStruct((num_r, packed_w), jnp.uint32)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nb, e_pad // blk),
@@ -548,17 +664,18 @@ def _fused_rows_ring(dst_arrays, offset, block_e: int, interpret: bool):
         out_specs=out_specs,
     )
     out_vv, out_p, out_da, out_dc = pl.pallas_call(
-        _make_ring_kernel(interpret),
+        _make_ring_kernel(interpret, packed_w),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((num_r, a_pad), jnp.uint32),
-            jax.ShapeDtypeStruct((num_r, e_pad), jnp.uint8),
+            p_shape,
             jax.ShapeDtypeStruct((num_r, e_pad), jnp.uint32),
             jax.ShapeDtypeStruct((num_r, e_pad), jnp.uint32),
         ],
         interpret=interpret,
-    )(meta, vv, vv, vv, p_u8, p_u8, p_u8, da, da, da, dc, dc, dc)
-    return (out_vv[:, :num_a], out_p[:, :num_e],
+    )(meta, vv, vv, vv, pres, pres, pres, da, da, da, dc, dc, dc)
+    out_p = out_p if packed_w else out_p[:, :num_e]
+    return (out_vv[:, :num_a], out_p,
             out_da[:, :num_e], out_dc[:, :num_e])
 
 
@@ -585,6 +702,29 @@ def pallas_ring_round_rows(state: AWSetState, offset, *,
                                      interpret)
     return AWSetState(vv=vv, present=p != 0, dot_actor=da, dot_counter=dc,
                       actor=state.actor)
+
+
+def pallas_ring_round_rows_packed(state, offset, *,
+                                  interpret: bool | None = None):
+    """One fused ring round on the BITPACKED layout
+    (models.packed.PackedAWSetState): membership crosses HBM as
+    uint32[R, E/32] — 8x less traffic for that array — and is unpacked/
+    repacked inside the kernel.  Bitwise-equal (through pack/unpack) to
+    pallas_ring_round_rows on the bool layout; pinned by
+    tests/test_packed.py."""
+    from go_crdt_playground_tpu.models.packed import PackedAWSetState
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not ring_supported(state.present_bits.shape[0]):
+        raise ValueError("packed ring kernel needs ring_supported(R); "
+                         "unpack and use the bool-layout paths instead")
+    vv, pb, da, dc = _fused_rows_ring(
+        (state.vv, state.present_bits, state.dot_actor,
+         state.dot_counter), offset, 512, interpret,
+        packed_w=state.present_bits.shape[1])
+    return PackedAWSetState(vv=vv, present_bits=pb, dot_actor=da,
+                            dot_counter=dc, actor=state.actor)
 
 
 def pallas_gossip_round_rows(state: AWSetState, perm, *,

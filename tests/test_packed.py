@@ -1,0 +1,127 @@
+"""Bitpacked membership layout (models/packed.py + the packed ring
+kernels): bitwise conformance against the bool layout.
+
+SURVEY §7.1/§7.3 step 5 — ``present``/``deleted`` as uint32[R, E/32].
+The contract: pack -> packed ring round -> unpack must equal the bool
+ring round bitwise, so the packed layout is a pure storage change,
+never a semantics change.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from go_crdt_playground_tpu.models import packed as packed_mod
+from go_crdt_playground_tpu.models.awset import AWSetState
+from go_crdt_playground_tpu.ops import pallas_delta, pallas_merge
+from go_crdt_playground_tpu.parallel import gossip
+
+R = 2 * pallas_merge._BLOCK_R
+
+
+def rand_state(rng, num_r, num_e, num_a):
+    present = rng.random((num_r, num_e)) < 0.5
+    da = np.where(present, rng.integers(0, num_a, (num_r, num_e)),
+                  0).astype(np.uint32)
+    dc = np.where(present, rng.integers(1, 9, (num_r, num_e)),
+                  0).astype(np.uint32)
+    return AWSetState(
+        vv=jnp.asarray(rng.integers(0, 10, (num_r, num_a))
+                       .astype(np.uint32)),
+        present=jnp.asarray(present), dot_actor=jnp.asarray(da),
+        dot_counter=jnp.asarray(dc),
+        actor=jnp.arange(num_r, dtype=jnp.uint32) % num_a)
+
+
+@pytest.mark.parametrize("num_e", [32, 100, 256])
+def test_pack_unpack_roundtrip(num_e):
+    rng = np.random.default_rng(1)
+    mask = jnp.asarray(rng.random((24, num_e)) < 0.4)
+    bits = pallas_merge.pack_bits(mask)
+    assert bits.shape == (24, (num_e + 31) // 32)
+    np.testing.assert_array_equal(
+        np.asarray(pallas_merge.unpack_bits(bits, num_e)),
+        np.asarray(mask))
+
+
+@pytest.mark.parametrize("offset", [1, 65, 127])
+def test_packed_ring_round_matches_bool(offset):
+    rng = np.random.default_rng(2)
+    state = rand_state(rng, R, 256, 5)
+    want = pallas_merge.pallas_ring_round_rows(state, offset)
+    got_packed = pallas_merge.pallas_ring_round_rows_packed(
+        packed_mod.pack_awset(state), offset)
+    got = packed_mod.unpack_awset(got_packed, 256)
+    for name in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, name)),
+            np.asarray(getattr(got, name)), err_msg=name)
+
+
+def test_packed_ring_round_ragged_e():
+    """E not a multiple of 32 or 128: padded bits stay zero."""
+    rng = np.random.default_rng(3)
+    state = rand_state(rng, R, 200, 3)
+    want = pallas_merge.pallas_ring_round_rows(state, 9)
+    got_packed = pallas_merge.pallas_ring_round_rows_packed(
+        packed_mod.pack_awset(state), 9)
+    got = packed_mod.unpack_awset(got_packed, 200)
+    for name in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, name)),
+            np.asarray(getattr(got, name)), err_msg=name)
+
+
+@pytest.mark.parametrize("offset", [1, 65])
+def test_packed_delta_ring_round_matches_bool(offset):
+    import random
+
+    from tests.test_pallas_delta import _scenario_state
+
+    rng = random.Random(44)
+    state = _scenario_state(rng, R, 128, 8)
+    want = pallas_delta.pallas_delta_ring_round(state, offset)
+    got_packed = pallas_delta.pallas_delta_ring_round_packed(
+        packed_mod.pack_awset_delta(state), offset)
+    got = packed_mod.unpack_awset_delta(got_packed, 128)
+    for name in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, name)),
+            np.asarray(getattr(got, name)), err_msg=name)
+
+
+def test_packed_schedule_stays_packed_and_converges():
+    """A whole dissemination schedule on the packed layout (traced
+    offsets, one program) converges to the bool layout's result."""
+    rng = np.random.default_rng(5)
+    state = rand_state(rng, R, 128, 4)
+    offsets = jnp.asarray(gossip.dissemination_offsets(R), jnp.uint32)
+
+    @jax.jit
+    def run_packed(p):
+        def body(c, off):
+            return pallas_merge.pallas_ring_round_rows_packed(c, off), None
+        return jax.lax.scan(body, p, offsets)[0]
+
+    want = state
+    for off in gossip.dissemination_offsets(R):
+        want = gossip.gossip_round(want, gossip.ring_perm(R, off),
+                                   kernel="xla")
+    got = packed_mod.unpack_awset(
+        run_packed(packed_mod.pack_awset(state)), 128)
+    for name in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, name)),
+            np.asarray(getattr(got, name)), err_msg=name)
+    assert bool(gossip.converged_jit(got.present, got.vv))
+
+
+def test_packed_nbytes_are_8x_smaller():
+    """The storage win the layout exists for: membership bytes drop 8x
+    (32 lanes per uint32 word vs 1 byte per bool lane)."""
+    rng = np.random.default_rng(6)
+    state = rand_state(rng, R, 256, 4)
+    packed = packed_mod.pack_awset(state)
+    assert packed.present_bits.nbytes * 8 == state.present.nbytes
