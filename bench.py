@@ -595,11 +595,23 @@ def measure_northstar(num_replicas=None, num_elements=256, num_writers=256):
     # (steady-state peak = state + outputs ~ 13GB).
     import functools
 
+    # CRDT_NORTHSTAR_PACKED=1 runs the schedule on the bitpacked layout
+    # (models/packed.py): membership crosses HBM as uint32[R, E/32] —
+    # the measured bitpack round-time delta for VERDICT r2 item #3.
+    packed = os.environ.get("CRDT_NORTHSTAR_PACKED") == "1"
+    if packed:
+        from go_crdt_playground_tpu.models import packed as packed_mod
+        from go_crdt_playground_tpu.ops.pallas_delta import (
+            pallas_delta_ring_round_packed)
+
     @functools.partial(jax.jit, static_argnames=("n",), donate_argnums=0)
     def run_schedule(state, n):
         def body(s, i):
+            off = offs[i % n_rounds]
+            if packed:
+                return pallas_delta_ring_round_packed(s, off), None
             return gossip.delta_ring_gossip_round(
-                s, offs[i % n_rounds], delta_semantics="v2"), None
+                s, off, delta_semantics="v2"), None
         state, _ = jax.lax.scan(body, state, jnp.arange(n))
         return state
 
@@ -615,22 +627,29 @@ def measure_northstar(num_replicas=None, num_elements=256, num_writers=256):
         trustworthy sync; the constant ~70ms tunnel round-trip it adds
         is cancelled by the (t(2n) - t(n)) fit below.
         """
-        state = _delta_fleet(num_replicas, num_elements, num_writers)
+        state = _make_fleet()
         float(jnp.asarray(state.vv[0, 0]))  # settle construction
         t0 = time.perf_counter()
         state = run_schedule(state, n)
         float(jnp.asarray(state.vv[0, 0]))  # forces the whole scan
         return time.perf_counter() - t0, state
 
+    def _make_fleet():
+        fleet = _delta_fleet(num_replicas, num_elements, num_writers)
+        if packed:
+            fleet = packed_mod.pack_awset_delta(fleet)
+        return fleet
+
     # compile both round counts on throwaway fleets (donation consumes);
     # the scalar fetch drains the execution queue so the timed runs
     # don't inherit warmup work
     for n in (n_rounds, 2 * n_rounds):
-        warm = run_schedule(_delta_fleet(num_replicas, num_elements,
-                                         num_writers), n)
+        warm = run_schedule(_make_fleet(), n)
         float(jnp.asarray(warm.vv[0, 0]))
         del warm
     t1, state = timed(n_rounds)
+    if packed:
+        state = packed_mod.unpack_awset_delta(state, num_elements)
     converged = bool(gossip.converged_jit(state.present, state.vv))
     del state
     t2, state2 = timed(2 * n_rounds)
@@ -640,7 +659,8 @@ def measure_northstar(num_replicas=None, num_elements=256, num_writers=256):
     return {
         "metric": f"north star: {num_replicas} x {num_elements}-element "
                   "delta-AWSet replicas, all-pairs converged "
-                  f"({n_rounds} dissemination rounds, v2 delta gossip)",
+                  f"({n_rounds} dissemination rounds, v2 delta gossip"
+                  f"{', bitpacked membership' if packed else ''})",
         "value": round(t1, 4),
         "unit": "seconds (single chip, incl. one ~70ms tunnel sync)",
         "converged": converged,

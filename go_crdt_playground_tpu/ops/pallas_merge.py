@@ -30,18 +30,27 @@ a zero dot on an absent lane is "covered by every clock" and the lane's
 to absent (same canonical zeroing as ops/merge.py).
 
 Measured regime guidance (v5e 1x1, R=10K, E=A=256, honest scan-timed
-rounds — the sync scalar must consume every output or XLA dead-codes
-the dot/membership computation and the number measures only the VV
-join):
+rounds — warm BOTH fit counts before timing, and the sync scalar must
+consume every output or XLA dead-codes the dot/membership computation
+and the number measures only the VV join):
   * XLA path: ~56ms/round — the elementwise HasDot gather
     (take_along_axis with [R, E] indices) hits a pathological lowering
     inside compiled loops; the VV-join chain alone runs at roofline
     (~45us/round), so the gather is ~99% of the cost.
   * this one-row kernel: ~2.4ms/round (grid overhead, ~240ns x R steps).
-  * the multi-row variant below: ~1.4ms/round — the production path.
-Prefer pallas_gossip_round_rows on TPU everywhere; this one-row variant
-remains for huge-E/modest-R streaming (row state >> VMEM) and as the
-scalar-prefetch reference.  tests/test_pallas_merge.py pins bitwise
+  * 8-row blocks + one-hot MXU HasDot (the round-2 production path):
+    ~1.37ms/round (7.3M merges/s) — ~9x off the streaming bound; the
+    O(A x E) one-hot selector materialization dominated.
+  * 64-row blocks + native lane-gather HasDot, XLA partner gather
+    (pallas_gossip_round_rows): ~0.37ms/round (26.7M merges/s).
+  * ring-fused (pallas_ring_round_rows, partner rows in place):
+    ~0.22ms/round (45.4M merges/s) — the production path.
+HBM streaming bound for the ring round at this config: read state
+(32.5MB) + read partner windows (32.5MB) + write outputs (32.5MB)
+= 97.5MB at the measured ~590GB/s device bandwidth -> ~0.165ms/round,
+so the ring kernel runs within ~1.3x of its bound (was ~9x).
+The one-row variant remains for huge-E/modest-R streaming (row state
+>> VMEM) and as the scalar-prefetch reference; tests pin bitwise
 equality across all paths, so schedulers may pick per shape freely.
 """
 

@@ -457,13 +457,32 @@ def rounds_to_convergence(
     delta: bool = False,
     delta_semantics: str = "v2",
     schedule: str = "dissemination",
+    check_every: int = 8,
 ) -> Tuple[int, object]:
     """Host-driven convergence loop: gossip until every replica agrees on
     (membership, VV); returns (rounds, final state).  The north-star
     metric's measurement harness (BASELINE.md).
 
     With drop_rate > 0 each replica's exchange is lost independently per
-    round (requires ``key``)."""
+    round (requires ``key``).
+
+    check_every: how many rounds run between host-synced convergence
+    checks.  Every check is a device->host round trip (~60ms through a
+    remote-TPU tunnel), so per-round checking dominates measurement at
+    fleet scale; with a chunk size k the loop pays rounds/k + O(log k)
+    syncs instead of rounds.  The returned round count is EXACT for any
+    chunk size: when a chunk lands converged, the minimal prefix is
+    found by bisection, replaying rounds from the chunk-start state —
+    valid because round randomness derives from the round INDEX
+    (fold_in), so replay reproduces the same drops/pairings, and a
+    converged fleet stays converged under further gossip (merge is
+    idempotent), making convergence monotone within the chunk.
+
+    Memory note: chunking keeps the chunk-start state live for replay —
+    ONE extra fleet copy on device.  When a fleet barely fits (e.g. the
+    1M-replica δ north star at ~6.5GB state), pass check_every=1 to
+    trade the sync savings back for the old single-copy footprint.
+    """
     R = state.vv.shape[0]
     offsets = dissemination_offsets(R) or [1]
     round_fn = delta_gossip_round_jit if delta else gossip_round_jit
@@ -471,10 +490,9 @@ def rounds_to_convergence(
     # kernel takes the offset as DATA, so every round reuses one
     # compiled program and no permuted state copy is materialized
     ring_fn = delta_ring_gossip_round_jit if delta else ring_gossip_round_jit
+    kw = {"delta_semantics": delta_semantics} if delta else {}
 
-    for rnd in range(max_rounds):
-        if bool(converged_jit(state.present, state.vv)):
-            return rnd, state
+    def one_round(s, rnd: int):
         offset = None
         if schedule == "dissemination":
             offset = offsets[rnd % len(offsets)]
@@ -483,27 +501,54 @@ def rounds_to_convergence(
         elif schedule == "random":
             if key is None:
                 raise ValueError("random schedule requires a key")
-            key, sub = jax.random.split(key)
-            perm = random_perm(sub, R)
+            perm = random_perm(jax.random.fold_in(key, 2 * rnd), R)
         else:
             raise ValueError(f"unknown schedule {schedule!r}")
         drop = None
         if drop_rate > 0.0:
             if key is None:
                 raise ValueError("drop_rate requires a key")
-            key, sub = jax.random.split(key)
-            drop = jax.random.bernoulli(sub, drop_rate, (R,))
-        kw = {"delta_semantics": delta_semantics} if delta else {}
+            drop = jax.random.bernoulli(
+                jax.random.fold_in(key, 2 * rnd + 1), drop_rate, (R,))
         if offset is not None:
-            state = ring_fn(state, jnp.uint32(offset), drop, **kw)
-        else:
-            state = round_fn(state, perm, drop, **kw)
-    if not bool(converged_jit(state.present, state.vv)):
-        raise RuntimeError(
-            f"no convergence within {max_rounds} rounds "
-            f"(schedule={schedule!r}, drop_rate={drop_rate}) — refusing to "
-            "report an exhausted budget as a measured rounds-to-convergence")
-    return max_rounds, state
+            return ring_fn(s, jnp.uint32(offset), drop, **kw)
+        return round_fn(s, perm, drop, **kw)
+
+    def advance(s, start: int, n: int):
+        for i in range(n):
+            s = one_round(s, start + i)
+        return s
+
+    def conv(s) -> bool:
+        return bool(converged_jit(s.present, s.vv))
+
+    if conv(state):
+        return 0, state
+    rnd = 0
+    while rnd < max_rounds:
+        k = min(max(1, check_every), max_rounds - rnd)
+        chunk_start = state
+        state = advance(state, rnd, k)
+        if conv(state):
+            # invariants: NOT converged after lo rounds, converged after
+            # hi; each probe resumes from the last non-converged prefix
+            # (lo_state), so the whole bisection replays O(k) rounds
+            # total, not O(k log k)
+            lo, hi = 0, k
+            lo_state, hi_state = chunk_start, state
+            while lo + 1 < hi:
+                mid = (lo + hi) // 2
+                s_mid = advance(lo_state, rnd + lo, mid - lo)
+                if conv(s_mid):
+                    hi, hi_state = mid, s_mid
+                else:
+                    lo, lo_state = mid, s_mid
+            return rnd + hi, hi_state
+        rnd += k
+    raise RuntimeError(
+        f"no convergence within {max_rounds} rounds "
+        f"(schedule={schedule!r}, drop_rate={drop_rate}) — refusing to "
+        "report an exhausted budget as a measured rounds-to-convergence")
 
 
 # ---------------------------------------------------------------------------

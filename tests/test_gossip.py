@@ -316,3 +316,22 @@ def test_membership_hash_properties():
     d1 = np.asarray(collectives.state_digest(p1 | p2, vv))
     d2 = np.asarray(collectives.state_digest(p1 | p2, vv.at[0, 0].set(1)))
     assert d1[0] != d2[0]
+
+
+@pytest.mark.parametrize("check_every", [1, 4, 32])
+def test_rounds_to_convergence_chunked_exact(check_every):
+    """The chunked convergence loop returns the SAME minimal round count
+    for any chunk size (bisect replays from the chunk start with
+    index-derived randomness), including under drops."""
+    import random
+    rng = random.Random(21)
+    state = _random_state(rng, R=16)
+    want_rounds, want_out = gossip.rounds_to_convergence(
+        state, key=jax.random.PRNGKey(5), drop_rate=0.4,
+        schedule="random", max_rounds=300, check_every=1)
+    got_rounds, got_out = gossip.rounds_to_convergence(
+        state, key=jax.random.PRNGKey(5), drop_rate=0.4,
+        schedule="random", max_rounds=300, check_every=check_every)
+    assert got_rounds == want_rounds
+    for a, b in zip(jax.tree.leaves(want_out), jax.tree.leaves(got_out)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
