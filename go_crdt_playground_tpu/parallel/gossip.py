@@ -287,6 +287,35 @@ def ormap_gossip_round(state, perm: jnp.ndarray, kernel: str = "auto"):
     )
 
 
+def ormap_ring_gossip_round(state, offset, kernel: str = "auto"):
+    """OR-Map ring round: the key membership runs the ring-FUSED AWSet
+    kernel (in-place partner reads), the LWW value cells join against
+    partner rows obtained by a row roll (a contiguous-slice shift, not
+    the pathological elementwise gather).  Bitwise-equivalent to
+    ``ormap_gossip_round(state, ring_perm(R, offset))``."""
+    from go_crdt_playground_tpu.ops.lattices import ORMapState, _lww_newer
+
+    base = AWSetState(vv=state.vv, present=state.present,
+                      dot_actor=state.dot_actor,
+                      dot_counter=state.dot_counter, actor=state.actor)
+    merged = ring_gossip_round(base, offset, kernel=kernel)
+    # row gather, not jnp.roll: with a traced offset roll lowers to
+    # concatenate((x, x)) + dynamic_slice — a transient 2x copy per
+    # value plane — while a [R]-index row gather materializes exactly
+    # one partner copy at HBM bandwidth
+    src_rows = ring_perm(state.ts.shape[0], offset)
+    roll = lambda x: jnp.take(x, src_rows, axis=0)  # noqa: E731
+    src_ts, src_wa = roll(state.ts), roll(state.wr_actor)
+    take = _lww_newer(src_ts, src_wa, state.ts, state.wr_actor)
+    return ORMapState(
+        vv=merged.vv, present=merged.present, dot_actor=merged.dot_actor,
+        dot_counter=merged.dot_counter, actor=state.actor,
+        ts=jnp.where(take, src_ts, state.ts),
+        wr_actor=jnp.where(take, src_wa, state.wr_actor),
+        val=jnp.where(take, roll(state.val), state.val),
+    )
+
+
 def _extract_round(state: AWSetDeltaState, perm: jnp.ndarray):
     """Batched sender-side δ-extraction for one round's pairing: replica r
     will absorb perm[r], so extract perm[r]'s payload against r's VV."""

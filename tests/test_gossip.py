@@ -335,3 +335,50 @@ def test_rounds_to_convergence_chunked_exact(check_every):
     assert got_rounds == want_rounds
     for a, b in zip(jax.tree.leaves(want_out), jax.tree.leaves(got_out)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ormap_ring_round_matches_perm_round():
+    """Offset-form OR-Map ring round == perm-form round, bitwise, on
+    both kernel paths (pallas runs in interpret mode on CPU) and with
+    traced offsets through a scanned schedule."""
+    import random
+    from go_crdt_playground_tpu.ops import lattices as L
+
+    rng = random.Random(31)
+    from go_crdt_playground_tpu.ops import pallas_merge
+
+    R_, E_ = 2 * pallas_merge._BLOCK_R, 8  # ring-kernel-eligible R
+    st = L.ormap_init(R_, E_, R_)
+    ts = 0
+    for _ in range(60):
+        r, e = rng.randrange(R_), rng.randrange(E_)
+        if rng.random() < 0.6:
+            ts += 1
+            st = L.ormap_put(st, np.uint32(r), np.uint32(e),
+                             np.uint32(rng.randrange(1, 99)),
+                             np.uint32(ts))
+        else:
+            st = L.ormap_delete(st, np.uint32(r), np.uint32(e))
+    st0 = st
+    for off in (1, 5, 15):
+        want = gossip.ormap_gossip_round(st, gossip.ring_perm(R_, off),
+                                         kernel="xla")
+        for kernel in ("xla", "pallas"):
+            got = gossip.ormap_ring_gossip_round(st, off, kernel=kernel)
+            for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                              err_msg=f"{off}/{kernel}")
+        st = want
+
+    # traced offsets through a scanned schedule reuse one program
+    offsets = jnp.asarray([1, 5, 15], jnp.uint32)
+
+    @jax.jit
+    def run(s):
+        def body(c, off):
+            return gossip.ormap_ring_gossip_round(c, off), None
+        return jax.lax.scan(body, s, offsets)[0]
+
+    got = run(st0)
+    for a, b in zip(jax.tree.leaves(st), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -155,3 +155,18 @@ def test_packed_ring_kernels_mosaic(offset):
             packed_mod.pack_awset_delta(dstate), offset,
             interpret=False), E)
     _assert_equal(dwant, dgot)
+
+
+def test_ormap_ring_round_mosaic():
+    """OR-Map ring round (ring-fused core + LWW row gather) on-chip."""
+    from go_crdt_playground_tpu.ops import lattices as L
+
+    st = L.ormap_init(R, 64, R)
+    st = L.ormap_put(st, jnp.uint32(1), jnp.uint32(3), jnp.uint32(7),
+                     jnp.uint32(1))
+    st = L.ormap_put(st, jnp.uint32(2), jnp.uint32(5), jnp.uint32(9),
+                     jnp.uint32(2))
+    want = gossip.ormap_gossip_round(st, gossip.ring_perm(R, 3),
+                                     kernel="xla")
+    got = gossip.ormap_ring_gossip_round(st, 3)
+    _assert_equal(want, got)
