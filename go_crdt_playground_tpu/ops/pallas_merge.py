@@ -60,6 +60,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -499,12 +500,15 @@ def _kernel_unpack_bits(bits, blk_e: int):
 def _kernel_pack_bits(mask_u8, w: int) -> jnp.ndarray:
     """In-kernel repack: uint8/bool[blk_r, blk_e] -> uint32[blk_r, W]
     via two exact f32 matmuls (low/high 16 bits of each word; each
-    product sums <= 16 terms < 2^16, exact in f32)."""
+    product sums <= 16 terms < 2^16, exact in f32).  The weight
+    operand is built one full lane group wide (zeros beyond W) so the
+    MXU sees a lane-aligned N dim; the result slices back to W."""
     blk_r, blk_e = mask_u8.shape
+    w_pad = _round_up(w, _LANE)
     as_i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)  # noqa: E731
     m = mask_u8.astype(jnp.float32)
-    e_ids = jax.lax.broadcasted_iota(jnp.uint32, (blk_e, w), 0)
-    word = jax.lax.broadcasted_iota(jnp.uint32, (blk_e, w), 1)
+    e_ids = jax.lax.broadcasted_iota(jnp.uint32, (blk_e, w_pad), 0)
+    word = jax.lax.broadcasted_iota(jnp.uint32, (blk_e, w_pad), 1)
     in_word = (e_ids >> 5) == word
     bit = e_ids & 31
     w_lo = jnp.where(in_word & (bit < 16),
@@ -515,7 +519,8 @@ def _kernel_pack_bits(mask_u8, w: int) -> jnp.ndarray:
                  preferred_element_type=jnp.float32).astype(jnp.int32)
     hi = jnp.dot(m, as_i32(w_hi).astype(jnp.float32),
                  preferred_element_type=jnp.float32).astype(jnp.int32)
-    return jax.lax.bitcast_convert_type(lo | (hi << 16), jnp.uint32)
+    packed = jax.lax.bitcast_convert_type(lo | (hi << 16), jnp.uint32)
+    return jax.lax.slice(packed, (0, 0), (blk_r, w))
 
 
 # ---------------------------------------------------------------------------
@@ -549,24 +554,44 @@ def _ring_window(lo, hi, o_mod, interpret: bool):
     return roll(stacked, -o_mod, 0)[:_BLOCK_R]
 
 
-def _make_ring_kernel(interpret: bool, packed_w: int = 0):
+def _ring_src_reader(meta_ref, refs, n_named: int, interpret: bool,
+                     aligned: bool):
+    """Split a ring kernel's flat ref list into per-name (dst, src)
+    value pairs plus the output refs.  Windowed form: groups of
+    (dst, lo, hi) with the dynamic roll; aligned form: groups of
+    (dst, src) read directly (offset % _BLOCK_R == 0 — the window IS a
+    block)."""
+    group = 2 if aligned else 3
+    ins, outs = refs[:n_named * group], refs[n_named * group:]
+    pairs = []
+    for k in range(n_named):
+        g = ins[group * k: group * k + group]
+        d = g[0][...]
+        if aligned:
+            s = g[1][...]
+        else:
+            s = _ring_window(g[1][...], g[2][...], o_mod=meta_ref[1],
+                             interpret=interpret)
+        pairs.append((d, s))
+    return pairs, outs
+
+
+def _make_ring_kernel(interpret: bool, packed_w: int = 0,
+                      aligned: bool = False):
     """packed_w > 0: the membership operand/output is bitpacked
     uint32[blk_r, packed_w]; unpack after windowing, repack before
-    writing."""
-    def kernel(meta_ref, dvv_ref, avv_ref, bvv_ref, dp_ref, ap_ref, bp_ref,
-               dda_ref, ada_ref, bda_ref, ddc_ref, adc_ref, bdc_ref,
-               ovv_ref, op_ref, oda_ref, odc_ref):
-        o = meta_ref[1]
-        win = functools.partial(_ring_window, o_mod=o, interpret=interpret)
-        dp, sp = dp_ref[...], win(ap_ref[...], bp_ref[...])
+    writing.  aligned: single-src-block form (see ring_block_specs)."""
+    def kernel(meta_ref, *refs):
+        pairs, out_refs = _ring_src_reader(meta_ref, refs, 4, interpret,
+                                           aligned)
+        (dvv, svv), (dp, sp), (dda, sda), (ddc, sdc) = pairs
         if packed_w:
-            blk_e = dda_ref.shape[-1]
+            blk_e = dda.shape[-1]
             dp = _kernel_unpack_bits(dp, blk_e).astype(jnp.uint8)
             sp = _kernel_unpack_bits(sp, blk_e).astype(jnp.uint8)
-        vv, p_u8, da, dc = _merge_algebra(
-            dvv_ref[...], win(avv_ref[...], bvv_ref[...]), dp, sp,
-            dda_ref[...], win(ada_ref[...], bda_ref[...]),
-            ddc_ref[...], win(adc_ref[...], bdc_ref[...]))
+        vv, p_u8, da, dc = _merge_algebra(dvv, svv, dp, sp, dda, sda,
+                                          ddc, sdc)
+        ovv_ref, op_ref, oda_ref, odc_ref = out_refs
         ovv_ref[...] = vv
         op_ref[...] = _kernel_pack_bits(p_u8, packed_w) if packed_w else p_u8
         oda_ref[...] = da
@@ -576,11 +601,17 @@ def _make_ring_kernel(interpret: bool, packed_w: int = 0):
 
 
 def ring_block_specs(nb: int, blk: int, a_pad: int, a_named: int,
-                     e_named: int):
+                     e_named: int, aligned: bool = False):
     """(in_specs, out_specs) for a ring-fused kernel: per A-shaped array
-    one dst block + the two partner blocks the window spans, likewise
-    per E-shaped array; outputs are dst-aligned.  Block index maps read
-    the prefetched [offset//_BLOCK_R, offset%_BLOCK_R] meta operand."""
+    one dst block + the partner block(s), likewise per E-shaped array;
+    outputs are dst-aligned.  Block index maps read the prefetched
+    [offset//_BLOCK_R, offset%_BLOCK_R] meta operand.
+
+    aligned=True emits the block-aligned-offset form: ONE partner block
+    per array (the window is exactly a block when offset % _BLOCK_R
+    == 0), cutting the round's src traffic in half — from 2x state to
+    1x — on the aligned rounds, which at fleet scale is most of a
+    dissemination schedule (every offset >= _BLOCK_R is a multiple)."""
     def dst_a(i, j, meta):
         del j, meta
         return (i, 0)
@@ -605,8 +636,11 @@ def ring_block_specs(nb: int, blk: int, a_pad: int, a_named: int,
 
     a_blk = lambda m: pl.BlockSpec((_BLOCK_R, a_pad), m)   # noqa: E731
     e_blk = lambda m: pl.BlockSpec((_BLOCK_R, blk), m)     # noqa: E731
-    in_specs = ([a_blk(dst_a), a_blk(src_a_lo), a_blk(src_a_hi)] * a_named
-                + [e_blk(dst_e), e_blk(src_e_lo), e_blk(src_e_hi)] * e_named)
+    a_group = ([a_blk(dst_a), a_blk(src_a_lo)] if aligned
+               else [a_blk(dst_a), a_blk(src_a_lo), a_blk(src_a_hi)])
+    e_group = ([e_blk(dst_e), e_blk(src_e_lo)] if aligned
+               else [e_blk(dst_e), e_blk(src_e_lo), e_blk(src_e_hi)])
+    in_specs = a_group * a_named + e_group * e_named
     out_specs = [a_blk(dst_a)] * a_named + [e_blk(dst_e)] * e_named
     return in_specs, out_specs
 
@@ -628,14 +662,16 @@ def ring_meta(offset, num_r: int) -> jnp.ndarray:
         jnp.int32)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("block_e", "interpret", "packed_w"))
+@functools.partial(jax.jit, static_argnames=("block_e", "interpret",
+                                             "packed_w", "aligned"))
 def _fused_rows_ring(dst_arrays, offset, block_e: int, interpret: bool,
-                     packed_w: int = 0):
+                     packed_w: int = 0, aligned: bool = False):
     """dst_arrays: (vv, present, da, dc) — present as uint8[R, E], or
     bitpacked uint32[R, packed_w] when packed_w > 0 (the grid is then
     single-j: packed words can't be lane-tiled and each step repacks
-    its full membership row)."""
+    its full membership row).  aligned=True is the single-src-block
+    form, correct ONLY when offset % _BLOCK_R == 0 (callers dispatch
+    via _ring_round_dispatch)."""
     num_r, num_e = dst_arrays[2].shape
     num_a = dst_arrays[0].shape[1]
     r_pad, e_pad, a_pad, blk = row_block_layout(num_r, num_e, num_a,
@@ -644,6 +680,7 @@ def _fused_rows_ring(dst_arrays, offset, block_e: int, interpret: bool,
     if packed_w:
         blk = e_pad
     nb = num_r // _BLOCK_R
+    group = 2 if aligned else 3
 
     def pad_e(x):
         return jnp.pad(x, ((0, 0), (0, e_pad - num_e)))
@@ -657,14 +694,13 @@ def _fused_rows_ring(dst_arrays, offset, block_e: int, interpret: bool,
 
     meta = ring_meta(offset, num_r)
     in_specs, out_specs = ring_block_specs(nb, blk, a_pad, a_named=1,
-                                           e_named=3)
+                                           e_named=3, aligned=aligned)
     p_shape = jax.ShapeDtypeStruct((num_r, e_pad), jnp.uint8)
     if packed_w:
         b_blk = lambda m: pl.BlockSpec((_BLOCK_R, packed_w), m)  # noqa: E731
-        dst_m, lo_m, hi_m = (in_specs[0].index_map, in_specs[1].index_map,
-                             in_specs[2].index_map)
-        in_specs[3:6] = [b_blk(dst_m), b_blk(lo_m), b_blk(hi_m)]
-        out_specs[1] = b_blk(dst_m)
+        maps = [s.index_map for s in in_specs[group:2 * group]]
+        in_specs[group:2 * group] = [b_blk(m) for m in maps]
+        out_specs[1] = b_blk(in_specs[0].index_map)
         p_shape = jax.ShapeDtypeStruct((num_r, packed_w), jnp.uint32)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -672,8 +708,9 @@ def _fused_rows_ring(dst_arrays, offset, block_e: int, interpret: bool,
         in_specs=in_specs,
         out_specs=out_specs,
     )
+    ins = [x for arr in (vv, pres, da, dc) for x in (arr,) * group]
     out_vv, out_p, out_da, out_dc = pl.pallas_call(
-        _make_ring_kernel(interpret, packed_w),
+        _make_ring_kernel(interpret, packed_w, aligned),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((num_r, a_pad), jnp.uint32),
@@ -682,10 +719,27 @@ def _fused_rows_ring(dst_arrays, offset, block_e: int, interpret: bool,
             jax.ShapeDtypeStruct((num_r, e_pad), jnp.uint32),
         ],
         interpret=interpret,
-    )(meta, vv, vv, vv, pres, pres, pres, da, da, da, dc, dc, dc)
+    )(meta, *ins)
     out_p = out_p if packed_w else out_p[:, :num_e]
     return (out_vv[:, :num_a], out_p,
             out_da[:, :num_e], out_dc[:, :num_e])
+
+
+def _ring_round_dispatch(arrays, offset, run):
+    """Route a ring round to the aligned (single-src-block, half the
+    src traffic) or windowed kernel.  Static offsets pick at trace
+    time; traced offsets go through lax.cond so one compiled program
+    still serves a whole dissemination schedule — both kernel variants
+    live in it and the untaken branch costs nothing at run time.  At
+    fleet scale most dissemination rounds are aligned (every offset
+    >= _BLOCK_R in a doubling schedule is a multiple of it)."""
+    if isinstance(offset, (int, np.integer)):
+        return run(arrays, offset, offset % _BLOCK_R == 0)
+    return jax.lax.cond(
+        (offset % _BLOCK_R) == 0,
+        lambda a, o: run(a, o, True),
+        lambda a, o: run(a, o, False),
+        arrays, offset)
 
 
 def pallas_ring_round_rows(state: AWSetState, offset, *,
@@ -707,8 +761,10 @@ def pallas_ring_round_rows(state: AWSetState, offset, *,
         return pallas_gossip_round_rows(
             state, ring_perm(state.present.shape[0], offset),
             block_e=block_e, interpret=interpret)
-    vv, p, da, dc = _fused_rows_ring(_as_arrays(state), offset, block_e,
-                                     interpret)
+    vv, p, da, dc = _ring_round_dispatch(
+        _as_arrays(state), offset,
+        lambda a, o, al: _fused_rows_ring(a, o, block_e, interpret,
+                                          aligned=al))
     return AWSetState(vv=vv, present=p != 0, dot_actor=da, dot_counter=dc,
                       actor=state.actor)
 
@@ -728,10 +784,12 @@ def pallas_ring_round_rows_packed(state, offset, *,
     if not ring_supported(state.present_bits.shape[0]):
         raise ValueError("packed ring kernel needs ring_supported(R); "
                          "unpack and use the bool-layout paths instead")
-    vv, pb, da, dc = _fused_rows_ring(
+    w = state.present_bits.shape[1]
+    vv, pb, da, dc = _ring_round_dispatch(
         (state.vv, state.present_bits, state.dot_actor,
-         state.dot_counter), offset, 512, interpret,
-        packed_w=state.present_bits.shape[1])
+         state.dot_counter), offset,
+        lambda a, o, al: _fused_rows_ring(a, o, 512, interpret,
+                                          packed_w=w, aligned=al))
     return PackedAWSetState(vv=vv, present_bits=pb, dot_actor=da,
                             dot_counter=dc, actor=state.actor)
 
