@@ -28,10 +28,15 @@ Two variants share one algebra body:
     the gather path it needs state + gathered copy + outputs ~ 3 x
     6.5GB and OOMs a 16GB v5e.
 
-v2 δ semantics only — the strict-reference quirk path (empty-δ VV skip,
-awset-delta_test.go:60-64) needs a cross-E reduction per pair and stays
-on the XLA path, which is also the conformance reference these kernels
-are pinned against bitwise (tests/test_pallas_delta.py).
+Both δ semantics fuse: v2 (record-absorbing) and strict-reference.  The
+strict empty-δ VV-skip quirk (awset-delta_test.go:60-64) needs one
+cross-E reduction per pair; the kernels compute it as a per-element-
+block emptiness bit accumulated in VMEM scratch across the grid's inner
+(element) steps, finishing the per-row VV select at the last block
+(_strict_vv_epilogue) — so reference-mode fleets no longer pay the ~40x
+XLA HasDot path.  The XLA path (ops/delta.py) remains the conformance
+reference these kernels are pinned against bitwise
+(tests/test_pallas_delta.py).
 """
 
 from __future__ import annotations
@@ -45,20 +50,36 @@ from jax.experimental.pallas import tpu as pltpu
 
 from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
 from go_crdt_playground_tpu.ops.pallas_merge import (
-    _BLOCK_R, _ring_window, gather_rows, ring_block_specs, ring_meta,
-    ring_supported, row_block_layout)
+    _BLOCK_R, _ring_round_dispatch, _ring_window, gather_rows,
+    ring_block_specs, ring_meta, ring_supported, row_block_layout)
 
 _A_NAMED = ("vv", "processed")
 _E_NAMED = ("present", "dot_actor", "dot_counter", "deleted",
             "del_dot_actor", "del_dot_counter")
 
 
-def _delta_algebra(dst, src, s_actor):
+def _delta_algebra(dst, src, s_actor, mode: str = "v2"):
     """The fused δ exchange on value tuples.
 
     dst/src: dicts of [blk_r, A]- and [blk_r, blk_e]-shaped values
     (present/deleted as uint8); s_actor: uint32[blk_r, 1] — the sender's
-    actor id per row.  Returns the 8 output arrays in state order.
+    actor id per row.
+
+    mode selects the δ semantics (static):
+      * "v2"              — record-absorbing semantics (ops/delta.py v2);
+      * "reference"       — strict reference semantics incl. the empty-δ
+                            VV-skip quirk (awset-delta_test.go:60-64):
+                            the vv output is a PLACEHOLDER (dst's vv) and
+                            extras carry what the kernel epilogue needs
+                            to finish the per-row select after the
+                            cross-E emptiness reduction accumulates over
+                            every element block;
+      * "reference_loose" — reference arbitration with an unconditional
+                            VV join (strict_reference_semantics=False).
+
+    Returns (outs, extras): outs = the 8 output arrays in state order;
+    extras = (first_contact, joined_vv, nonempty_i32[blk_r, 1]) for
+    "reference", None otherwise.
     """
     dvv, svv = dst["vv"], src["vv"]
     dproc, sproc = dst["processed"], src["processed"]
@@ -85,68 +106,126 @@ def _delta_algebra(dst, src, s_actor):
     seen_s_by_d = sdc <= gather_rows(dvv, sda)       # receiver covers src dot
     seen_d_by_s = ddc <= gather_rows(svv, dda)       # sender covers dst dot
 
-    # ---- FULL branch (first contact; ops/delta.full_merge_delta v2) ----
+    # ---- FULL branch (first contact; ops/delta.full_merge_delta) ----
     take_f = sp & (dp | ~seen_s_by_d)
     present_f = take_f | (dp & ~sp & ~seen_d_by_s)
     da_f = jnp.where(present_f, jnp.where(take_f, sda, dda), 0)
     dc_f = jnp.where(present_f, jnp.where(take_f, sdc, ddc), 0)
-    rec_f = sd & (~dd | (sddc > dddc))
-    deleted_f = dd | sd
-    del_da_f = jnp.where(rec_f, sdda, ddda)
-    del_dc_f = jnp.where(rec_f, sddc, dddc)
 
-    # ---- δ branch (ops/delta.delta_extract + delta_apply, fused) ----
+    # ---- δ branch phase 1 (ops/delta.delta_extract, fused) ----
     changed = sp & ~seen_s_by_d                      # :84-92
     resurrected = sp & ((sda != sdda) | (sdc > sddc))  # :94-97
     deleted_p = sd & ~resurrected
     present1 = dp | changed                          # p1_take == changed
     da1 = jnp.where(changed, sda, dda)
     dc1 = jnp.where(changed, sdc, ddc)
-    # v2 arbitration: remove iff the SENDER's clock covers our live dot
-    remove = deleted_p & present1 & (dc1 <= gather_rows(svv, da1))
+    joined_vv = jnp.where(dvv < svv, svv, dvv)
+
+    if mode == "v2":
+        rec_f = sd & (~dd | (sddc > dddc))
+        deleted_f = dd | sd
+        del_da_f = jnp.where(rec_f, sdda, ddda)
+        del_dc_f = jnp.where(rec_f, sddc, dddc)
+        # v2 arbitration: remove iff the SENDER's clock covers our live dot
+        remove = deleted_p & present1 & (dc1 <= gather_rows(svv, da1))
+        present_d = present1 & ~remove
+        da_d = jnp.where(present_d, da1, 0)
+        dc_d = jnp.where(present_d, dc1, 0)
+        rec_d = deleted_p & (~dd | (sddc > dddc))
+        deleted_d = dd | deleted_p
+        del_da_d = jnp.where(rec_d, sdda, ddda)
+        del_dc_d = jnp.where(rec_d, sddc, dddc)
+
+        # ---- select per row; A-shaped outputs are branch-independent ----
+        # (select between i1 vectors doesn't lower on Mosaic —
+        # "Unsupported target bitwidth for truncation" — so widen the
+        # operands first)
+        out_p = jnp.where(fc, present_f.astype(jnp.uint8),
+                          present_d.astype(jnp.uint8))
+        out_da = jnp.where(fc, da_f, da_d)
+        out_dc = jnp.where(fc, dc_f, dc_d)
+        out_d = jnp.where(fc, deleted_f.astype(jnp.uint8),
+                          deleted_d.astype(jnp.uint8))
+        out_dda = jnp.where(fc, del_da_f, del_da_d)
+        out_ddc = jnp.where(fc, del_dc_f, del_dc_d)
+        proc = jnp.where(dproc < sproc, sproc, dproc)
+        # the sender's own slot advances to its clock (spec _join_processed)
+        out_proc = jnp.where(aonehot & (proc < svv), svv, proc)
+        return (joined_vv, out_proc, out_p, out_da, out_dc, out_d,
+                out_dda, out_ddc), None
+
+    # ---- reference arbitration (awset-delta_test.go:153-158): keep iff
+    # OUR clock covers the DELETION dot; deletion log / del dots /
+    # processed are never touched by a reference-mode receive
+    # (deltaMerge writes only Entries + VV, :126-165) ----
+    remove = deleted_p & present1 & ~(sddc <= gather_rows(dvv, sdda))
     present_d = present1 & ~remove
     da_d = jnp.where(present_d, da1, 0)
     dc_d = jnp.where(present_d, dc1, 0)
-    rec_d = deleted_p & (~dd | (sddc > dddc))
-    deleted_d = dd | deleted_p
-    del_da_d = jnp.where(rec_d, sdda, ddda)
-    del_dc_d = jnp.where(rec_d, sddc, dddc)
-
-    # ---- select per row; A-shaped outputs are branch-independent ----
-    # (select between i1 vectors doesn't lower on Mosaic — "Unsupported
-    # target bitwidth for truncation" — so widen the operands first)
     out_p = jnp.where(fc, present_f.astype(jnp.uint8),
                       present_d.astype(jnp.uint8))
     out_da = jnp.where(fc, da_f, da_d)
     out_dc = jnp.where(fc, dc_f, dc_d)
-    out_d = jnp.where(fc, deleted_f.astype(jnp.uint8),
-                      deleted_d.astype(jnp.uint8))
-    out_dda = jnp.where(fc, del_da_f, del_da_d)
-    out_ddc = jnp.where(fc, del_dc_f, del_dc_d)
-    out_vv = jnp.where(dvv < svv, svv, dvv)
-    proc = jnp.where(dproc < sproc, sproc, dproc)
-    # the sender's own slot advances to its clock (spec _join_processed)
-    out_proc = jnp.where(aonehot & (proc < svv), svv, proc)
-    return (out_vv, out_proc, out_p, out_da, out_dc, out_d, out_dda,
-            out_ddc)
+    out_d = dst["deleted"]
+    if mode == "reference_loose":
+        return (joined_vv, dproc, out_p, out_da, out_dc, out_d, ddda,
+                dddc), None
+    # strict: the empty-δ quirk needs ALL element blocks' payload masks;
+    # emit this block's per-row emptiness bit and dst's vv as a
+    # placeholder — the kernel epilogue accumulates the bits across the
+    # grid's j steps and finishes the select (fc rows take the full-merge
+    # branch, whose VV join is unconditional, awset-delta_test.go:55)
+    nonempty = jnp.max((changed | deleted_p).astype(jnp.int32), axis=1,
+                       keepdims=True)
+    return (dvv, dproc, out_p, out_da, out_dc, out_d, ddda, dddc), (
+        fc, joined_vv, nonempty)
 
 
-def _delta_kernel(sact_ref, dvv_ref, svv_ref, dpr_ref, spr_ref,
-                  dp_ref, sp_ref, dda_ref, sda_ref, ddc_ref, sdc_ref,
-                  dd_ref, sd_ref, ddda_ref, sdda_ref, dddc_ref, sddc_ref,
-                  ovv_ref, opr_ref, op_ref, oda_ref, odc_ref,
-                  od_ref, odda_ref, oddc_ref):
-    """General-perm kernel: partner rows pre-gathered, dst-aligned."""
-    refs = [dvv_ref, svv_ref, dpr_ref, spr_ref, dp_ref, sp_ref, dda_ref,
-            sda_ref, ddc_ref, sdc_ref, dd_ref, sd_ref, ddda_ref, sdda_ref,
-            dddc_ref, sddc_ref]
-    names = [n for name in _A_NAMED + _E_NAMED for n in (name, name)]
-    dst = {n: r[...] for n, r in zip(names[0::2], refs[0::2])}
-    src = {n: r[...] for n, r in zip(names[1::2], refs[1::2])}
-    outs = _delta_algebra(dst, src, sact_ref[...])
-    for ref, val in zip([ovv_ref, opr_ref, op_ref, oda_ref, odc_ref,
-                         od_ref, odda_ref, oddc_ref], outs):
-        ref[...] = val
+def _strict_vv_epilogue(ovv_ref, dvv, extras, scratch_ref):
+    """Finish the strict-reference VV select: accumulate this block's
+    per-row payload-emptiness bit across the grid's (inner) element
+    steps in VMEM scratch, and at the LAST element block write the
+    final per-row choice — joined VV for first-contact or nonempty-δ
+    rows, dst's VV otherwise (the empty-δ quirk,
+    awset-delta_test.go:60-64).  The A-shaped vv output block's index
+    map ignores j, so the block stays resident across the row's element
+    steps and the last write is the one flushed to HBM."""
+    fc, joined_vv, nonempty = extras
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _reset():
+        scratch_ref[...] = jnp.zeros_like(scratch_ref)
+
+    scratch_ref[...] = jnp.maximum(
+        scratch_ref[...], jnp.broadcast_to(nonempty, scratch_ref.shape))
+
+    @pl.when(j == n_j - 1)
+    def _finish():
+        seen_any = jnp.max(scratch_ref[...], axis=1, keepdims=True) != 0
+        ovv_ref[...] = jnp.where(fc | seen_any, joined_vv, dvv)
+
+
+def _make_delta_kernel(mode: str):
+    """General-perm kernel: partner rows pre-gathered, dst-aligned.
+    Strict-reference mode threads a [_BLOCK_R, _LANE] i32 VMEM scratch
+    (last positional ref) for the cross-E emptiness accumulation."""
+    def kernel(sact_ref, *refs):
+        if mode == "reference":
+            *refs, scratch_ref = refs
+        in_refs, out_refs = refs[:16], refs[16:]
+        names = [n for name in _A_NAMED + _E_NAMED for n in (name, name)]
+        dst = {n: r[...] for n, r in zip(names[0::2], in_refs[0::2])}
+        src = {n: r[...] for n, r in zip(names[1::2], in_refs[1::2])}
+        outs, extras = _delta_algebra(dst, src, sact_ref[...], mode)
+        for ref, val in zip(out_refs, outs):
+            ref[...] = val
+        if mode == "reference":
+            _strict_vv_epilogue(out_refs[0], dst["vv"], extras,
+                                scratch_ref)
+
+    return kernel
 
 
 def _out_shapes(num_r, a_pad, e_pad):
@@ -157,8 +236,10 @@ def _out_shapes(num_r, a_pad, e_pad):
             for w, d in zip(widths, dts)]
 
 
-@functools.partial(jax.jit, static_argnames=("block_e", "interpret"))
-def _fused_delta_round(arrays, perm, block_e: int, interpret: bool):
+@functools.partial(jax.jit,
+                   static_argnames=("block_e", "interpret", "mode"))
+def _fused_delta_round(arrays, perm, block_e: int, interpret: bool,
+                       mode: str = "v2"):
     """arrays: the 9 AWSetDeltaState fields as a dict of 2D device
     arrays (present/deleted as uint8)."""
     num_r, num_e = arrays["present"].shape
@@ -189,12 +270,15 @@ def _fused_delta_round(arrays, perm, block_e: int, interpret: bool):
         ins += [dst[name], src[name]]
         in_specs += [a_blk, a_blk] if name in _A_NAMED else [e_blk, e_blk]
 
+    scratch_shapes = ([pltpu.VMEM((_BLOCK_R, 128), jnp.int32)]
+                      if mode == "reference" else [])
     outs = pl.pallas_call(
-        _delta_kernel,
+        _make_delta_kernel(mode),
         grid=grid,
         in_specs=in_specs,
         out_specs=[a_blk, a_blk, e_blk, e_blk, e_blk, e_blk, e_blk, e_blk],
         out_shape=_out_shapes(r_pad, a_pad, e_pad),
+        scratch_shapes=scratch_shapes,
         interpret=interpret,
     )(*ins)
     vv, proc, p, da, dc, d, dda, ddc = outs
@@ -206,44 +290,62 @@ def _fused_delta_round(arrays, perm, block_e: int, interpret: bool):
 _PACKED_NAMES = ("present", "deleted")
 
 
-def _make_delta_ring_kernel(interpret: bool, packed_w: int = 0):
+def _make_delta_ring_kernel(interpret: bool, packed_w: int = 0,
+                            mode: str = "v2", aligned: bool = False):
     """packed_w > 0: ``present``/``deleted`` operands/outputs are
     bitpacked uint32[blk_r, packed_w]; unpack after windowing, repack
-    before writing (pallas_merge bit helpers)."""
+    before writing (pallas_merge bit helpers).  aligned: single-src-
+    block form — one partner block per array instead of the lo/hi
+    window pair, halving partner-read HBM traffic; valid only when
+    offset % _BLOCK_R == 0 (callers dispatch via _ring_round_dispatch).
+    mode="reference" threads the strict-quirk scratch (last ref)."""
     from go_crdt_playground_tpu.ops.pallas_merge import (
         _kernel_pack_bits, _kernel_unpack_bits)
 
+    group = 2 if aligned else 3
+    names = _A_NAMED + _E_NAMED
+
     def kernel(meta_ref, sact_ref, *refs):
-        o = meta_ref[1]
-        win = functools.partial(_ring_window, o_mod=o, interpret=interpret)
-        n_a, n_e = len(_A_NAMED), len(_E_NAMED)
-        blk_e = refs[3 * len(_A_NAMED) + 3].shape[-1]  # a dot_actor block
+        scratch_ref = None
+        if mode == "reference":
+            *refs, scratch_ref = refs
+        win = functools.partial(_ring_window, o_mod=meta_ref[1],
+                                interpret=interpret)
+        blk_e = refs[group * 3].shape[-1]   # the dot_actor dst block
         dst, src = {}, {}
-        for k, name in enumerate(_A_NAMED + _E_NAMED):
-            d_ref, lo_ref, hi_ref = refs[3 * k: 3 * k + 3]
-            d, s = d_ref[...], win(lo_ref[...], hi_ref[...])
+        for k, name in enumerate(names):
+            g = refs[group * k: group * k + group]
+            d = g[0][...]
+            s = g[1][...] if aligned else win(g[1][...], g[2][...])
             if packed_w and name in _PACKED_NAMES:
                 d = _kernel_unpack_bits(d, blk_e).astype(jnp.uint8)
                 s = _kernel_unpack_bits(s, blk_e).astype(jnp.uint8)
             dst[name] = d
             src[name] = s
-        out_refs = refs[3 * (n_a + n_e):]
-        outs = _delta_algebra(dst, src, sact_ref[...])
-        for ref, name, val in zip(out_refs, _A_NAMED + _E_NAMED, outs):
+        out_refs = refs[group * len(names):]
+        outs, extras = _delta_algebra(dst, src, sact_ref[...], mode)
+        for ref, name, val in zip(out_refs, names, outs):
             if packed_w and name in _PACKED_NAMES:
                 val = _kernel_pack_bits(val, packed_w)
             ref[...] = val
+        if mode == "reference":
+            _strict_vv_epilogue(out_refs[0], dst["vv"], extras,
+                                scratch_ref)
 
     return kernel
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("block_e", "interpret", "packed_w"))
+                   static_argnames=("block_e", "interpret", "packed_w",
+                                    "mode", "aligned"))
 def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
-                      packed_w: int = 0):
+                      packed_w: int = 0, mode: str = "v2",
+                      aligned: bool = False):
     """packed_w > 0: arrays["present"]/["deleted"] are bitpacked
     uint32[R, packed_w] (models.packed layout); the grid is then
-    single-j (each step repacks its full membership row)."""
+    single-j (each step repacks its full membership row).
+    aligned=True is the single-src-block form, correct ONLY when
+    offset % _BLOCK_R == 0 (callers dispatch via _ring_round_dispatch)."""
     num_r, num_e = arrays["dot_actor"].shape
     num_a = arrays["vv"].shape[1]
     r_pad, e_pad, a_pad, blk = row_block_layout(num_r, num_e, num_a,
@@ -252,6 +354,7 @@ def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
     if packed_w:
         blk = e_pad  # packed words can't be lane-tiled; one j step
     nb = num_r // _BLOCK_R
+    group = 2 if aligned else 3
 
     offset = offset % num_r
     # the sender-actor column is dst-aligned and tiny ([R, 1]): compute
@@ -265,20 +368,20 @@ def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
         return jnp.pad(x, ((0, 0), (0, last - x.shape[1])))
 
     in_specs, out_specs = ring_block_specs(
-        nb, blk, a_pad, a_named=len(_A_NAMED), e_named=len(_E_NAMED))
+        nb, blk, a_pad, a_named=len(_A_NAMED), e_named=len(_E_NAMED),
+        aligned=aligned)
     b_blk = lambda m: pl.BlockSpec((_BLOCK_R, packed_w), m)  # noqa: E731
-    dst_m, lo_m, hi_m = (in_specs[0].index_map, in_specs[1].index_map,
-                         in_specs[2].index_map)
+    src_maps = [in_specs[g].index_map for g in range(group)]
     ins = [s_actor]
     for k, name in enumerate(_A_NAMED + _E_NAMED):
         if packed_w and name in _PACKED_NAMES:
             x = arrays[name]
-            in_specs[3 * k: 3 * k + 3] = [b_blk(dst_m), b_blk(lo_m),
-                                          b_blk(hi_m)]
-            out_specs[k] = b_blk(dst_m)
+            in_specs[group * k: group * k + group] = [
+                b_blk(m) for m in src_maps]
+            out_specs[k] = b_blk(src_maps[0])
         else:
             x = pad(arrays[name], a_pad if name in _A_NAMED else e_pad)
-        ins += [x, x, x]
+        ins += [x] * group
 
     out_shape = _out_shapes(num_r, a_pad, e_pad)
     if packed_w:
@@ -292,9 +395,11 @@ def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
         grid=(nb, e_pad // blk),
         in_specs=[s_blk] + in_specs,
         out_specs=out_specs,
+        scratch_shapes=([pltpu.VMEM((_BLOCK_R, 128), jnp.int32)]
+                        if mode == "reference" else []),
     )
     outs = pl.pallas_call(
-        _make_delta_ring_kernel(interpret, packed_w),
+        _make_delta_ring_kernel(interpret, packed_w, mode, aligned),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
@@ -322,41 +427,66 @@ def _rebuild(state, vv, proc, p, da, dc, d, dda, ddc):
     )
 
 
+def _kernel_mode(delta_semantics: str,
+                 strict_reference_semantics: bool) -> str:
+    if delta_semantics == "v2":
+        return "v2"
+    if delta_semantics == "reference":
+        return ("reference" if strict_reference_semantics
+                else "reference_loose")
+    raise ValueError(f"unknown delta_semantics {delta_semantics!r}")
+
+
 def pallas_delta_gossip_round(state: AWSetDeltaState, perm, *,
+                              delta_semantics: str = "v2",
+                              strict_reference_semantics: bool = True,
                               block_e: int = 512,
                               interpret: bool | None = None
                               ) -> AWSetDeltaState:
-    """One fused δ anti-entropy round, v2 semantics: drop-in bitwise
-    equivalent of ``parallel.gossip.delta_gossip_round(state, perm,
-    delta_semantics="v2")`` (the production TPU path — that function
-    dispatches here on TPU backends)."""
+    """One fused δ anti-entropy round: drop-in bitwise equivalent of
+    ``parallel.gossip.delta_gossip_round(state, perm, ...)`` (the
+    production TPU path — that function dispatches here on TPU
+    backends).  Reference semantics fuse the empty-δ VV-skip quirk as a
+    cross-E reduction accumulated across element blocks (see
+    _strict_vv_epilogue) — reference-mode fleets no longer pay the ~40x
+    XLA HasDot path."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    outs = _fused_delta_round(_state_as_arrays(state), jnp.asarray(perm),
-                              block_e, interpret)
+    outs = _fused_delta_round(
+        _state_as_arrays(state), jnp.asarray(perm), block_e, interpret,
+        _kernel_mode(delta_semantics, strict_reference_semantics))
     return _rebuild(state, *outs)
 
 
 def pallas_delta_ring_round(state: AWSetDeltaState, offset, *,
+                            delta_semantics: str = "v2",
+                            strict_reference_semantics: bool = True,
                             block_e: int = 512,
                             interpret: bool | None = None
                             ) -> AWSetDeltaState:
     """One fused δ ring round against partner (r + offset) mod R with
     partner rows read in place — no materialized ``state[perm]`` copy
     (peak HBM = state + outputs; the 1M-replica north-star enabler).
-    ``offset`` may be traced: one compiled program serves a whole
-    dissemination schedule.  Bitwise-equal to
-    ``pallas_delta_gossip_round(state, ring_perm(R, offset))``."""
+    Block-aligned offsets take the single-src-block form (half the
+    partner-read traffic); ``offset`` may be traced: one compiled
+    program serves a whole dissemination schedule, both variants inside
+    it via lax.cond.  Bitwise-equal to
+    ``pallas_delta_gossip_round(state, ring_perm(R, offset), ...)``."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    mode = _kernel_mode(delta_semantics, strict_reference_semantics)
     if not ring_supported(state.present.shape[0]):
         from go_crdt_playground_tpu.parallel.gossip import ring_perm
 
         return pallas_delta_gossip_round(
             state, ring_perm(state.present.shape[0], offset),
+            delta_semantics=delta_semantics,
+            strict_reference_semantics=strict_reference_semantics,
             block_e=block_e, interpret=interpret)
-    outs = _fused_delta_ring(_state_as_arrays(state), offset, block_e,
-                             interpret)
+    outs = _ring_round_dispatch(
+        _state_as_arrays(state), offset,
+        lambda a, o, al: _fused_delta_ring(a, o, block_e, interpret,
+                                           mode=mode, aligned=al))
     return _rebuild(state, *outs)
 
 
@@ -383,8 +513,10 @@ def pallas_delta_ring_round_packed(state, offset, *,
         "del_dot_counter": state.del_dot_counter, "actor": state.actor,
     }
     w = state.present_bits.shape[1]
-    vv, proc, pb, da, dc, db, dda, ddc = _fused_delta_ring(
-        arrays, offset, 512, interpret, packed_w=w)
+    vv, proc, pb, da, dc, db, dda, ddc = _ring_round_dispatch(
+        arrays, offset,
+        lambda a, o, al: _fused_delta_ring(a, o, 512, interpret,
+                                           packed_w=w, aligned=al))
     return PackedAWSetDeltaState(
         vv=vv, present_bits=pb, dot_actor=da, dot_counter=dc,
         actor=state.actor, deleted_bits=db, del_dot_actor=dda,

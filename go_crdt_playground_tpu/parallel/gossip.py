@@ -80,10 +80,11 @@ def _auto_kernel(state, delta_semantics: Optional[str] = None,
                  single_device: bool = True) -> str:
     """The fused-kernel auto-dispatch rule, in ONE place: Pallas on TPU
     backends (single-device processes unless the caller runs per shard
-    inside shard_map) when the actor axis fits the fused row kernels —
-    and, for δ rounds, only under v2 semantics (the strict-reference
-    quirk needs a cross-E reduction the fused kernel doesn't do).  All
-    choices are bitwise-identical; on TPU the XLA HasDot gather lowers
+    inside shard_map) when the actor axis fits the fused row kernels.
+    Both δ semantics fuse — the strict-reference empty-δ quirk is a
+    scratch-accumulated cross-E reduction inside the kernel
+    (ops/pallas_delta._strict_vv_epilogue).  All choices are
+    bitwise-identical; on TPU the XLA HasDot gather lowers
     pathologically inside compiled loops (~40x slower, see
     ops/pallas_merge.py regime notes)."""
     from go_crdt_playground_tpu.ops.pallas_merge import MAX_FUSED_ACTORS
@@ -91,7 +92,7 @@ def _auto_kernel(state, delta_semantics: Optional[str] = None,
     ok = (jax.default_backend() == "tpu"
           and (not single_device or jax.device_count() == 1)
           and state.vv.shape[-1] <= MAX_FUSED_ACTORS
-          and (delta_semantics is None or delta_semantics == "v2"))
+          and delta_semantics in (None, "v2", "reference"))
     return "pallas" if ok else "xla"
 
 
@@ -189,22 +190,22 @@ def delta_gossip_round(
     """One δ anti-entropy round (payload-compressed exchanges).
 
     kernel: "auto" picks the fused Pallas δ kernel on single-device TPU
-    processes for v2 semantics (bitwise-identical, ~44x faster at fleet
-    scale — the XLA HasDot gathers lower pathologically there,
-    ops/pallas_merge.py regime notes); reference-mode semantics always
-    use the XLA path (the strict empty-δ quirk needs a per-pair cross-E
-    reduction), and mesh programs keep XLA too (same GSPMD caveat as
-    gossip_round — use shard_map + kernel="pallas" per shard instead).
+    processes (bitwise-identical, ~44x faster at fleet scale — the XLA
+    HasDot gathers lower pathologically there, ops/pallas_merge.py
+    regime notes); both δ semantics fuse, incl. the strict empty-δ
+    quirk (scratch-accumulated cross-E reduction in the kernel).  Mesh
+    programs keep XLA (same GSPMD caveat as gossip_round — use
+    shard_map + kernel="pallas" per shard instead).
     """
     if kernel == "auto":
         kernel = _auto_kernel(state, delta_semantics)
     if kernel == "pallas":
-        if delta_semantics != "v2":
-            raise ValueError("the fused delta kernel is v2-only")
         from go_crdt_playground_tpu.ops.pallas_delta import (
             pallas_delta_gossip_round)
 
-        merged = pallas_delta_gossip_round(state, perm)
+        merged = pallas_delta_gossip_round(
+            state, perm, delta_semantics=delta_semantics,
+            strict_reference_semantics=strict_reference_semantics)
     else:
         src = jax.tree.map(lambda x: x[perm], state)
         merged = delta_merge_pairwise(state, src, delta_semantics,
@@ -238,12 +239,12 @@ def delta_ring_gossip_round(
     if kernel == "auto":
         kernel = _auto_kernel(state, delta_semantics)
     if kernel == "pallas":
-        if delta_semantics != "v2":
-            raise ValueError("the fused delta kernel is v2-only")
         from go_crdt_playground_tpu.ops.pallas_delta import (
             pallas_delta_ring_round)
 
-        merged = pallas_delta_ring_round(state, offset)
+        merged = pallas_delta_ring_round(
+            state, offset, delta_semantics=delta_semantics,
+            strict_reference_semantics=strict_reference_semantics)
     else:
         merged = delta_gossip_round(
             state, ring_perm(state.vv.shape[0], offset),

@@ -1,0 +1,90 @@
+"""Partial-persistence layer of the bench supervisor (bench.py).
+
+Round 3 lost its whole TPU evidence session to one late hang; the fix is
+per-step persistence + resume, which these tests pin without needing a
+device: steps persisted by a dying child must be reloadable by a retry
+child on the same platform, and never leak across platforms (a CPU
+fallback's numbers must not seed a TPU artifact).
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+def test_persist_then_load_roundtrip(tmp_path):
+    path = str(tmp_path / "partial.jsonl")
+    rec1 = bench._persist_partial(path, "config1",
+                                  {"value": 1.5, "platform": "tpu"})
+    bench._persist_partial(path, "config2",
+                           {"value": 2.5, "platform": "tpu"})
+    assert rec1["_step"] == "config1"
+    done = bench._load_partial(path, "tpu")
+    assert set(done) == {"config1", "config2"}
+    assert done["config1"]["value"] == 1.5
+
+
+def test_load_partial_filters_platform(tmp_path):
+    path = str(tmp_path / "partial.jsonl")
+    bench._persist_partial(path, "config1",
+                           {"value": 1.0, "platform": "cpu"})
+    bench._persist_partial(path, "config2",
+                           {"value": 2.0, "platform": "tpu"})
+    assert set(bench._load_partial(path, "tpu")) == {"config2"}
+    assert set(bench._load_partial(path, "cpu")) == {"config1"}
+
+
+def test_load_partial_missing_file(tmp_path):
+    assert bench._load_partial(str(tmp_path / "nope.jsonl"), "tpu") == {}
+
+
+def test_persist_appends_latest_wins(tmp_path):
+    # a retried step overwrites on load (later line wins the dict key)
+    path = str(tmp_path / "partial.jsonl")
+    bench._persist_partial(path, "config1",
+                           {"value": 1.0, "platform": "tpu"})
+    bench._persist_partial(path, "config1",
+                           {"value": 9.0, "platform": "tpu"})
+    assert bench._load_partial(path, "tpu")["config1"]["value"] == 9.0
+    with open(path) as f:
+        assert len([ln for ln in f if ln.strip()]) == 2
+
+
+def test_load_partial_tolerates_torn_line(tmp_path):
+    # the supervisor SIGKILLs timed-out children; a mid-write kill can
+    # leave a torn trailing line, which must not wedge later attempts
+    path = str(tmp_path / "partial.jsonl")
+    bench._persist_partial(path, "config1",
+                           {"value": 1.0, "platform": "tpu"})
+    with open(path, "a") as f:
+        f.write('{"value": 2.0, "platform": "tpu", "_st')
+    done = bench._load_partial(path, "tpu")
+    assert set(done) == {"config1"}
+    assert bench._read_partial_records(path)[0]["_step"] == "config1"
+
+
+def test_load_partial_filters_session(tmp_path, monkeypatch):
+    # a stale partial from a killed supervisor (different session id)
+    # must not seed this session's artifact
+    path = str(tmp_path / "partial.jsonl")
+    monkeypatch.setenv("CRDT_BENCH_SESSION", "old-1")
+    bench._persist_partial(path, "config1",
+                           {"value": 1.0, "platform": "tpu"})
+    monkeypatch.setenv("CRDT_BENCH_SESSION", "new-2")
+    bench._persist_partial(path, "config2",
+                           {"value": 2.0, "platform": "tpu"})
+    assert set(bench._load_partial(path, "tpu")) == {"config2"}
+
+
+def test_partial_lines_are_json(tmp_path):
+    path = str(tmp_path / "partial.jsonl")
+    bench._persist_partial(path, "drop0.1",
+                           {"drop_rate": 0.1, "rounds_median": 12,
+                            "platform": "tpu"})
+    with open(path) as f:
+        rec = json.loads(f.read())
+    assert rec["_step"] == "drop0.1"

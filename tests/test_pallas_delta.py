@@ -98,9 +98,98 @@ def test_fused_delta_large_counters_exact():
 def test_delta_dispatch_guard():
     st = awset_delta.init(4, 8, 4)
     with pytest.raises(ValueError):
-        gossip.delta_gossip_round(st, gossip.ring_perm(4, 1),
-                                  delta_semantics="reference",
-                                  kernel="pallas")
+        pallas_delta.pallas_delta_gossip_round(
+            st, gossip.ring_perm(4, 1), delta_semantics="v3")
+
+
+# ---------------------------------------------------------------------------
+# Strict-reference semantics (fused empty-δ VV-skip quirk)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "R,E,A",
+    [
+        (8, 16, 8),       # exact blocks
+        (7, 300, 5),      # ragged everything
+        (12, 640, 16),    # multiple E tiles (quirk reduction spans them)
+    ],
+)
+@pytest.mark.parametrize("strict", [True, False])
+def test_fused_delta_reference_matches_xla(R, E, A, strict):
+    """Reference-mode fused kernel vs the XLA reference path, iterated
+    so first-contact, δ, and steady-state (empty-payload) rounds all
+    occur (awset-delta_test.go:51-65 incl. the :60-64 quirk)."""
+    import random
+    rng = random.Random(131)
+    st_x = _scenario_state(rng, R, E, A)
+    st_p = st_x
+    for offset in (1, 2, 3, 1, 2, 3):   # repeats drive payloads empty
+        perm = gossip.ring_perm(R, offset)
+        st_x = gossip.delta_gossip_round(
+            st_x, perm, delta_semantics="reference",
+            strict_reference_semantics=strict, kernel="xla")
+        st_p = pallas_delta.pallas_delta_gossip_round(
+            st_p, perm, delta_semantics="reference",
+            strict_reference_semantics=strict)
+        _assert_equal(st_x, st_p, f"offset {offset} strict={strict}")
+
+
+def test_fused_delta_reference_empty_payload_skips_vv():
+    """The quirk itself: entries converged, VVs divergent, payloads
+    empty -> strict mode must NOT join the vv (the reference's [5,2] vs
+    [5,3] clock divergence, SURVEY §3.3), loose mode must."""
+    st = awset_delta.init(8, 16, 8)
+    # all replicas know element 0 via dot (0, 1) and have seen EVERY
+    # actor tick once (nonzero partner counters — otherwise the round
+    # takes the first-contact FULL branch, which always joins,
+    # awset-delta_test.go:53-56); clocks diverge in own slots only, so
+    # every pairwise payload is empty (receiver covers dot (0,1))
+    vv = np.ones((8, 8), np.uint32)
+    vv[np.arange(8), np.arange(8)] += np.arange(8).astype(np.uint32)
+    st = st._replace(
+        vv=jnp.asarray(vv),
+        present=st.present.at[:, 0].set(True),
+        dot_actor=st.dot_actor.at[:, 0].set(0),
+        dot_counter=st.dot_counter.at[:, 0].set(1))
+    perm = gossip.ring_perm(8, 1)
+    want = gossip.delta_gossip_round(st, perm,
+                                     delta_semantics="reference",
+                                     kernel="xla")
+    got = pallas_delta.pallas_delta_gossip_round(
+        st, perm, delta_semantics="reference")
+    _assert_equal(want, got, "empty-payload quirk")
+    # strict: vv unchanged (the skip); loose: vv joined
+    np.testing.assert_array_equal(np.asarray(got.vv), vv)
+    loose = pallas_delta.pallas_delta_gossip_round(
+        st, perm, delta_semantics="reference",
+        strict_reference_semantics=False)
+    assert not np.array_equal(np.asarray(loose.vv), vv)
+    want_loose = gossip.delta_gossip_round(
+        st, perm, delta_semantics="reference",
+        strict_reference_semantics=False, kernel="xla")
+    _assert_equal(want_loose, loose, "loose join")
+
+
+@pytest.mark.parametrize("offset", [1, 63, 64, 128])
+def test_delta_ring_reference_matches_xla(offset):
+    """Ring-fused reference-mode kernel (aligned and windowed offsets)
+    vs the XLA reference path."""
+    import random
+
+    from go_crdt_playground_tpu.ops import pallas_merge
+
+    rng = random.Random(137)
+    num_r = 4 * pallas_merge._BLOCK_R
+    st = _scenario_state(rng, num_r, 128, 8)
+    for rep in range(2):   # second pass exercises empty payloads
+        want = gossip.delta_gossip_round(
+            st, gossip.ring_perm(num_r, offset),
+            delta_semantics="reference", kernel="xla")
+        got = pallas_delta.pallas_delta_ring_round(
+            st, offset, delta_semantics="reference")
+        _assert_equal(want, got, f"ring ref offset {offset} rep {rep}")
+        st = want
 
 
 def test_fused_delta_converges_like_xla():
