@@ -787,6 +787,11 @@ def _load_partial(path, platform):
     must never seed a TPU artifact, and a previous session's numbers
     may predate code changes."""
     sid = _session_id()
+    if not sid:
+        # unsupervised child (CRDT_BENCH_CHILD=1 by hand, or run_ladder
+        # called from driver code): no session scope exists, so resuming
+        # would match ANY unscoped stale partial — never resume
+        return {}
     return {rec["_step"]: rec for rec in _read_partial_records(path)
             if rec.get("platform") == platform
             and rec.get("_session", "") == sid}
@@ -932,8 +937,11 @@ def main():
     # the id, and _load_partial ignores records from other sessions (a
     # stale partial left by a killed supervisor must not seed a later
     # artifact — the code may have changed in between)
-    os.environ.setdefault(
-        "CRDT_BENCH_SESSION", f"{os.getpid()}-{int(time.time())}")
+    # plain assignment, not setdefault: children inherit the id through
+    # the subprocess env anyway, and an id leaked into the shell from a
+    # killed run would let _load_partial resume past steps measured by
+    # older code — the exact stale-partial hazard this scoping prevents
+    os.environ["CRDT_BENCH_SESSION"] = f"{os.getpid()}-{int(time.time())}"
     ladder = ("--ladder" in sys.argv or "--droprate" in sys.argv
               or "--northstar" in sys.argv or "--payload" in sys.argv)
     timeout_s = int(os.environ.get(
@@ -981,13 +989,18 @@ def main():
             continue
         recs = _read_partial_records(partial)
         os.remove(partial)
-        platforms = {r.get("platform") for r in recs}
+        # this session's records only, BEFORE choosing the platform: a
+        # stale session's "tpu" rows must not shadow this session's real
+        # (e.g. cpu) measurements into an empty salvage, and records from
+        # older code without a platform key must not crash the min()
+        sid = _session_id()
+        recs = [r for r in recs
+                if r.get("_session", "") == sid and r.get("platform")]
+        platforms = {r["platform"] for r in recs}
         plat = ("tpu" if "tpu" in platforms
                 else min(platforms) if platforms else None)
-        sid = _session_id()
         by_step = {r["_step"]: r for r in recs
-                   if r.get("platform") == plat
-                   and r.get("_session", "") == sid}
+                   if r["platform"] == plat}
         if not by_step:
             continue
         note = ("INCOMPLETE session: later steps failed: "

@@ -1,9 +1,10 @@
 // Command crdtbridge-client drives the go_crdt_playground_tpu Merger
 // bridge from Go, replaying the reference repository's full-state AWSet
 // scenarios (/root/reference/awset_test.go:10-122 — TestAWSetXXX,
-// TestAWSet, TestAWSetConcurrentAddWinsOverDelete) with EVERY
-// dst.Merge(src) executed by the framework's packed TPU merge kernel,
-// reached over the plain-TCP framing of bridge/service.py:
+// TestAWSet, TestAWSetConcurrentAddWinsOverDelete) and the δ-state
+// scenario (/root/reference/awset-delta_test.go:168-189 — TestAWSetDelta)
+// with EVERY dst.Merge(src) executed by the framework's packed TPU merge
+// kernel, reached over the plain-TCP framing of bridge/service.py:
 //
 //	frame = method(1 byte) | length(uint32 big-endian) | proto body
 //	merge = method 0x01, body crdtbridge.MergeRequest
@@ -125,6 +126,37 @@ func (r *replica) String() string {
 }
 
 // ---------------------------------------------------------------------------
+// δ-state replica model (awset-delta_test.go:9-49 semantics).
+// ---------------------------------------------------------------------------
+
+type deltaReplica struct {
+	replica
+	Deleted map[string]dot
+}
+
+func newDeltaReplica(actor uint32, actors int) *deltaReplica {
+	return &deltaReplica{replica: *newReplica(actor, actors)}
+}
+
+// del ticks the clock ONCE per call (even when no key matches) and stamps
+// every removed key with that one shared deletion dot, recording it in the
+// Deleted log (awset-delta_test.go:14-33) — unlike AWSet.Del, which never
+// ticks (awset.go:96-101).
+func (r *deltaReplica) del(keys ...string) {
+	r.VV[r.Actor]++
+	d := dot{r.Actor, r.VV[r.Actor]}
+	for _, k := range keys {
+		if _, ok := r.Entries[k]; ok {
+			if r.Deleted == nil {
+				r.Deleted = map[string]dot{}
+			}
+			r.Deleted[k] = d
+			delete(r.Entries, k)
+		}
+	}
+}
+
+// ---------------------------------------------------------------------------
 // Minimal deterministic proto3 wire encoding (merger.proto messages only).
 // ---------------------------------------------------------------------------
 
@@ -191,6 +223,32 @@ func encodeMergeRequest(dst, src *replica) []byte {
 	return b.Bytes()
 }
 
+func encodeDeltaReplica(r *deltaReplica) []byte {
+	var b bytes.Buffer
+	b.Write(encodeReplica(&r.replica))
+	keys := make([]string, 0, len(r.Deleted)) // deterministic log order
+	for k := range r.Deleted {
+		keys = append(keys, k)
+	}
+	sort.Strings(keys)
+	for _, k := range keys {
+		putLenField(&b, 4, encodeEntry(k, r.Deleted[k]))
+	}
+	return b.Bytes()
+}
+
+func encodeDeltaMergeRequest(dst, src *deltaReplica) []byte {
+	var b bytes.Buffer
+	putLenField(&b, 1, encodeDeltaReplica(dst))
+	putLenField(&b, 2, encodeDeltaReplica(src))
+	putTag(&b, 3, 0) // delta=true: AWSetDelta.Merge dispatch
+	putVarint(&b, 1) // (awset-delta_test.go:51-65)
+	putLenField(&b, 4, []byte("reference"))
+	putTag(&b, 5, 0) // strict_reference_semantics: keep the empty-δ
+	putVarint(&b, 1) // VV-skip quirk (awset-delta_test.go:60-64)
+	return b.Bytes()
+}
+
 // ---------------------------------------------------------------------------
 // Minimal proto3 wire decoding for MergeResponse.
 // ---------------------------------------------------------------------------
@@ -253,9 +311,30 @@ func decodeDot(buf []byte) dot {
 	return d
 }
 
-func decodeReplica(buf []byte) *replica {
+func decodeEntryField(buf []byte) (string, dot) {
+	e := wireReader{buf: buf}
+	var key string
+	var d dot
+	for !e.done() {
+		etag := e.varint()
+		switch etag >> 3 {
+		case 1:
+			key = string(e.lenField())
+		case 2:
+			d = decodeDot(e.lenField())
+		default:
+			e.skip(etag & 7)
+		}
+	}
+	return key, d
+}
+
+// decodeReplica parses a ReplicaState; the second return is the δ Deleted
+// log (field 4), nil for plain-AWSet responses.
+func decodeReplica(buf []byte) (*replica, map[string]dot) {
 	w := wireReader{buf: buf}
 	r := &replica{Entries: map[string]dot{}}
+	var deleted map[string]dot
 	for !w.done() {
 		tag := w.varint()
 		switch tag >> 3 {
@@ -271,33 +350,27 @@ func decodeReplica(buf []byte) *replica {
 				r.VV = append(r.VV, w.varint())
 			}
 		case 3:
-			e := wireReader{buf: w.lenField()}
-			var key string
-			var d dot
-			for !e.done() {
-				etag := e.varint()
-				switch etag >> 3 {
-				case 1:
-					key = string(e.lenField())
-				case 2:
-					d = decodeDot(e.lenField())
-				default:
-					e.skip(etag & 7)
-				}
-			}
+			key, d := decodeEntryField(w.lenField())
 			r.Entries[key] = d
+		case 4:
+			key, d := decodeEntryField(w.lenField())
+			if deleted == nil {
+				deleted = map[string]dot{}
+			}
+			deleted[key] = d
 		default:
 			w.skip(tag & 7)
 		}
 	}
-	return r
+	return r, deleted
 }
 
 type mergeResponse struct {
-	Merged       *replica
-	SortedValues []string
-	Canonical    string
-	Err          string
+	Merged        *replica
+	MergedDeleted map[string]dot
+	SortedValues  []string
+	Canonical     string
+	Err           string
 }
 
 func decodeMergeResponse(buf []byte) mergeResponse {
@@ -307,7 +380,7 @@ func decodeMergeResponse(buf []byte) mergeResponse {
 		tag := w.varint()
 		switch tag >> 3 {
 		case 1:
-			resp.Merged = decodeReplica(w.lenField())
+			resp.Merged, resp.MergedDeleted = decodeReplica(w.lenField())
 		case 2:
 			resp.SortedValues = append(resp.SortedValues,
 				string(w.lenField()))
@@ -377,6 +450,29 @@ func merge(conn net.Conn, dst, src *replica) {
 	// (utils/codec.render_packed) must equal this client's Go rendering
 	if got := dst.String(); got != resp.Canonical {
 		fatalf("canonical mismatch:\nserver: %q\nclient: %q",
+			resp.Canonical, got)
+	}
+}
+
+// deltaMerge performs dst.Merge(src) with the δ dispatch
+// (awset-delta_test.go:51-65) on the server: first contact takes the
+// full-merge branch, later exchanges δ-extract + δ-apply — all computed by
+// the framework's packed kernels, never by this client.
+func deltaMerge(conn net.Conn, dst, src *deltaReplica) {
+	sendFrame(conn, methodMerge, encodeDeltaMergeRequest(dst, src))
+	method, body := recvFrame(conn)
+	if method != methodMerge {
+		fatalf("unexpected reply method %#x", method)
+	}
+	resp := decodeMergeResponse(body)
+	if resp.Err != "" {
+		fatalf("server delta merge error: %s", resp.Err)
+	}
+	dst.VV = resp.Merged.VV
+	dst.Entries = resp.Merged.Entries
+	dst.Deleted = resp.MergedDeleted
+	if got := dst.String(); got != resp.Canonical {
+		fatalf("canonical mismatch (delta):\nserver: %q\nclient: %q",
 			resp.Canonical, got)
 	}
 }
@@ -490,6 +586,28 @@ func testConcurrentAddWins(conn net.Conn) {
 	assertEntries("Conc/A-seq", A, "Anne")
 }
 
+// testAWSetDelta replays awset-delta_test.go:168-189 (T6): the first two
+// merges take the full-merge branch (first contact), the last two take the
+// δ extract/apply branch, with the empty-δ VV-skip quirk live server-side.
+func testAWSetDelta(conn net.Conn) {
+	A, B := newDeltaReplica(0, 2), newDeltaReplica(1, 2)
+	A.add("A", "B")
+	B.add("A", "C")
+	deltaMerge(conn, A, B)
+	deltaMerge(conn, B, A)
+	assertEntries("Delta/A1", &A.replica, "A", "B", "C")
+	assertEntries("Delta/B1", &B.replica, "A", "B", "C")
+
+	A.del("B")
+	A.add("D", "E")
+	B.add("E")
+	deltaMerge(conn, B, A)
+	assertEntries("Delta/B2", &B.replica, "A", "C", "D", "E")
+
+	deltaMerge(conn, A, B)
+	assertEntries("Delta/A2", &A.replica, "A", "C", "D", "E")
+}
+
 func main() {
 	addr := flag.String("addr", "127.0.0.1:7777",
 		"MergerServer host:port (python -m go_crdt_playground_tpu serve)")
@@ -509,10 +627,11 @@ func main() {
 	testAWSetXXX(conn)
 	testAWSet(conn)
 	testConcurrentAddWins(conn)
+	testAWSetDelta(conn)
 
 	if failures > 0 {
 		fmt.Fprintf(os.Stderr, "%d assertion(s) failed\n", failures)
 		os.Exit(1)
 	}
-	fmt.Println("ok: T1-T3 replayed through the framework merge kernel")
+	fmt.Println("ok: T1-T3 + T6 replayed through the framework merge kernels")
 }

@@ -18,7 +18,8 @@ sender, the dispatch condition of awset-delta_test.go:53) else DELTA.
 from __future__ import annotations
 
 import socket
-from typing import Tuple
+import time
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -44,9 +45,20 @@ class RemoteError(RuntimeError):
     """The peer reported a protocol-level failure (MSG_ERROR frame)."""
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int,
+                deadline: Optional[float] = None) -> bytes:
+    """Read exactly n bytes.  With a ``deadline`` (time.monotonic()-based),
+    the WHOLE read must finish by then: the per-recv socket timeout is
+    re-derived from the remaining budget each iteration, so a peer
+    trickling one byte per timeout window cannot hold the read open
+    indefinitely the way a bare settimeout allows."""
     chunks = []
     while n:
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout("frame deadline exceeded")
+            sock.settimeout(remaining)
         b = sock.recv(min(n, 1 << 20))
         if not b:
             raise ProtocolError("connection closed mid-frame")
@@ -55,11 +67,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def _recv_varint(sock: socket.socket) -> int:
+def _recv_varint(sock: socket.socket,
+                 deadline: Optional[float] = None) -> int:
     out = 0
     shift = 0
     while True:
-        b = _recv_exact(sock, 1)[0]
+        b = _recv_exact(sock, 1, deadline)[0]
         out |= (b & 0x7F) << shift
         if not b & 0x80:
             return out
@@ -86,15 +99,31 @@ def send_frame(sock: socket.socket, msg_type: int, body: bytes) -> int:
     return len(data)
 
 
-def recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
-    magic = _recv_exact(sock, 2)
+def recv_frame(sock: socket.socket,
+               timeout: Optional[float] = None) -> Tuple[int, bytes]:
+    """Receive one frame.  ``timeout`` bounds the WHOLE frame (absolute
+    deadline semantics), not each recv, and the socket's own timeout
+    configuration is restored afterwards; on None it applies per recv
+    as usual."""
+    if timeout is None:
+        return _recv_frame(sock, None)
+    saved = sock.gettimeout()
+    try:
+        return _recv_frame(sock, time.monotonic() + timeout)
+    finally:
+        sock.settimeout(saved)
+
+
+def _recv_frame(sock: socket.socket,
+                deadline: Optional[float]) -> Tuple[int, bytes]:
+    magic = _recv_exact(sock, 2, deadline)
     if magic != MAGIC:
         raise ProtocolError(f"bad magic {magic!r}")
-    msg_type = _recv_exact(sock, 1)[0]
-    n = _recv_varint(sock)
+    msg_type = _recv_exact(sock, 1, deadline)[0]
+    n = _recv_varint(sock, deadline)
     if n > _MAX_BODY:
         raise ProtocolError(f"oversized frame ({n} bytes)")
-    body = _recv_exact(sock, n)
+    body = _recv_exact(sock, n, deadline)
     if msg_type == MSG_ERROR:
         raise RemoteError(body.decode("utf-8", "replace"))
     return msg_type, body

@@ -58,7 +58,13 @@ class Node:
     # pin a thread forever.  At capacity new dials are shed, not queued —
     # anti-entropy self-heals a dropped exchange (SURVEY §5.3), so
     # shedding is semantically a lost gossip round, never lost data.
+    # The initial HELLO gets a much shorter deadline than the payload
+    # exchange: a legitimate client sends HELLO immediately on connect,
+    # so an idle half-open dial must release its slot in seconds — at
+    # MAX_CONNS=64, 64 silent dials holding slots for the full payload
+    # timeout would shed every legitimate gossip dial for 30s.
     CONN_TIMEOUT_S = 30.0
+    HELLO_TIMEOUT_S = 2.0
     MAX_CONNS = 64
 
     def __init__(self, actor: int, num_elements: int, num_actors: int,
@@ -88,6 +94,7 @@ class Node:
         self._closing = False
         self.conn_timeout_s = (self.CONN_TIMEOUT_S if conn_timeout_s is None
                                else conn_timeout_s)
+        self.hello_timeout_s = min(self.HELLO_TIMEOUT_S, self.conn_timeout_s)
         self._conn_slots = threading.BoundedSemaphore(
             self.MAX_CONNS if max_conns is None else max_conns)
 
@@ -260,8 +267,13 @@ class Node:
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             with conn:
+                # short ABSOLUTE deadline for the whole HELLO frame: idle
+                # half-open dials — and dialers trickling a byte per
+                # timeout window — must release their slot quickly (a
+                # real client sends HELLO immediately on connect)
+                msg_type, body = framing.recv_frame(
+                    conn, timeout=self.hello_timeout_s)
                 conn.settimeout(self.conn_timeout_s)
-                msg_type, body = framing.recv_frame(conn)
                 if msg_type != MSG_HELLO:
                     framing.send_frame(conn, framing.MSG_ERROR,
                                        f"expected HELLO, got {msg_type}"

@@ -230,12 +230,14 @@ def delta_ring_gossip_round(
     strict_reference_semantics: bool = True,
     kernel: str = "auto",
 ) -> AWSetDeltaState:
-    """One δ ring round: r absorbs (r + offset) mod R.  On TPU (v2
-    semantics) this dispatches the ring-fused δ kernel, which reads
-    partner rows in place — no materialized ``state[perm]`` copy.  That
-    is what lets the 1M-replica north star fit on one 16GB chip: the
-    gather path peaks at ~3x the 6.5GB state and OOMs.  Bitwise-equal
-    to ``delta_gossip_round(state, ring_perm(R, offset), ...)``."""
+    """One δ ring round: r absorbs (r + offset) mod R.  On TPU this
+    dispatches the ring-fused δ kernel (BOTH semantics — reference mode
+    fuses the empty-δ VV-skip as an in-kernel emptiness reduction),
+    which reads partner rows in place — no materialized ``state[perm]``
+    copy.  That is what lets the 1M-replica north star fit on one 16GB
+    chip: the gather path peaks at ~3x the 6.5GB state and OOMs.
+    Bitwise-equal to ``delta_gossip_round(state, ring_perm(R, offset),
+    ...)``."""
     if kernel == "auto":
         kernel = _auto_kernel(state, delta_semantics)
     if kernel == "pallas":

@@ -11,9 +11,28 @@ import json
 import sys
 from pathlib import Path
 
+import pytest
+
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import bench  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _session(monkeypatch):
+    """The supervisor always assigns a session id before children run;
+    tests mirror that default (unscoped = never resume, pinned below)."""
+    monkeypatch.setenv("CRDT_BENCH_SESSION", "test-session")
+
+
+def test_load_partial_without_session_never_resumes(tmp_path, monkeypatch):
+    # unsupervised child (no session id): resuming would match any
+    # unscoped stale partial left by older code — must load nothing
+    path = str(tmp_path / "partial.jsonl")
+    bench._persist_partial(path, "config1",
+                           {"value": 1.0, "platform": "tpu"})
+    monkeypatch.delenv("CRDT_BENCH_SESSION")
+    assert bench._load_partial(path, "tpu") == {}
 
 
 def test_persist_then_load_roundtrip(tmp_path):

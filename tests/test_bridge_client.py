@@ -5,8 +5,9 @@ SURVEY preamble), so this test IS its execution: a Python mirror of the
 client's deterministic proto3 wire encoder produces the byte-identical
 MergeRequest frames the Go program would send (pinned against protobuf's
 own serializer), replays the same T1-T3 scenarios
-(/root/reference/awset_test.go:10-122) over a real TCP connection to
-MergerServer, and checks the same membership + canonical-rendering
+(/root/reference/awset_test.go:10-122) and the δ scenario T6
+(/root/reference/awset-delta_test.go:168-189) over a real TCP connection
+to MergerServer, and checks the same membership + canonical-rendering
 assertions the Go client makes.
 """
 
@@ -17,7 +18,8 @@ import pytest
 
 from go_crdt_playground_tpu.bridge import service as bridge
 from go_crdt_playground_tpu.bridge import merger_pb2 as pb
-from go_crdt_playground_tpu.models.spec import AWSet, Dot, VersionVector
+from go_crdt_playground_tpu.models.spec import (AWSet, AWSetDelta, Dot,
+                                                VersionVector)
 
 # ---------------------------------------------------------------------------
 # Mirror of main.go's encoder: fields in tag order, entries sorted by key,
@@ -72,6 +74,24 @@ def _enc_merge_request(dst: AWSet, src: AWSet) -> bytes:
     return _len_field(1, _enc_replica(dst)) + _len_field(2, _enc_replica(src))
 
 
+def _enc_delta_replica(rep: AWSetDelta) -> bytes:
+    out = _enc_replica(rep)
+    for k in sorted(rep.deleted):  # Deleted log, field 4, sorted (main.go)
+        out += _len_field(4, _enc_entry(k, rep.deleted[k]))
+    return out
+
+
+def _enc_delta_merge_request(dst: AWSetDelta, src: AWSetDelta) -> bytes:
+    """main.go's encodeDeltaMergeRequest: delta=true, reference semantics,
+    strict quirk on — the AWSetDelta.Merge dispatch
+    (awset-delta_test.go:51-65)."""
+    return (_len_field(1, _enc_delta_replica(dst))
+            + _len_field(2, _enc_delta_replica(src))
+            + _tag(3, 0) + _varint(1)
+            + _len_field(4, b"reference")
+            + _tag(5, 0) + _varint(1))
+
+
 def test_wire_encoder_matches_protobuf_serializer():
     """The hand encoder (== main.go's) must produce byte-identical output
     to protobuf's canonical serializer, so the Go client's frames parse
@@ -93,6 +113,35 @@ def test_wire_encoder_matches_protobuf_serializer():
 
     ref = pb.MergeRequest(dst=to_pb(a), src=to_pb(b)).SerializeToString()
     assert _enc_merge_request(a, b) == ref
+
+
+def test_delta_wire_encoder_matches_protobuf_serializer():
+    """The δ-request encoder (== main.go's encodeDeltaMergeRequest) must be
+    byte-identical to protobuf's serializer, Deleted log included."""
+    a = AWSetDelta(actor=0, version_vector=VersionVector([0, 0]))
+    b = AWSetDelta(actor=1, version_vector=VersionVector([0, 0]))
+    a.add("A", "B")
+    b.add("A", "C")
+    a.del_("B")
+
+    def to_pb(rep):
+        msg = pb.ReplicaState(actor=rep.actor,
+                              version_vector=list(rep.version_vector))
+        for k in sorted(rep.entries):
+            d = rep.entries[k]
+            msg.entries.add(key=k,
+                            dot=pb.Dot(actor=d.actor, counter=d.counter))
+        for k in sorted(rep.deleted):
+            d = rep.deleted[k]
+            msg.deleted.add(key=k,
+                            dot=pb.Dot(actor=d.actor, counter=d.counter))
+        return msg
+
+    ref = pb.MergeRequest(
+        dst=to_pb(a), src=to_pb(b), delta=True,
+        delta_semantics="reference",
+        strict_reference_semantics=True).SerializeToString()
+    assert _enc_delta_merge_request(a, b) == ref
 
 
 # ---------------------------------------------------------------------------
@@ -141,6 +190,26 @@ class GoClientMirror:
             [int(n) for n in resp.merged.version_vector])
         dst.entries = {e.key: Dot(e.dot.actor, int(e.dot.counter))
                        for e in resp.merged.entries}
+        assert str(dst) == resp.canonical, (str(dst), resp.canonical)
+        assert resp.sorted_values == dst.sorted_values()
+
+    def delta_merge(self, dst: AWSetDelta, src: AWSetDelta) -> None:
+        """dst.Merge(src) via the server's δ dispatch, exactly as
+        main.go's deltaMerge() does (state install + canonical parity)."""
+        body = _enc_delta_merge_request(dst, src)
+        self.sock.sendall(struct.pack(">BI", bridge.METHOD_MERGE,
+                                      len(body)) + body)
+        method, length = struct.unpack(">BI", self._recv(5))
+        assert method == bridge.METHOD_MERGE
+        resp = pb.MergeResponse()
+        resp.ParseFromString(self._recv(length))
+        assert not resp.error, resp.error
+        dst.version_vector = VersionVector(
+            [int(n) for n in resp.merged.version_vector])
+        dst.entries = {e.key: Dot(e.dot.actor, int(e.dot.counter))
+                       for e in resp.merged.entries}
+        dst.deleted = {e.key: Dot(e.dot.actor, int(e.dot.counter))
+                       for e in resp.merged.deleted}
         assert str(dst) == resp.canonical, (str(dst), resp.canonical)
         assert resp.sorted_values == dst.sorted_values()
 
@@ -229,3 +298,32 @@ def test_t3_concurrent_add_wins_replay(client):
     client.merge(A, B)
     _assert_entries(B, "Anne")
     _assert_entries(A, "Anne")
+
+
+def test_t6_awset_delta_replay(client):
+    """awset-delta_test.go:168-189 (T6) through the framework δ kernels:
+    first contacts take the full-merge branch, later exchanges the
+    δ extract/apply branch — all server-side."""
+    A = AWSetDelta(actor=0, version_vector=VersionVector([0, 0]))
+    B = AWSetDelta(actor=1, version_vector=VersionVector([0, 0]))
+    A.add("A", "B")
+    B.add("A", "C")
+    client.delta_merge(A, B)
+    client.delta_merge(B, A)
+    _assert_entries(A, "A", "B", "C")
+    _assert_entries(B, "A", "B", "C")
+
+    A.del_("B")
+    A.add("D", "E")
+    B.add("E")
+    client.delta_merge(B, A)
+    _assert_entries(B, "A", "C", "D", "E")
+
+    client.delta_merge(A, B)
+    _assert_entries(A, "A", "C", "D", "E")
+
+    # the strict-reference empty-δ quirk, live over the wire: the final
+    # exchange ships no payload so A's VV is NOT joined
+    # (awset-delta_test.go:60-64) — clocks stay divergent (SURVEY §3.3)
+    assert list(A.version_vector) == [5, 2]
+    assert list(B.version_vector) == [5, 3]

@@ -330,3 +330,82 @@ def test_server_sheds_connections_at_capacity():
                     raise
                 time.sleep(0.05)
         assert 3 in server.members()
+
+
+def test_half_open_dial_releases_slot_at_hello_deadline():
+    """An idle half-open dial must free its connection slot after the
+    (short) HELLO deadline, not the full payload timeout — otherwise
+    max_conns silent dials shed every legitimate gossip exchange for
+    conn_timeout_s (ADVICE r3)."""
+    import socket as socket_mod
+
+    server = Node(0, E, A, max_conns=1, conn_timeout_s=30.0)
+    server.hello_timeout_s = 0.5
+    with server:
+        addr = server.serve()
+        hog = socket_mod.create_connection(addr, timeout=5.0)
+        try:
+            time.sleep(0.8)  # past the HELLO deadline, far below 30s
+            peer = Node(1, E, A)
+            peer.add(5)
+            # must succeed promptly: the hog's slot was reclaimed at the
+            # HELLO deadline even though conn_timeout_s is 30s
+            deadline = time.monotonic() + 10.0
+            while True:
+                try:
+                    peer.sync_with(addr)
+                    break
+                except (OSError, framing.ProtocolError):
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.05)
+            assert 5 in server.members()
+        finally:
+            hog.close()
+
+
+def test_trickling_dial_releases_slot_at_hello_deadline():
+    """The HELLO deadline is absolute for the whole frame: a dialer
+    feeding one byte per timeout window must not hold a slot past it
+    (per-recv socket timeouts alone would reset on every byte)."""
+    import socket as socket_mod
+
+    import threading
+
+    server = Node(0, E, A, max_conns=1, conn_timeout_s=30.0)
+    server.hello_timeout_s = 0.5
+    with server:
+        addr = server.serve()
+        hog = socket_mod.create_connection(addr, timeout=5.0)
+        stop = threading.Event()
+
+        def trickle():
+            # valid frame prefix, one byte at a time, forever
+            for b in framing.MAGIC * 1000:
+                if stop.is_set():
+                    return
+                try:
+                    hog.sendall(bytes([b]))
+                except OSError:
+                    return
+                time.sleep(0.3)
+
+        t = threading.Thread(target=trickle, daemon=True)
+        t.start()
+        try:
+            time.sleep(1.0)  # several trickled bytes, past the deadline
+            peer = Node(1, E, A)
+            peer.add(7)
+            deadline = time.monotonic() + 10.0
+            while True:
+                try:
+                    peer.sync_with(addr)
+                    break
+                except (OSError, framing.ProtocolError):
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.05)
+            assert 7 in server.members()
+        finally:
+            stop.set()
+            hog.close()
