@@ -432,26 +432,37 @@ func readFull(conn net.Conn, buf []byte) (int, error) {
 	return total, nil
 }
 
-// merge performs dst.Merge(src) on the server: the framework's packed
-// kernel computes the result, which replaces dst's state client-side.
-func merge(conn net.Conn, dst, src *replica) {
-	sendFrame(conn, methodMerge, encodeMergeRequest(dst, src))
-	method, body := recvFrame(conn)
+// exchange ships one Merge request body and returns the decoded
+// response, with the framing/method/error checks every merge shares.
+func exchange(conn net.Conn, body []byte) mergeResponse {
+	sendFrame(conn, methodMerge, body)
+	method, reply := recvFrame(conn)
 	if method != methodMerge {
 		fatalf("unexpected reply method %#x", method)
 	}
-	resp := decodeMergeResponse(body)
+	resp := decodeMergeResponse(reply)
 	if resp.Err != "" {
 		fatalf("server merge error: %s", resp.Err)
 	}
+	return resp
+}
+
+// install replaces dst's state with the server's merged result and checks
+// cross-language rendering parity: the server's canonical String
+// (utils/codec.render_packed) must equal this client's Go rendering.
+func install(dst *replica, resp mergeResponse) {
 	dst.VV = resp.Merged.VV
 	dst.Entries = resp.Merged.Entries
-	// cross-language rendering parity: the server's canonical String
-	// (utils/codec.render_packed) must equal this client's Go rendering
 	if got := dst.String(); got != resp.Canonical {
 		fatalf("canonical mismatch:\nserver: %q\nclient: %q",
 			resp.Canonical, got)
 	}
+}
+
+// merge performs dst.Merge(src) on the server: the framework's packed
+// kernel computes the result, which replaces dst's state client-side.
+func merge(conn net.Conn, dst, src *replica) {
+	install(dst, exchange(conn, encodeMergeRequest(dst, src)))
 }
 
 // deltaMerge performs dst.Merge(src) with the δ dispatch
@@ -459,22 +470,9 @@ func merge(conn net.Conn, dst, src *replica) {
 // full-merge branch, later exchanges δ-extract + δ-apply — all computed by
 // the framework's packed kernels, never by this client.
 func deltaMerge(conn net.Conn, dst, src *deltaReplica) {
-	sendFrame(conn, methodMerge, encodeDeltaMergeRequest(dst, src))
-	method, body := recvFrame(conn)
-	if method != methodMerge {
-		fatalf("unexpected reply method %#x", method)
-	}
-	resp := decodeMergeResponse(body)
-	if resp.Err != "" {
-		fatalf("server delta merge error: %s", resp.Err)
-	}
-	dst.VV = resp.Merged.VV
-	dst.Entries = resp.Merged.Entries
+	resp := exchange(conn, encodeDeltaMergeRequest(dst, src))
+	install(&dst.replica, resp)
 	dst.Deleted = resp.MergedDeleted
-	if got := dst.String(); got != resp.Canonical {
-		fatalf("canonical mismatch (delta):\nserver: %q\nclient: %q",
-			resp.Canonical, got)
-	}
 }
 
 // ---------------------------------------------------------------------------

@@ -267,13 +267,17 @@ class Node:
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             with conn:
+                # base per-op timeout covers the SENDS (a client that
+                # stops reading fills the TCP window and blocks sendall);
+                # each recv_frame below overrides it with a whole-frame
+                # deadline and restores it afterwards
+                conn.settimeout(self.conn_timeout_s)
                 # short ABSOLUTE deadline for the whole HELLO frame: idle
                 # half-open dials — and dialers trickling a byte per
                 # timeout window — must release their slot quickly (a
                 # real client sends HELLO immediately on connect)
                 msg_type, body = framing.recv_frame(
                     conn, timeout=self.hello_timeout_s)
-                conn.settimeout(self.conn_timeout_s)
                 if msg_type != MSG_HELLO:
                     framing.send_frame(conn, framing.MSG_ERROR,
                                        f"expected HELLO, got {msg_type}"
@@ -290,7 +294,12 @@ class Node:
                 sent = framing.send_frame(
                     conn, MSG_HELLO, framing.encode_hello(
                         self.actor, self.num_elements, self.vv()))
-                msg_type, body = framing.recv_frame(conn)
+                # the payload read gets the SAME whole-frame deadline
+                # treatment (longer budget): per-recv timeouts reset on
+                # every byte, so a post-HELLO trickler would otherwise
+                # hold the slot indefinitely
+                msg_type, body = framing.recv_frame(
+                    conn, timeout=self.conn_timeout_s)
                 if msg_type != MSG_PAYLOAD:
                     framing.send_frame(conn, framing.MSG_ERROR,
                                        f"expected PAYLOAD, got {msg_type}"

@@ -44,14 +44,16 @@ def membership_hash(present: jnp.ndarray) -> jnp.ndarray:
                    dtype=jnp.uint32)
 
 
+def _vv_hash(vv: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(_mix32(vv) * _mix32(jnp.arange(
+        1, vv.shape[-1] + 1, dtype=jnp.uint32)), axis=-1, dtype=jnp.uint32)
+
+
 def state_digest(present: jnp.ndarray, vv: jnp.ndarray) -> jnp.ndarray:
     """(membership, VV) digest per replica — the convergence criterion of
     the reference semantics (per-entry dots may legitimately diverge,
     SURVEY §3.2, so they are NOT part of the digest)."""
-    mh = membership_hash(present)
-    vh = jnp.sum(_mix32(vv) * _mix32(jnp.arange(
-        1, vv.shape[-1] + 1, dtype=jnp.uint32)), axis=-1, dtype=jnp.uint32)
-    return mh ^ vh
+    return membership_hash(present) ^ _vv_hash(vv)
 
 
 def all_equal(digest: jnp.ndarray) -> jnp.ndarray:
@@ -62,6 +64,18 @@ def all_equal(digest: jnp.ndarray) -> jnp.ndarray:
 def converged(present: jnp.ndarray, vv: jnp.ndarray) -> jnp.ndarray:
     """Scalar bool: has the whole batch converged on (membership, VV)?"""
     return all_equal(state_digest(present, vv))
+
+
+def converged_packed(present_bits: jnp.ndarray,
+                     vv: jnp.ndarray) -> jnp.ndarray:
+    """``converged`` on the bitpacked membership layout
+    (models/packed.py): equal uint32 words <=> equal membership (padding
+    tail bits are zero by construction), so the digest hashes word lanes
+    directly — no unpack.  present_bits: uint32[R, E/32]."""
+    w = present_bits.shape[-1]
+    lane = _mix32(jnp.arange(1, w + 1, dtype=jnp.uint32))
+    mh = jnp.sum(_mix32(present_bits) * lane, axis=-1, dtype=jnp.uint32)
+    return all_equal(mh ^ _vv_hash(vv))
 
 
 def global_vv_join(vv: jnp.ndarray) -> jnp.ndarray:

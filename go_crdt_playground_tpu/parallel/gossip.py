@@ -692,3 +692,98 @@ def ring_round_shardmap(state: AWSetState, mesh: Mesh,
     if kernel == "auto":
         kernel = _auto_kernel(state, single_device=False)
     return _ring_step_compiled(mesh, type(state), kernel)(state)
+
+
+# ---------------------------------------------------------------------------
+# Bitpacked δ gossip with an explicitly sharded replica axis
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _packed_block_ring_compiled(mesh: Mesh, shift: int, kernel_offset: int):
+    from jax.sharding import PartitionSpec as P
+
+    from go_crdt_playground_tpu.models.packed import PackedAWSetDeltaState
+    from go_crdt_playground_tpu.ops.pallas_delta import (
+        pallas_delta_ring_round_packed)
+
+    n = mesh.shape[REPLICA_AXIS]
+    # device d receives the block of device (d + shift) mod n
+    pairs = [((i + shift) % n, i) for i in range(n)]
+    row = P(REPLICA_AXIS, None)
+    specs = PackedAWSetDeltaState(
+        vv=row, present_bits=row, dot_actor=row, dot_counter=row,
+        actor=P(REPLICA_AXIS), deleted_bits=row, del_dot_actor=row,
+        del_dot_counter=row, processed=row)
+
+    def step(local):
+        if shift:
+            recv = jax.tree.map(
+                lambda x: jax.lax.ppermute(x, REPLICA_AXIS, pairs), local)
+        else:
+            recv = local
+        stacked = jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b], axis=0), local, recv)
+        out = pallas_delta_ring_round_packed(stacked, kernel_offset)
+        return jax.tree.map(lambda x: x[: x.shape[0] // 2], out)
+
+    # check_vma off for the same reason as _ring_step_compiled's pallas
+    # path: pallas_call's out_shape carries no varying-manual-axes
+    # annotation (the bitwise pin vs the global-jit packed round in
+    # tests/test_gossip.py is the stronger guarantee).
+    return jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs,
+                      check_vma=False)
+    )
+
+
+def packed_block_ring_round_shardmap(state, mesh: Mesh, offset):
+    """One BITPACKED δ gossip round (models/packed.py layout) with the
+    replica axis explicitly sharded: membership crosses ICI as
+    uint32[blk, E/32] words — 8x less wire traffic for the two
+    membership sections than the bool layouts.
+
+    Pairing, with ``blk = R / n_devices`` rows per device:
+
+    * ``offset % blk == 0`` — block-aligned ring: row r absorbs
+      r + offset globally, i.e. device d's rows absorb device
+      (d + offset/blk)'s rows pairwise.  Bitwise-identical to
+      ``pallas_delta_ring_round_packed(state, offset)`` on one device.
+    * ``offset < blk`` — intra-device ring: row i absorbs row
+      (i + offset) mod blk WITHIN its device block, no communication.
+      This wraps per block rather than globally, so it is a different
+      (equally convergent, v2-semantics) anti-entropy pairing than the
+      global ring at that offset — dissemination schedules compose
+      intra rounds (offsets < blk) with block-aligned rounds (offset
+      multiples of blk) to reach all-pairs in ceil(log2 R) rounds.
+
+    Both forms run the packed ring kernel on the stacked [local; recv]
+    (or [local; local]) 2*blk block at an in-kernel offset that lands
+    every kept row on its partner; rows >= blk are partner-absorbing
+    scratch and are discarded (2x compute for zero gather/copy of the
+    partner block — the shard-side analogue of the in-place ring reads).
+    Requires the element mesh dim unsharded and blk a multiple of 64
+    (ring_supported on the stacked block).
+    """
+    if mesh.shape[ELEMENT_AXIS] != 1:
+        raise ValueError(
+            "packed block ring needs the element axis unsharded (mesh "
+            f"element dim {mesh.shape[ELEMENT_AXIS]}): packed words are "
+            "not element-shardable")
+    n = mesh.shape[REPLICA_AXIS]
+    R = state.vv.shape[0]
+    if R % n:
+        raise ValueError(f"R={R} not divisible by replica mesh dim {n}")
+    blk = R // n
+    offset = int(offset) % R
+    if offset == 0:
+        raise ValueError("offset 0 is a no-op round")
+    if offset % blk == 0:
+        shift, kernel_offset = offset // blk, blk
+    elif offset < blk:
+        shift, kernel_offset = 0, blk + offset
+    else:
+        raise ValueError(
+            f"offset {offset} is neither intra-block (< {blk}) nor "
+            f"block-aligned (multiple of {blk})")
+    return _packed_block_ring_compiled(mesh, shift, kernel_offset)(state)

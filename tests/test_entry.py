@@ -42,16 +42,18 @@ def test_entry_forward_step_compiles_and_runs():
 
 
 def test_dryrun_multichip_8_devices():
-    """dryrun_multichip(8) must finish (it owns its subprocess + timeout);
-    called from a process where the ambient env still points at the TPU
-    tunnel — the exact condition that hung round 1."""
+    """dryrun_multichip(8) must finish (it owns its subprocess + timeout)
+    with EVERY sharded path converged; called from a process where the
+    ambient env still points at the TPU tunnel — the exact condition
+    that hung round 1."""
     proc = subprocess.run(
         [sys.executable, "-c",
          "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"],
         env=dict(os.environ), cwd=REPO, timeout=660,
         capture_output=True, text=True)
     assert proc.returncode == 0, proc.stderr[-2000:]
-    assert "dryrun_multichip ok" in proc.stdout
+    assert "dryrun_multichip ok: 4/4 sharded paths converged" in proc.stdout
+    assert "converged=False" not in proc.stdout
 
 
 def test_dryrun_multichip_odd_device_count():
@@ -62,7 +64,8 @@ def test_dryrun_multichip_odd_device_count():
         env=dict(os.environ), cwd=REPO, timeout=660,
         capture_output=True, text=True)
     assert proc.returncode == 0, proc.stderr[-2000:]
-    assert "mesh=(3, 1)" in proc.stdout
+    assert "delta-default(3, 1)" in proc.stdout
+    assert "4/4 sharded paths converged" in proc.stdout
 
 
 def test_entry_shape_triggers_fused_dispatch():
