@@ -735,7 +735,12 @@ def run_northstar():
         print("CRDT_BENCH_FATAL: fleet did not converge", file=sys.stderr)
         sys.exit(1)
     print(json.dumps(result))
-    with open("NORTHSTAR.json", "w") as f:
+    # the bitpacked variant records NEXT TO the bool artifact, so the
+    # packed-vs-bool round-time delta survives as a committed pair
+    artifact = ("NORTHSTAR_PACKED.json"
+                if os.environ.get("CRDT_NORTHSTAR_PACKED") == "1"
+                else "NORTHSTAR.json")
+    with open(artifact, "w") as f:
         json.dump(result, f, indent=2)
     return result
 

@@ -434,15 +434,17 @@ func readFull(conn net.Conn, buf []byte) (int, error) {
 
 // exchange ships one Merge request body and returns the decoded
 // response, with the framing/method/error checks every merge shares.
-func exchange(conn net.Conn, body []byte) mergeResponse {
+// path labels failures so interleaved merge/deltaMerge scenarios stay
+// localizable.
+func exchange(conn net.Conn, path string, body []byte) mergeResponse {
 	sendFrame(conn, methodMerge, body)
 	method, reply := recvFrame(conn)
 	if method != methodMerge {
-		fatalf("unexpected reply method %#x", method)
+		fatalf("unexpected reply method %#x (%s)", method, path)
 	}
 	resp := decodeMergeResponse(reply)
 	if resp.Err != "" {
-		fatalf("server merge error: %s", resp.Err)
+		fatalf("server %s error: %s", path, resp.Err)
 	}
 	return resp
 }
@@ -450,19 +452,20 @@ func exchange(conn net.Conn, body []byte) mergeResponse {
 // install replaces dst's state with the server's merged result and checks
 // cross-language rendering parity: the server's canonical String
 // (utils/codec.render_packed) must equal this client's Go rendering.
-func install(dst *replica, resp mergeResponse) {
+func install(dst *replica, path string, resp mergeResponse) {
 	dst.VV = resp.Merged.VV
 	dst.Entries = resp.Merged.Entries
 	if got := dst.String(); got != resp.Canonical {
-		fatalf("canonical mismatch:\nserver: %q\nclient: %q",
-			resp.Canonical, got)
+		fatalf("canonical mismatch (%s):\nserver: %q\nclient: %q",
+			path, resp.Canonical, got)
 	}
 }
 
 // merge performs dst.Merge(src) on the server: the framework's packed
 // kernel computes the result, which replaces dst's state client-side.
 func merge(conn net.Conn, dst, src *replica) {
-	install(dst, exchange(conn, encodeMergeRequest(dst, src)))
+	install(dst, "merge", exchange(conn, "merge",
+		encodeMergeRequest(dst, src)))
 }
 
 // deltaMerge performs dst.Merge(src) with the δ dispatch
@@ -470,8 +473,8 @@ func merge(conn net.Conn, dst, src *replica) {
 // full-merge branch, later exchanges δ-extract + δ-apply — all computed by
 // the framework's packed kernels, never by this client.
 func deltaMerge(conn net.Conn, dst, src *deltaReplica) {
-	resp := exchange(conn, encodeDeltaMergeRequest(dst, src))
-	install(&dst.replica, resp)
+	resp := exchange(conn, "deltaMerge", encodeDeltaMergeRequest(dst, src))
+	install(&dst.replica, "deltaMerge", resp)
 	dst.Deleted = resp.MergedDeleted
 }
 

@@ -342,17 +342,22 @@ def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
                       packed_w: int = 0, mode: str = "v2",
                       aligned: bool = False):
     """packed_w > 0: arrays["present"]/["deleted"] are bitpacked
-    uint32[R, packed_w] (models.packed layout); the grid is then
-    single-j (each step repacks its full membership row).
+    uint32[R, packed_w] (models.packed layout); the element grid tiles
+    in 4096-element chunks (= one lane group of words each,
+    pallas_merge._packed_tiling), so each j step unpacks/repacks one
+    word group — E is bounded by HBM, not by the gather lane width.
     aligned=True is the single-src-block form, correct ONLY when
     offset % _BLOCK_R == 0 (callers dispatch via _ring_round_dispatch)."""
+    from go_crdt_playground_tpu.ops.pallas_merge import _packed_tiling
+
     num_r, num_e = arrays["dot_actor"].shape
     num_a = arrays["vv"].shape[1]
     r_pad, e_pad, a_pad, blk = row_block_layout(num_r, num_e, num_a,
                                                 block_e)
     assert r_pad == num_r, "callers must check ring_supported()"
+    w_blk = total_w = packed_w
     if packed_w:
-        blk = e_pad  # packed words can't be lane-tiled; one j step
+        blk, e_pad, w_blk, total_w = _packed_tiling(e_pad, packed_w)
     nb = num_r // _BLOCK_R
     group = 2 if aligned else 3
 
@@ -370,12 +375,17 @@ def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
     in_specs, out_specs = ring_block_specs(
         nb, blk, a_pad, a_named=len(_A_NAMED), e_named=len(_E_NAMED),
         aligned=aligned)
-    b_blk = lambda m: pl.BlockSpec((_BLOCK_R, packed_w), m)  # noqa: E731
-    src_maps = [in_specs[g].index_map for g in range(group)]
+    b_blk = lambda m: pl.BlockSpec((_BLOCK_R, w_blk), m)  # noqa: E731
+    # bits blocks advance with the element grid step: word block j of a
+    # row serves element block j, so the index maps must be the E-style
+    # (i, j) ones, NOT the A-style (i, 0) ones (word tiling made the
+    # packed grid multi-j)
+    e0 = group * len(_A_NAMED)
+    src_maps = [in_specs[e0 + g].index_map for g in range(group)]
     ins = [s_actor]
     for k, name in enumerate(_A_NAMED + _E_NAMED):
         if packed_w and name in _PACKED_NAMES:
-            x = arrays[name]
+            x = pad(arrays[name], total_w)
             in_specs[group * k: group * k + group] = [
                 b_blk(m) for m in src_maps]
             out_specs[k] = b_blk(src_maps[0])
@@ -387,7 +397,7 @@ def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
     if packed_w:
         for k, name in enumerate(_A_NAMED + _E_NAMED):
             if name in _PACKED_NAMES:
-                out_shape[k] = jax.ShapeDtypeStruct((num_r, packed_w),
+                out_shape[k] = jax.ShapeDtypeStruct((num_r, total_w),
                                                     jnp.uint32)
     s_blk = pl.BlockSpec((_BLOCK_R, 1), lambda i, j, meta: (i, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -399,13 +409,14 @@ def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
                         if mode == "reference" else []),
     )
     outs = pl.pallas_call(
-        _make_delta_ring_kernel(interpret, packed_w, mode, aligned),
+        _make_delta_ring_kernel(interpret, w_blk, mode, aligned),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
     )(meta, *ins)
     vv, proc, p, da, dc, d, dda, ddc = outs
-    trim_p = (lambda x: x) if packed_w else (lambda x: x[:, :num_e])
+    trim_p = ((lambda x: x[:, :packed_w]) if packed_w
+              else (lambda x: x[:, :num_e]))
     return (vv[:, :num_a], proc[:, :num_a], trim_p(p), da[:, :num_e],
             dc[:, :num_e], trim_p(d), dda[:, :num_e], ddc[:, :num_e])
 

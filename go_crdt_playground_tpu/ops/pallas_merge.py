@@ -43,12 +43,21 @@ and the number measures only the VV join):
     O(A x E) one-hot selector materialization dominated.
   * 64-row blocks + native lane-gather HasDot, XLA partner gather
     (pallas_gossip_round_rows): ~0.37ms/round (26.7M merges/s).
-  * ring-fused (pallas_ring_round_rows, partner rows in place):
-    ~0.22ms/round (45.4M merges/s) — the production path.
-HBM streaming bound for the ring round at this config: read state
-(32.5MB) + read partner windows (32.5MB) + write outputs (32.5MB)
-= 97.5MB at the measured ~590GB/s device bandwidth -> ~0.165ms/round,
-so the ring kernel runs within ~1.3x of its bound (was ~9x).
+  * ring-fused, windowed partner reads (lo+hi block pair):
+    ~0.22ms/round (45.4M merges/s).
+  * ring-fused + aligned single-src-block dispatch (offset % 64 == 0
+    rounds read ONE partner block; most of a dissemination schedule):
+    0.123ms/round (82.0M merges/s, BENCH_LADDER r4) — the production
+    path.
+HBM roofline at this config (state = R x 3.3KB = 33.4MB/array-set):
+an aligned round moves read dst 33.4 + read partner 33.4 + write 33.4
+= 100.3MB, which at the v5e spec bandwidth (819GB/s) is 0.1225ms —
+the measured 0.1226ms/round (dissemination-mix average, 8/14 rounds
+aligned) sits AT that bound; windowed rounds move 133.8MB so the true
+mixed bound is ~0.137ms, i.e. the measurement is ~0.9x of the traffic
+model.  Residual uncertainty is now in the model (achieved-vs-spec
+bandwidth, possible cross-step block reuse), not in kernel overhead:
+the round-3 1.33x residue is closed.
 The one-row variant remains for huge-E/modest-R streaming (row state
 >> VMEM) and as the scalar-prefetch reference; tests pin bitwise
 equality across all paths, so schedulers may pick per shape freely.
@@ -478,12 +487,19 @@ def unpack_bits(bits, num_e: int) -> jnp.ndarray:
 def _kernel_unpack_bits(bits, blk_e: int):
     """In-kernel unpack: uint32[blk_r, W<=128] -> bool[blk_r, blk_e].
     Word lookup is the same native lane gather HasDot uses; the bit
-    extract is a per-lane variable shift."""
+    extract is a per-lane variable shift.
+
+    One lane group of words (W <= 128, i.e. <= 4096 elements) per call
+    is an INVARIANT, not a feature cap: beyond one chunk the packed
+    kernels tile the element axis into 4096-element j blocks
+    (_packed_tiling), so each grid step hands this helper exactly one
+    word group."""
     blk_r, w = bits.shape
     if w > _LANE:  # the word gather is one lane group wide
         raise ValueError(
-            f"packed membership caps E at {32 * _LANE} (one gather lane "
-            f"group of words); got packed width {w}")
+            f"_kernel_unpack_bits is per-chunk (<= {_LANE} words); the "
+            f"dispatchers tile larger E via _packed_tiling — got width "
+            f"{w}")
     if w < _LANE:  # gather operands must be exactly one lane group wide
         bits = jnp.concatenate(
             [bits, jnp.zeros((blk_r, _LANE - w), jnp.uint32)], axis=1)
@@ -539,6 +555,24 @@ def _kernel_pack_bits(mask_u8, w: int) -> jnp.ndarray:
 # The offset rides in as data (an int32[2] = [offset//64, offset%64]
 # prefetch operand), so ONE compiled kernel serves every round of a
 # dissemination schedule.
+
+
+_PACK_CHUNK = _LANE * _WORD   # 4096 elements = one 128-lane group of words
+
+
+def _packed_tiling(e_pad: int, packed_w: int):
+    """Element/word tiling for the bitpacked ring kernels: one j step
+    per 4096-element chunk (exactly one lane group of words), so the
+    in-kernel unpack's native lane gather never spans more than one
+    group — this is what lifts the old E <= 4096 packed cap — and VMEM
+    per grid step stays bounded however large E grows.  At or below one
+    chunk the word axis rides whole (sub-lane word blocks are fine).
+
+    Returns (blk_elements, e_pad, words_per_block, total_words)."""
+    if e_pad <= _PACK_CHUNK:
+        return e_pad, e_pad, packed_w, packed_w
+    e_pad = _round_up(e_pad, _PACK_CHUNK)
+    return _PACK_CHUNK, e_pad, _LANE, e_pad // _WORD
 
 
 def _ring_window(lo, hi, o_mod, interpret: bool):
@@ -667,18 +701,20 @@ def ring_meta(offset, num_r: int) -> jnp.ndarray:
 def _fused_rows_ring(dst_arrays, offset, block_e: int, interpret: bool,
                      packed_w: int = 0, aligned: bool = False):
     """dst_arrays: (vv, present, da, dc) — present as uint8[R, E], or
-    bitpacked uint32[R, packed_w] when packed_w > 0 (the grid is then
-    single-j: packed words can't be lane-tiled and each step repacks
-    its full membership row).  aligned=True is the single-src-block
-    form, correct ONLY when offset % _BLOCK_R == 0 (callers dispatch
-    via _ring_round_dispatch)."""
+    bitpacked uint32[R, packed_w] when packed_w > 0 (the element grid
+    then tiles in 4096-element chunks, one lane group of words each —
+    _packed_tiling — so each j step unpacks/repacks one word group and
+    E is bounded by HBM, not the gather lane width).  aligned=True is
+    the single-src-block form, correct ONLY when offset % _BLOCK_R == 0
+    (callers dispatch via _ring_round_dispatch)."""
     num_r, num_e = dst_arrays[2].shape
     num_a = dst_arrays[0].shape[1]
     r_pad, e_pad, a_pad, blk = row_block_layout(num_r, num_e, num_a,
                                                 block_e)
     assert r_pad == num_r, "callers must check ring_supported()"
+    w_blk = total_w = packed_w
     if packed_w:
-        blk = e_pad
+        blk, e_pad, w_blk, total_w = _packed_tiling(e_pad, packed_w)
     nb = num_r // _BLOCK_R
     group = 2 if aligned else 3
 
@@ -688,7 +724,10 @@ def _fused_rows_ring(dst_arrays, offset, block_e: int, interpret: bool,
     vv, pres, da, dc = dst_arrays
     if a_pad != num_a:
         vv = jnp.pad(vv, ((0, 0), (0, a_pad - num_a)))
-    if not packed_w:
+    if packed_w:
+        if total_w != packed_w:   # word axis padded to whole chunks
+            pres = jnp.pad(pres, ((0, 0), (0, total_w - packed_w)))
+    else:
         pres = pad_e(pres)
     da, dc = pad_e(da), pad_e(dc)
 
@@ -697,11 +736,13 @@ def _fused_rows_ring(dst_arrays, offset, block_e: int, interpret: bool,
                                            e_named=3, aligned=aligned)
     p_shape = jax.ShapeDtypeStruct((num_r, e_pad), jnp.uint8)
     if packed_w:
-        b_blk = lambda m: pl.BlockSpec((_BLOCK_R, packed_w), m)  # noqa: E731
+        b_blk = lambda m: pl.BlockSpec((_BLOCK_R, w_blk), m)  # noqa: E731
+        # E-style (i, j) maps for both ins and outs: word block j serves
+        # element block j (the grid is multi-j once the word axis tiles)
         maps = [s.index_map for s in in_specs[group:2 * group]]
         in_specs[group:2 * group] = [b_blk(m) for m in maps]
-        out_specs[1] = b_blk(in_specs[0].index_map)
-        p_shape = jax.ShapeDtypeStruct((num_r, packed_w), jnp.uint32)
+        out_specs[1] = b_blk(maps[0])
+        p_shape = jax.ShapeDtypeStruct((num_r, total_w), jnp.uint32)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nb, e_pad // blk),
@@ -710,7 +751,7 @@ def _fused_rows_ring(dst_arrays, offset, block_e: int, interpret: bool,
     )
     ins = [x for arr in (vv, pres, da, dc) for x in (arr,) * group]
     out_vv, out_p, out_da, out_dc = pl.pallas_call(
-        _make_ring_kernel(interpret, packed_w, aligned),
+        _make_ring_kernel(interpret, w_blk, aligned),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((num_r, a_pad), jnp.uint32),
@@ -720,7 +761,7 @@ def _fused_rows_ring(dst_arrays, offset, block_e: int, interpret: bool,
         ],
         interpret=interpret,
     )(meta, *ins)
-    out_p = out_p if packed_w else out_p[:, :num_e]
+    out_p = out_p[:, :packed_w] if packed_w else out_p[:, :num_e]
     return (out_vv[:, :num_a], out_p,
             out_da[:, :num_e], out_dc[:, :num_e])
 

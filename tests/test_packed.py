@@ -125,3 +125,60 @@ def test_packed_nbytes_are_8x_smaller():
     state = rand_state(rng, R, 256, 4)
     packed = packed_mod.pack_awset(state)
     assert packed.present_bits.nbytes * 8 == state.present.nbytes
+
+
+@pytest.mark.parametrize("offset", [1, 64, 65])
+def test_packed_ring_round_beyond_one_word_group(offset):
+    """E=8192 -> 256 packed words, two 128-word lane groups: the word
+    tiling (pallas_merge._packed_tiling) must produce bitwise-identical
+    results to the bool layout beyond the old E<=4096 cap, on both the
+    aligned (offset 64) and windowed kernel forms."""
+    rng = np.random.default_rng(7)
+    E = 8192
+    state = rand_state(rng, R, E, 5)
+    want = pallas_merge.pallas_ring_round_rows(state, offset)
+    got_packed = pallas_merge.pallas_ring_round_rows_packed(
+        packed_mod.pack_awset(state), offset)
+    assert got_packed.present_bits.shape == (R, E // 32)
+    got = packed_mod.unpack_awset(got_packed, E)
+    for name in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, name)),
+            np.asarray(getattr(got, name)), err_msg=name)
+
+
+@pytest.mark.parametrize("offset", [1, 64])
+def test_packed_delta_ring_round_beyond_one_word_group(offset):
+    """The delta twin at E=8192 (word-tiled multi-j grid), v2 mode."""
+    import random
+
+    from tests.test_pallas_delta import _scenario_state
+
+    rng = random.Random(13)
+    E = 8192
+    state = _scenario_state(rng, R, E, 8)
+    want = pallas_delta.pallas_delta_ring_round(state, offset)
+    got_packed = pallas_delta.pallas_delta_ring_round_packed(
+        packed_mod.pack_awset_delta(state), offset)
+    got = packed_mod.unpack_awset_delta(got_packed, E)
+    for name in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, name)),
+            np.asarray(getattr(got, name)), err_msg=name)
+
+
+def test_packed_non_chunk_multiple_width():
+    """E between one and two chunks (4100 elements -> 129 words): the
+    padded word tail must round-trip exactly."""
+    rng = np.random.default_rng(9)
+    E = 4100
+    state = rand_state(rng, R, E, 4)
+    want = pallas_merge.pallas_ring_round_rows(state, 3)
+    got_packed = pallas_merge.pallas_ring_round_rows_packed(
+        packed_mod.pack_awset(state), 3)
+    assert got_packed.present_bits.shape == (R, (E + 31) // 32)
+    got = packed_mod.unpack_awset(got_packed, E)
+    for name in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, name)),
+            np.asarray(getattr(got, name)), err_msg=name)
