@@ -302,11 +302,9 @@ def measure_config2(num_replicas=1000, num_actors=256):
     }
 
 
-def measure_config4(num_replicas=100_032, num_elements=256,
-                    num_writers=256):
-    """delta-AWSet 100K replicas: payload-compressed gossip rounds (the
-    single-chip rate of the program that runs on a v5e-4 mesh via
-    parallel/mesh.py; the driver environment has one chip).
+def _config4_delta_fleet(num_replicas, num_elements, num_writers):
+    """The config-4 fleet + its dissemination offsets, shared by the v2
+    and strict-reference ladder steps so both measure the SAME state.
 
     100,032 = a nearby _BLOCK_R multiple of the nominal 100K (see
     measure_tpu: exact 100,000 would silently fall back off the
@@ -325,12 +323,49 @@ def measure_config4(num_replicas=100_032, num_elements=256,
         del_dot_actor=zE, del_dot_counter=zE, processed=base.vv)
     offsets = jnp.asarray(gossip.dissemination_offsets(num_replicas),
                           jnp.uint32)
+    return state, offsets
+
+
+def measure_config4(num_replicas=100_032, num_elements=256,
+                    num_writers=256):
+    """delta-AWSet 100K replicas: payload-compressed gossip rounds (the
+    single-chip rate of the program that runs on a v5e-4 mesh via
+    parallel/mesh.py; the driver environment has one chip)."""
+    from go_crdt_playground_tpu.parallel import gossip
+
+    state, offsets = _config4_delta_fleet(num_replicas, num_elements,
+                                          num_writers)
     meas = _scan_round_rate(
         lambda s, off: gossip.delta_ring_gossip_round(
             s, off, delta_semantics="v2"),
         state, offsets, start=8, max_n=256, full=True)
     return {
         "metric": "config4: delta-AWSet 100K replicas, v2 delta gossip",
+        "value": round(num_replicas / meas.per_round_s, 1),
+        "unit": "delta-merges/sec/chip",
+        **meas.stats(num_replicas),
+    }
+
+
+def measure_config4_reference(num_replicas=100_032, num_elements=256,
+                              num_writers=256):
+    """config4's fleet under STRICT-REFERENCE δ semantics — the fused
+    empty-δ VV-skip path (ops/pallas_delta._strict_vv_epilogue).  Before
+    round 3 fused it, reference-mode fleets paid the ~40x XLA HasDot
+    path; this measurement is the committed evidence of the fused rate
+    (VERDICT r3 item #4's 'with a measured rate')."""
+    from go_crdt_playground_tpu.parallel import gossip
+
+    state, offsets = _config4_delta_fleet(num_replicas, num_elements,
+                                          num_writers)
+    meas = _scan_round_rate(
+        lambda s, off: gossip.delta_ring_gossip_round(
+            s, off, delta_semantics="reference",
+            strict_reference_semantics=True),
+        state, offsets, start=8, max_n=256, full=True)
+    return {
+        "metric": "config4ref: delta-AWSet 100K replicas, STRICT-"
+                  "REFERENCE delta semantics (fused empty-delta VV-skip)",
         "value": round(num_replicas / meas.per_round_s, 1),
         "unit": "delta-merges/sec/chip",
         **meas.stats(num_replicas),
@@ -834,6 +869,7 @@ def run_ladder():
 
     steps = [("config1", measure_config1), ("config2", measure_config2),
              ("config3", config3), ("config4", measure_config4),
+             ("config4ref", measure_config4_reference),
              ("config5", measure_config5)]
     results = []
     for step, fn in steps:

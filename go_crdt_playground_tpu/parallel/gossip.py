@@ -481,6 +481,49 @@ def all_pairs_converge(state, delta: bool = False,
     return state
 
 
+@functools.lru_cache(maxsize=None)
+def _advance_program(delta: bool, schedule: str, delta_semantics: str,
+                     has_drop: bool):
+    """Cached jitted multi-round advance for rounds_to_convergence: a
+    whole chunk of rounds is ONE dispatch — the round index drives
+    offset selection and the drop/perm randomness INSIDE a lax.scan
+    (fold_in on the traced index reproduces the exact stream the old
+    eager loop drew), so a remote-tunnel measurement pays
+    rounds/check_every round trips instead of 2-3 per round.  The
+    eager form ground through ~1.8K tiny tunnel dispatches per droprate
+    run and looked like a hang (round-4 postmortem).  key and
+    drop_rate are traced operands, so the six-rate droprate sweep
+    shares one compiled program per chunk width; distinct static n
+    values are the chunk size plus O(log check_every) bisection
+    widths.  has_drop is static so no-drop runs keep the drop=None fast
+    path (no mask draw, no per-round full-state select)."""
+    round_fn = delta_gossip_round if delta else gossip_round
+    ring_fn = delta_ring_gossip_round if delta else ring_gossip_round
+    kw = {"delta_semantics": delta_semantics} if delta else {}
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def advance_jit(s, key, offsets_arr, drop_rate, start, n: int):
+        R = s.vv.shape[0]
+
+        def body(c, i):
+            rnd = start + i
+            drop = None
+            if has_drop:
+                drop = jax.random.bernoulli(
+                    jax.random.fold_in(key, 2 * rnd + 1), drop_rate, (R,))
+            if schedule == "random":
+                perm = random_perm(jax.random.fold_in(key, 2 * rnd), R)
+                return round_fn(c, perm, drop, **kw), None
+            off = (jnp.uint32(1) if schedule == "ring"
+                   else offsets_arr[rnd % offsets_arr.shape[0]])
+            return ring_fn(c, off, drop, **kw), None
+
+        s, _ = jax.lax.scan(body, s, jnp.arange(n, dtype=jnp.uint32))
+        return s
+
+    return advance_jit
+
+
 def rounds_to_convergence(
     state,
     key: Optional[jax.Array] = None,
@@ -517,39 +560,25 @@ def rounds_to_convergence(
     """
     R = state.vv.shape[0]
     offsets = dissemination_offsets(R) or [1]
-    round_fn = delta_gossip_round_jit if delta else gossip_round_jit
-    # ring-schedule rounds go through the offset form: the fused ring
-    # kernel takes the offset as DATA, so every round reuses one
-    # compiled program and no permuted state copy is materialized
-    ring_fn = delta_ring_gossip_round_jit if delta else ring_gossip_round_jit
-    kw = {"delta_semantics": delta_semantics} if delta else {}
-
-    def one_round(s, rnd: int):
-        offset = None
-        if schedule == "dissemination":
-            offset = offsets[rnd % len(offsets)]
-        elif schedule == "ring":
-            offset = 1
-        elif schedule == "random":
-            if key is None:
-                raise ValueError("random schedule requires a key")
-            perm = random_perm(jax.random.fold_in(key, 2 * rnd), R)
-        else:
-            raise ValueError(f"unknown schedule {schedule!r}")
-        drop = None
-        if drop_rate > 0.0:
-            if key is None:
-                raise ValueError("drop_rate requires a key")
-            drop = jax.random.bernoulli(
-                jax.random.fold_in(key, 2 * rnd + 1), drop_rate, (R,))
-        if offset is not None:
-            return ring_fn(s, jnp.uint32(offset), drop, **kw)
-        return round_fn(s, perm, drop, **kw)
+    if schedule not in ("dissemination", "ring", "random"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if schedule == "random" and key is None:
+        raise ValueError("random schedule requires a key")
+    if drop_rate > 0.0 and key is None:
+        raise ValueError("drop_rate requires a key")
+    offsets_arr = jnp.asarray(offsets, jnp.uint32)
+    advance_prog = _advance_program(bool(delta), schedule, delta_semantics,
+                                    drop_rate > 0.0)
+    # key/drop_rate ride as DATA so one compiled program serves every
+    # (positive rate, seed) run of a measurement sweep; no-drop runs
+    # share a second, mask-free program (a dummy key placates the
+    # signature — its stream is never drawn there)
+    key_arr = key if key is not None else jax.random.key(0)
+    rate_arr = jnp.float32(drop_rate)
 
     def advance(s, start: int, n: int):
-        for i in range(n):
-            s = one_round(s, start + i)
-        return s
+        return advance_prog(s, key_arr, offsets_arr, rate_arr,
+                            jnp.uint32(start), n)
 
     def conv(s) -> bool:
         return bool(converged_jit(s.present, s.vv))

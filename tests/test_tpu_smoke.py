@@ -109,6 +109,21 @@ def test_ring_delta_kernel_mosaic(offset):
     _assert_equal(want, got)
 
 
+@pytest.mark.parametrize("offset", [1, 65])
+def test_ring_delta_kernel_strict_reference_mosaic(offset):
+    """The fused STRICT-REFERENCE δ path (empty-δ VV-skip as a scratch-
+    accumulated cross-E reduction, _strict_vv_epilogue) must Mosaic-
+    compile — interpret-mode CI cannot prove the scratch/when lowering."""
+    state = _delta_state(5)
+    want = gossip.delta_gossip_round(
+        state, gossip.ring_perm(R, offset), delta_semantics="reference",
+        strict_reference_semantics=True, kernel="xla")
+    got = pallas_delta.pallas_delta_ring_round(
+        state, offset, delta_semantics="reference",
+        strict_reference_semantics=True, interpret=False)
+    _assert_equal(want, got)
+
+
 def test_rows_delta_kernel_mosaic():
     state = _delta_state(4)
     perm = gossip.random_perm(jax.random.key(1), R)
@@ -155,6 +170,36 @@ def test_packed_ring_kernels_mosaic(offset):
             packed_mod.pack_awset_delta(dstate), offset,
             interpret=False), E)
     _assert_equal(dwant, dgot)
+
+
+@pytest.mark.parametrize("num_e", [8192, 4100])
+def test_packed_word_tiling_mosaic(num_e):
+    """The word-tiled packed grid beyond the old E<=4096 cap (two+ lane
+    groups of words; pallas_merge._packed_tiling) must Mosaic-compile
+    and agree with the bool layout on the real chip — interpret-mode CI
+    cannot prove the lowering."""
+    from go_crdt_playground_tpu.models import packed as packed_mod
+    from go_crdt_playground_tpu.models.awset import AWSetState
+
+    rng = np.random.default_rng(11)
+    present = rng.random((R, num_e)) < 0.4
+    da = np.where(present, rng.integers(0, A, (R, num_e)),
+                  0).astype(np.uint32)
+    dc = np.where(present, rng.integers(1, 9, (R, num_e)),
+                  0).astype(np.uint32)
+    state = AWSetState(
+        vv=jnp.asarray(rng.integers(0, 10, (R, A)).astype(np.uint32)),
+        present=jnp.asarray(present), dot_actor=jnp.asarray(da),
+        dot_counter=jnp.asarray(dc),
+        actor=jnp.arange(R, dtype=jnp.uint32) % A)
+    for offset in (3, 64):
+        want = pallas_merge.pallas_ring_round_rows(state, offset,
+                                                   interpret=False)
+        got = packed_mod.unpack_awset(
+            pallas_merge.pallas_ring_round_rows_packed(
+                packed_mod.pack_awset(state), offset,
+                interpret=False), num_e)
+        _assert_equal(want, got)
 
 
 def test_ormap_ring_round_mosaic():
