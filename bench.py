@@ -326,25 +326,33 @@ def _config4_delta_fleet(num_replicas, num_elements, num_writers):
     return state, offsets
 
 
-def measure_config4(num_replicas=100_032, num_elements=256,
-                    num_writers=256):
-    """delta-AWSet 100K replicas: payload-compressed gossip rounds (the
-    single-chip rate of the program that runs on a v5e-4 mesh via
-    parallel/mesh.py; the driver environment has one chip)."""
+def _measure_config4_variant(metric, num_replicas, num_elements,
+                             num_writers, **round_kw):
+    """One config-4 ladder measurement: the shared fleet pushed through
+    delta_ring_gossip_round with the given semantics kwargs."""
     from go_crdt_playground_tpu.parallel import gossip
 
     state, offsets = _config4_delta_fleet(num_replicas, num_elements,
                                           num_writers)
     meas = _scan_round_rate(
-        lambda s, off: gossip.delta_ring_gossip_round(
-            s, off, delta_semantics="v2"),
+        lambda s, off: gossip.delta_ring_gossip_round(s, off, **round_kw),
         state, offsets, start=8, max_n=256, full=True)
     return {
-        "metric": "config4: delta-AWSet 100K replicas, v2 delta gossip",
+        "metric": metric,
         "value": round(num_replicas / meas.per_round_s, 1),
         "unit": "delta-merges/sec/chip",
         **meas.stats(num_replicas),
     }
+
+
+def measure_config4(num_replicas=100_032, num_elements=256,
+                    num_writers=256):
+    """delta-AWSet 100K replicas: payload-compressed gossip rounds (the
+    single-chip rate of the program that runs on a v5e-4 mesh via
+    parallel/mesh.py; the driver environment has one chip)."""
+    return _measure_config4_variant(
+        "config4: delta-AWSet 100K replicas, v2 delta gossip",
+        num_replicas, num_elements, num_writers, delta_semantics="v2")
 
 
 def measure_config4_reference(num_replicas=100_032, num_elements=256,
@@ -354,22 +362,11 @@ def measure_config4_reference(num_replicas=100_032, num_elements=256,
     round 3 fused it, reference-mode fleets paid the ~40x XLA HasDot
     path; this measurement is the committed evidence of the fused rate
     (VERDICT r3 item #4's 'with a measured rate')."""
-    from go_crdt_playground_tpu.parallel import gossip
-
-    state, offsets = _config4_delta_fleet(num_replicas, num_elements,
-                                          num_writers)
-    meas = _scan_round_rate(
-        lambda s, off: gossip.delta_ring_gossip_round(
-            s, off, delta_semantics="reference",
-            strict_reference_semantics=True),
-        state, offsets, start=8, max_n=256, full=True)
-    return {
-        "metric": "config4ref: delta-AWSet 100K replicas, STRICT-"
-                  "REFERENCE delta semantics (fused empty-delta VV-skip)",
-        "value": round(num_replicas / meas.per_round_s, 1),
-        "unit": "delta-merges/sec/chip",
-        **meas.stats(num_replicas),
-    }
+    return _measure_config4_variant(
+        "config4ref: delta-AWSet 100K replicas, STRICT-REFERENCE delta "
+        "semantics (fused empty-delta VV-skip)",
+        num_replicas, num_elements, num_writers,
+        delta_semantics="reference", strict_reference_semantics=True)
 
 
 def measure_config5(num_replicas=1_000_000, num_elements=256,

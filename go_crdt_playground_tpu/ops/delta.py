@@ -134,6 +134,13 @@ def _delta_apply_impl(
         # the payload keys: remove iff the SENDER's clock covers our LIVE
         # dot.  (Key absent at sender is guaranteed by payload
         # construction.)  Preserves add-wins in any topology.
+        # NOTE: the gather must run on the POST-phase-1 dots (da1/dc1),
+        # not be shortcut via "p1_take lanes are trivially covered by the
+        # sender's clock": that identity leans on the every-VV-covers-its-
+        # own-live-dots invariant, which the compact-overflow path
+        # deliberately breaks (ops/compact.py ships partial data with NO
+        # clock advance), and there the shortcut removes entries the spec
+        # (models/spec.py v2 arbitration) keeps.
         remove = p.deleted & present1 & has_dot(p.src_vv, da1, dc1)
     else:
         # Reference arbitration (awset-delta_test.go:153-158): keep iff OUR

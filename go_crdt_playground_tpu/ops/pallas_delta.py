@@ -126,7 +126,13 @@ def _delta_algebra(dst, src, s_actor, mode: str = "v2"):
         deleted_f = dd | sd
         del_da_f = jnp.where(rec_f, sdda, ddda)
         del_dc_f = jnp.where(rec_f, sddc, dddc)
-        # v2 arbitration: remove iff the SENDER's clock covers our live dot
+        # v2 arbitration: remove iff the SENDER's clock covers our live
+        # dot.  The gather runs on the post-phase-1 dots — do NOT
+        # shortcut changed lanes as "trivially covered by the sender's
+        # clock": the compact-overflow path ships partial data with no
+        # clock advance (ops/compact.py), breaking the VV-covers-own-
+        # dots invariant that shortcut needs, and there it would remove
+        # entries the spec keeps (r4 review repro).
         remove = deleted_p & present1 & (dc1 <= gather_rows(svv, da1))
         present_d = present1 & ~remove
         da_d = jnp.where(present_d, da1, 0)

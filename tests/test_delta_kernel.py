@@ -241,3 +241,48 @@ def test_add_elements_batch_matches_sequential_adds():
             for variant, other in (("batch", bat), ("padded", pad)):
                 b = np.asarray(getattr(other, name))
                 assert np.array_equal(a, b), (ids, variant, name, a, b)
+
+
+def test_v2_remove_arbitration_on_uncovered_sender_dots():
+    """A sender whose VV does NOT cover its own shipped live dot — the
+    compact-overflow state (ops/compact.py: partial data, NO clock
+    advance) — ships a changed lane plus a matching deletion record.
+    v2 removes only when the sender's CLOCK covers the live dot
+    (models/spec.py arbitration), so the entry must SURVIVE; a
+    'changed lanes are trivially covered' shortcut removes it (r4
+    review repro).  Pinned on the XLA path and the fused kernel."""
+    import jax.numpy as jnp
+
+    from go_crdt_playground_tpu.ops import pallas_delta
+    from go_crdt_playground_tpu.parallel import gossip
+
+    R, E, A = 2, 8, 2
+    zE = jnp.zeros((R, E), jnp.uint32)
+    state = awset_delta.AWSetDeltaState(
+        # row 0: receiver (actor 0) — saw the sender's counter 1 only
+        # (delta dispatch engages, counter-2 dots are news), no entries
+        # row 1: sender (actor 1) — live dot (1,2) AND deletion record
+        # (1,2) on lane 0, with an all-zero VV (overflow state)
+        vv=jnp.asarray([[0, 1], [0, 0]], jnp.uint32),
+        present=jnp.zeros((R, E), bool).at[1, 0].set(True),
+        dot_actor=zE.at[1, 0].set(1),
+        dot_counter=zE.at[1, 0].set(2),
+        actor=jnp.asarray([0, 1], jnp.uint32),
+        deleted=jnp.zeros((R, E), bool).at[1, 0].set(True),
+        del_dot_actor=zE.at[1, 0].set(1),
+        del_dot_counter=zE.at[1, 0].set(2),
+        processed=jnp.zeros((R, A), jnp.uint32),
+    )
+    perm = jnp.asarray([1, 0], jnp.uint32)
+    want = gossip.delta_gossip_round(state, perm, delta_semantics="v2",
+                                     kernel="xla")
+    # the shipped entry survives: the sender's zero clock covers nothing
+    assert bool(want.present[0, 0]), (
+        "uncovered sender dot must not trigger removal")
+    assert int(want.dot_counter[0, 0]) == 2
+    got = pallas_delta.pallas_delta_gossip_round(state, perm,
+                                                 delta_semantics="v2")
+    for name in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, name)),
+            np.asarray(getattr(got, name)), err_msg=name)
