@@ -519,7 +519,9 @@ def _advance_program(delta: bool, schedule: str, delta_semantics: str,
             return ring_fn(c, off, drop, **kw), None
 
         s, _ = jax.lax.scan(body, s, jnp.arange(n, dtype=jnp.uint32))
-        return s
+        # the convergence digest rides in the same program: a chunk costs
+        # ONE device->host sync (the bool), not a second digest dispatch
+        return s, collectives.converged(s.present, s.vv)
 
     return advance_jit
 
@@ -577,20 +579,20 @@ def rounds_to_convergence(
     rate_arr = jnp.float32(drop_rate)
 
     def advance(s, start: int, n: int):
-        return advance_prog(s, key_arr, offsets_arr, rate_arr,
+        """n rounds + the fused digest: (state, converged) for ONE
+        device->host sync (the bool fetch)."""
+        s, c = advance_prog(s, key_arr, offsets_arr, rate_arr,
                             jnp.uint32(start), n)
+        return s, bool(c)
 
-    def conv(s) -> bool:
-        return bool(converged_jit(s.present, s.vv))
-
-    if conv(state):
+    if bool(converged_jit(state.present, state.vv)):
         return 0, state
     rnd = 0
     while rnd < max_rounds:
         k = min(max(1, check_every), max_rounds - rnd)
         chunk_start = state
-        state = advance(state, rnd, k)
-        if conv(state):
+        state, chunk_conv = advance(state, rnd, k)
+        if chunk_conv:
             # invariants: NOT converged after lo rounds, converged after
             # hi; each probe resumes from the last non-converged prefix
             # (lo_state), so the whole bisection replays O(k) rounds
@@ -599,8 +601,8 @@ def rounds_to_convergence(
             lo_state, hi_state = chunk_start, state
             while lo + 1 < hi:
                 mid = (lo + hi) // 2
-                s_mid = advance(lo_state, rnd + lo, mid - lo)
-                if conv(s_mid):
+                s_mid, mid_conv = advance(lo_state, rnd + lo, mid - lo)
+                if mid_conv:
                     hi, hi_state = mid, s_mid
                 else:
                     lo, lo_state = mid, s_mid
