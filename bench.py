@@ -1081,7 +1081,10 @@ def main():
             lines = [ln for ln in out.splitlines() if ln.strip()]
             rec = json.loads(lines[-1])
             rec["note"] = ("ambient (TPU) backend unavailable: "
-                           + "; ".join(errors) + " — CPU fallback")
+                           + "; ".join(errors) + " — CPU fallback; "
+                           "committed on-chip evidence for this round "
+                           "lives in BENCH_LADDER.json / NORTHSTAR.json "
+                           "(platform fields say tpu)")
             print(json.dumps(rec))
             return
         errors.append(f"cpu-fallback({why})")
