@@ -17,6 +17,7 @@ from go_crdt_playground_tpu.obs.metrics import Recorder, payload_metrics  # noqa
 _TRACE_EXPORTS = frozenset({
     "format_event", "render_spec_trace", "render_tensor_trace",
     "render_delta_tensor_trace", "trace_counts", "printstate",
+    "format_delta_extract", "format_delta_extract_tensor",
 })
 
 __all__ = ["Recorder", "payload_metrics", *sorted(_TRACE_EXPORTS)]

@@ -130,6 +130,43 @@ def render_tensor_trace(
     return lines
 
 
+def format_delta_extract(changed, deleted) -> str:
+    """The sender-side δ-extraction print (awset-delta_test.go:103):
+    ``delta: changed map[D:(A 4) E:(A 5)], deleted map[B:(A 3)]``.
+    Go's ``%v`` renders a map[string]Dot with SORTED keys (fmt sorts map
+    keys for deterministic output), bare keys, the Dot's String(), and a
+    nil or empty map as ``map[]``."""
+    def go_map(d) -> str:
+        if not d:
+            return "map[]"
+        inner = " ".join(
+            f"{k}:{_dot_str(_as_pair(v))}" for k, v in sorted(d.items()))
+        return f"map[{inner}]"
+
+    return f"delta: changed {go_map(changed)}, deleted {go_map(deleted)}"
+
+
+def format_delta_extract_tensor(payload, key_of=None) -> str:
+    """``format_delta_extract`` from a single-replica DeltaPayload
+    (ops/delta.delta_extract): payload masks decode to the same Go map
+    rendering, with element ids mapped through ``key_of`` (the
+    ElementDict decode in dictionary-coded deployments)."""
+    key_of = key_of or (lambda e: str(e))
+    changed = np.asarray(payload.changed)
+    if changed.ndim != 1:
+        raise ValueError("format_delta_extract_tensor takes a "
+                         "single-replica payload; index the batch first")
+    # one bulk transfer per array (the sibling renderers' pattern) — a
+    # per-lane scalar index on a device array is a host round trip each
+    ch_da, ch_dc = np.asarray(payload.ch_da), np.asarray(payload.ch_dc)
+    del_da, del_dc = np.asarray(payload.del_da), np.asarray(payload.del_dc)
+    ch = {key_of(int(e)): (int(ch_da[e]), int(ch_dc[e]))
+          for e in np.nonzero(changed)[0]}
+    dl = {key_of(int(e)): (int(del_da[e]), int(del_dc[e]))
+          for e in np.nonzero(np.asarray(payload.deleted))[0]}
+    return format_delta_extract(ch, dl)
+
+
 def render_delta_tensor_trace(
     trace: MergeTrace,
     dst_before,

@@ -215,3 +215,46 @@ def test_printstate_box_dump_parity():
     packed = awset.from_arrays(codec.pack_awsets([a, b], dictionary, 2))
     rendered = codec.render_packed(awset.to_arrays(packed), dictionary)
     assert printstate(rendered) == expected
+
+
+def test_delta_extract_print_parity():
+    """The sender-side extraction print (awset-delta_test.go:103) renders
+    byte-for-byte from both the spec model and the tensor payload: the
+    T6 scenario's own two extraction moments are the oracle (Go fmt
+    prints map[string]Dot with sorted keys; nil maps as map[])."""
+    from go_crdt_playground_tpu.models.spec import AWSetDelta, VersionVector
+    from go_crdt_playground_tpu.obs import (format_delta_extract,
+                                            format_delta_extract_tensor)
+    from go_crdt_playground_tpu.ops import delta as delta_ops
+    from go_crdt_playground_tpu.utils.codec import (ElementDict,
+                                                    pack_awset_deltas)
+    import jax
+    import jax.numpy as jnp
+
+    A = AWSetDelta(actor=0, version_vector=VersionVector([0, 0]))
+    B = AWSetDelta(actor=1, version_vector=VersionVector([0, 0]))
+    A.add("A", "B"); B.add("A", "C")
+    A.merge(B); B.merge(A)
+    A.del_("B"); A.add("D", "E"); B.add("E")
+
+    # B.Merge(A)'s extraction: A ships D/E adds + the B deletion record
+    changed, deleted = A.make_delta_merge_data(B.version_vector)
+    line = format_delta_extract(changed, deleted)
+    assert line == ("delta: changed map[D:(A 4) E:(A 5)], "
+                    "deleted map[B:(A 3)]"), line
+
+    # same line from the packed tensor payload
+    dictionary = ElementDict(capacity=8)
+    arrays = pack_awset_deltas([A, B], dictionary, 2)
+    from go_crdt_playground_tpu.models import awset_delta as ad
+    state = ad.from_arrays(arrays)
+    src = jax.tree.map(lambda x: x[0], state)   # A is replica 0
+    payload = delta_ops.delta_extract(src, jnp.asarray(state.vv[1]))
+    tline = format_delta_extract_tensor(payload, key_of=dictionary.decode)
+    assert tline == line, (tline, line)
+
+    # after full convergence the final extraction is empty on both sides
+    B.merge(A)
+    changed, deleted = B.make_delta_merge_data(A.version_vector)
+    assert format_delta_extract(changed, deleted) == \
+        "delta: changed map[], deleted map[]"
