@@ -83,21 +83,24 @@ _WORKER = textwrap.dedent("""
     vv = np.zeros((hi - lo, A), np.uint32)
     vv[np.arange(hi - lo), np.arange(lo, hi)] = counter.max(axis=1)
 
-    def globalize(name, local, global_shape):
+    def globalize(specs, name, local, global_shape):
         sharding = NamedSharding(mesh, getattr(specs, name))
         return jax.make_array_from_process_local_data(
             sharding, local, global_shape)
 
-    state = awset.AWSetState(
-        vv=globalize("vv", vv, (R, A)),
-        present=globalize("present", present, (R, E)),
-        dot_actor=globalize("dot_actor",
-                            np.where(present, r, 0).astype(np.uint32),
-                            (R, E)),
-        dot_counter=globalize("dot_counter", counter, (R, E)),
-        actor=globalize("actor", np.arange(lo, hi, dtype=np.uint32),
-                        (R,)),
-    )
+    def build(state_cls, specs, **extra):
+        fields = dict(
+            vv=(vv, (R, A)),
+            present=(present, (R, E)),
+            dot_actor=(np.where(present, r, 0).astype(np.uint32), (R, E)),
+            dot_counter=(counter, (R, E)),
+            actor=(np.arange(lo, hi, dtype=np.uint32), (R,)),
+            **extra,
+        )
+        return state_cls(**{{name: globalize(specs, name, local, shape)
+                             for name, (local, shape) in fields.items()}})
+
+    state = build(awset.AWSetState, specs)
 
     @jax.jit
     def step(s, perm):
@@ -108,6 +111,35 @@ _WORKER = textwrap.dedent("""
     jax.block_until_ready(out)
     # the digest is fully replicated: both hosts can read it
     print(f"WORKER_OK pid={{pid}} converged={{bool(conv)}}")
+
+    # δ path over the same 2-process mesh: payload-compressed rounds +
+    # collective GC frontier + digest, driven to convergence — the
+    # v5e-16 multi-host program shape for the headline protocol
+    from go_crdt_playground_tpu.models import awset_delta
+    from go_crdt_playground_tpu.ops import delta as delta_ops
+
+    zE = np.zeros((hi - lo, E), np.uint32)
+    dstate = build(
+        awset_delta.AWSetDeltaState,
+        mesh_mod.partition_specs(awset_delta.AWSetDeltaState),
+        deleted=(np.zeros((hi - lo, E), bool), (R, E)),
+        del_dot_actor=(zE, (R, E)),
+        del_dot_counter=(zE, (R, E)),
+        processed=(vv, (R, A)),
+    )
+
+    @jax.jit
+    def dstep(s, perm):
+        s = gossip.delta_gossip_round(s, perm, delta_semantics="v2")
+        frontier = delta_ops.gc_frontier(s.processed)
+        s = delta_ops.gc_apply(s, frontier)
+        return s, collectives.converged(s.present, s.vv)
+
+    dconv = False
+    for off in gossip.dissemination_offsets(R):
+        dstate, dconv = dstep(dstate, gossip.ring_perm(R, off))
+    jax.block_until_ready(dstate)
+    print(f"WORKER_DELTA_OK pid={{pid}} converged={{bool(dconv)}}")
 """).format(repo=REPO)
 
 
@@ -154,3 +186,7 @@ def test_two_process_distributed_gossip_round(tmp_path):
         assert rc == 0, f"worker failed:\n{err[-3000:]}"
     assert "WORKER_OK pid=0" in outs[0][1]
     assert "WORKER_OK pid=1" in outs[1][1]
+    # the δ fleet converged across the process boundary, and both hosts
+    # read the same replicated digest
+    assert "WORKER_DELTA_OK pid=0 converged=True" in outs[0][1]
+    assert "WORKER_DELTA_OK pid=1 converged=True" in outs[1][1]

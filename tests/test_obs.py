@@ -253,8 +253,13 @@ def test_delta_extract_print_parity():
     tline = format_delta_extract_tensor(payload, key_of=dictionary.decode)
     assert tline == line, (tline, line)
 
-    # after full convergence the final extraction is empty on both sides
+    # after convergence B->A extracts nothing, but A->B still ships its
+    # deletion record (reference mode has no GC, so records persist —
+    # the nil-map rendering and the asymmetry are both pinned)
     B.merge(A)
     changed, deleted = B.make_delta_merge_data(A.version_vector)
     assert format_delta_extract(changed, deleted) == \
         "delta: changed map[], deleted map[]"
+    changed, deleted = A.make_delta_merge_data(B.version_vector)
+    assert format_delta_extract(changed, deleted) == \
+        "delta: changed map[], deleted map[B:(A 3)]"
