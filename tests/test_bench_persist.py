@@ -107,3 +107,22 @@ def test_partial_lines_are_json(tmp_path):
     with open(path) as f:
         rec = json.loads(f.read())
     assert rec["_step"] == "drop0.1"
+
+
+def test_time_drop_round_compiles_and_runs():
+    """The droprate capture's on-chip timing program must compile and
+    execute on CPU CI: it only ever ran under on_tpu before, so a break
+    surfaced at the END of a live TPU session (after the convergence
+    sweeps) — the most expensive possible place to find it."""
+    import jax.numpy as jnp
+
+    from go_crdt_playground_tpu.parallel import gossip
+
+    state0 = bench.build_state(96, 32, 8)
+    offsets = jnp.asarray(gossip.dissemination_offsets(96), jnp.uint32)
+    for rate in (0.0, 0.3):
+        # tiny scan: this proves compile+execute, not a stable rate
+        per_round = bench._time_drop_round(state0, offsets, rate, 96,
+                                           start=4, min_delta=1e-4,
+                                           repeats=1)
+        assert per_round > 0.0
