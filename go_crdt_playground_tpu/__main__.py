@@ -78,8 +78,11 @@ def _cmd_serve(port: int) -> int:
 
     srv = MergerServer(port=port)
     host, bound = srv.serve()
+    # flush: a harness reading our pipe must see the address before the
+    # first request (stdout is block-buffered when not a tty)
     print(f"Merger bridge listening on {host}:{bound} "
-          "(method 0x01 = Merge, 0x02 = Ping; 5-byte header + proto body)")
+          "(method 0x01 = Merge, 0x02 = Ping; 5-byte header + proto body)",
+          flush=True)
     try:
         while True:
             time.sleep(3600)
