@@ -28,10 +28,15 @@ import numpy as np
 
 from go_crdt_playground_tpu.models.awset import AWSetState
 from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
+from go_crdt_playground_tpu.models.packed import (
+    PackedAWSetDeltaState,
+    PackedAWSetState,
+)
 from go_crdt_playground_tpu.ops.lattices import (
     GCounterState,
     LWWMapState,
     MVRegisterState,
+    ORMapState,
     PNCounterState,
     TwoPSetState,
 )
@@ -47,11 +52,14 @@ STATE_TYPES = {
     for cls in (
         AWSetState,
         AWSetDeltaState,
+        PackedAWSetState,
+        PackedAWSetDeltaState,
         GCounterState,
         PNCounterState,
         TwoPSetState,
         LWWMapState,
         MVRegisterState,
+        ORMapState,
     )
 }
 

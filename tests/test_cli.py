@@ -2,12 +2,15 @@
 walkthrough, a converging fleet, and the Merger bridge service — the
 whole operational surface, driven as a user would."""
 
+import os
 import re
 import signal
 import subprocess
 import sys
 
 from go_crdt_playground_tpu.__main__ import main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_scenario_command_passes(capsys):
@@ -42,11 +45,13 @@ def test_serve_command_end_to_end(tmp_path):
     # diagnostics); the address line is read under a hard deadline so a
     # child wedged before printing can never hang the suite
     err_path = tmp_path / "serve.err"
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "go_crdt_playground_tpu", "serve",
-         "--port", "0"],
-        env=_scrubbed_cpu_env(1),  # never dial the TPU tunnel from CI
-        stdout=subprocess.PIPE, stderr=open(err_path, "w"), text=True)
+    with open(err_path, "w") as err_f:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "go_crdt_playground_tpu", "serve",
+             "--port", "0"],
+            env=_scrubbed_cpu_env(1),  # never dial the TPU tunnel from CI
+            cwd=REPO,  # the package is not pip-installed
+            stdout=subprocess.PIPE, stderr=err_f, text=True)
     try:
         lines: "queue.Queue[str]" = queue.Queue()
         threading.Thread(target=lambda: lines.put(proc.stdout.readline()),
