@@ -49,25 +49,35 @@ def _cmd_scenario() -> int:
     return 0 if ok else 1
 
 
-def _cmd_gossip(num_replicas: int) -> int:
+def _cmd_gossip(num_replicas: int, delta: bool = False,
+                drop_rate: float = 0.0) -> int:
     import numpy as np
 
     from go_crdt_playground_tpu.config import Config
-    from go_crdt_playground_tpu.models import awset
+    from go_crdt_playground_tpu.models import awset, awset_delta
     from go_crdt_playground_tpu.parallel import collectives, gossip
 
     cfg = Config(num_replicas=num_replicas, num_elements=128,
                  num_actors=num_replicas)
     R, E = cfg.num_replicas, cfg.num_elements
-    state = cfg.init_awset()
+    mod = awset_delta if delta else awset
+    state = cfg.init_awset_delta() if delta else cfg.init_awset()
     rng = np.random.default_rng(0)
     for r in range(R):             # every replica adds a private slice
-        state = awset.add_element(
+        state = mod.add_element(
             state, np.uint32(r), np.uint32(rng.integers(E)))
-    rounds, state = gossip.rounds_to_convergence(state)
+    key = None
+    if drop_rate > 0.0:
+        import jax
+
+        key = jax.random.key(0)
+    rounds, state = gossip.rounds_to_convergence(
+        state, key=key, drop_rate=drop_rate, delta=delta)
     digest = collectives.state_digest(state.present, state.vv)
-    print(f"{R} replicas converged in {rounds} dissemination rounds; "
-          f"digest={int(np.asarray(digest)[0]):#x}")
+    kind = "delta" if delta else "full-state"
+    drop = f" under {drop_rate:.0%} drop" if drop_rate > 0.0 else ""
+    print(f"{R} replicas ({kind} gossip{drop}) converged in {rounds} "
+          f"dissemination rounds; digest={int(np.asarray(digest)[0]):#x}")
     return 0
 
 
@@ -97,13 +107,26 @@ def main(argv=None) -> int:
     sub.add_parser("scenario")
     g = sub.add_parser("gossip")
     g.add_argument("--replicas", type=int, default=64)
+    g.add_argument("--delta", action="store_true",
+                   help="payload-compressed delta gossip (v2 semantics)")
+    def _rate(text: str) -> float:
+        v = float(text)
+        if not 0.0 <= v < 1.0:
+            raise argparse.ArgumentTypeError(
+                f"drop rate must be in [0, 1), got {v} (at 1.0 every "
+                "exchange is lost and the fleet can never converge)")
+        return v
+
+    g.add_argument("--drop-rate", type=_rate, default=0.0,
+                   help="per-replica exchange loss probability per round")
     s = sub.add_parser("serve")
     s.add_argument("--port", type=int, default=0)
     args = p.parse_args(argv)
     if args.cmd == "scenario":
         return _cmd_scenario()
     if args.cmd == "gossip":
-        return _cmd_gossip(args.replicas)
+        return _cmd_gossip(args.replicas, delta=args.delta,
+                           drop_rate=args.drop_rate)
     if args.cmd == "serve":
         return _cmd_serve(args.port)
     return 2

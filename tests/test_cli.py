@@ -26,8 +26,20 @@ def test_scenario_command_passes(capsys):
 def test_gossip_command_converges(capsys):
     assert main(["gossip", "--replicas", "8"]) == 0
     out = capsys.readouterr().out
-    assert re.search(r"8 replicas converged in \d+ dissemination rounds",
-                     out)
+    assert re.search(
+        r"8 replicas \(full-state gossip\) converged in \d+ "
+        r"dissemination rounds", out)
+
+
+def test_gossip_command_delta_with_drops_converges(capsys):
+    """The resilience story from the shell: delta semantics + lossy
+    exchanges still converge (SURVEY §5.3 — drops only delay)."""
+    assert main(["gossip", "--replicas", "8", "--delta",
+                 "--drop-rate", "0.3"]) == 0
+    out = capsys.readouterr().out
+    assert re.search(
+        r"8 replicas \(delta gossip under 30% drop\) converged in \d+ "
+        r"dissemination rounds", out)
 
 
 def test_serve_command_end_to_end(tmp_path):
@@ -80,3 +92,13 @@ def test_serve_command_end_to_end(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=10)
+
+
+def test_gossip_command_rejects_certain_loss():
+    """--drop-rate 1.0 can never converge; the parser fails fast with a
+    clean error instead of grinding the full round budget."""
+    import pytest
+
+    with pytest.raises(SystemExit) as exc:
+        main(["gossip", "--drop-rate", "1.0"])
+    assert exc.value.code == 2  # argparse usage error
