@@ -902,6 +902,24 @@ def _child_main():
     """The actual measurement, run inside a parent-supervised subprocess
     (it may initialize a flaky remote-TPU backend and hang or die; the
     parent owns the timeout and the driver-facing output contract)."""
+    if "--probe" in sys.argv:
+        # liveness probe: initialize the ambient backend and time ONE
+        # tiny dispatch.  Device listing alone is not enough — through
+        # the remote-TPU tunnel jax.devices() can succeed while every
+        # execution hangs, so the probe must run something.
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        platform = jax.devices()[0].platform
+        t1 = time.perf_counter()
+        float(jnp.ones((64, 64)).sum())
+        print(json.dumps({
+            "probe": platform,
+            "init_s": round(t1 - t0, 2),
+            "dispatch_s": round(time.perf_counter() - t1, 2),
+        }))
+        return
     if "--northstar" in sys.argv:
         run_northstar()
         return
@@ -934,7 +952,7 @@ def _child_main():
     }))
 
 
-def _run_child(env, timeout_s):
+def _run_child(env, timeout_s, argv=None):
     """One supervised measurement attempt.  Returns (ok, stdout, why)."""
     env = dict(env)
     env["CRDT_BENCH_CHILD"] = "1"
@@ -942,7 +960,8 @@ def _run_child(env, timeout_s):
         # cwd is inherited so artifacts (BENCH_LADDER.json) land in the
         # invoker's directory, exactly as the pre-supervisor bench did
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+            [sys.executable, os.path.abspath(__file__)]
+            + (sys.argv[1:] if argv is None else argv),
             env=env, timeout=timeout_s, capture_output=True, text=True)
     except subprocess.TimeoutExpired:
         return False, "", f"timeout after {timeout_s}s"
@@ -996,35 +1015,66 @@ def main():
     ladder = ("--ladder" in sys.argv or "--droprate" in sys.argv
               or "--northstar" in sys.argv or "--payload" in sys.argv)
     timeout_s = int(os.environ.get(
-        "CRDT_BENCH_TIMEOUT_S", "2700" if ladder else "900"))
-    max_attempts = int(os.environ.get("CRDT_BENCH_ATTEMPTS", "3"))
+        "CRDT_BENCH_TIMEOUT_S", "2700" if ladder else "300"))
+    max_attempts = int(os.environ.get("CRDT_BENCH_ATTEMPTS",
+                                      "3" if ladder else "1"))
+    probe_timeout_s = int(os.environ.get("CRDT_BENCH_PROBE_TIMEOUT_S",
+                                         "75"))
+    # Hard wall on the WHOLE supervisor (probe + attempts + fallback).
+    # The driver records whatever this process prints within ITS budget:
+    # round 4's worst case (2x900s ambient + 900s CPU fallback) blew
+    # through that budget and the round recorded rc=124 with no JSON at
+    # all.  Default-mode worst case is now 75s dead-probe + 300s ambient
+    # + 120s CPU fallback ~ 8 min; the dead-tunnel path is ~3 min.
+    # the default wall must scale with an operator-raised timeout (a
+    # raised CRDT_BENCH_TIMEOUT_S alone must not be silently clamped by
+    # a fixed wall), but never shrink below the 8-minute profile
     budget_s = int(os.environ.get(
-        "CRDT_BENCH_TOTAL_BUDGET_S", str(2 * timeout_s)))
+        "CRDT_BENCH_TOTAL_BUDGET_S",
+        str(2 * timeout_s) if ladder
+        else str(max(500, probe_timeout_s + timeout_s + 150))))
+    # default mode must reserve room for the CPU fallback child inside
+    # the wall; ladder modes salvage instantly so they reserve nothing
+    reserve_s = 0 if ladder else 130
     errors = []
+    t0 = time.monotonic()
+
+    def remaining():
+        return budget_s - (time.monotonic() - t0)
 
     # Retry the AMBIENT (TPU) backend with backoff before any fallback:
     # tunnel flakes are transient, and round 3 lost its entire TPU
     # evidence to a single 900s hang with no retry.  Retries are cheap
     # for --ladder/--droprate because children resume past every
-    # partial-persisted step.
-    t0 = time.monotonic()
+    # partial-persisted step.  EACH attempt is gated by a cheap liveness
+    # probe (initialize the backend, time one tiny dispatch): when the
+    # tunnel is dead even jax.devices() hangs, and discovering that must
+    # cost one probe_timeout per attempt, not a full measurement timeout
+    # (exactly how rounds 3/4 burned their driver budget).  The probe is
+    # per-attempt rather than once-up-front so a single transient flake
+    # in the probe window cannot void a whole ladder session.
     for attempt in range(1, max_attempts + 1):
-        ok, out, why = _run_child(os.environ, timeout_s)
-        if ok:
-            sys.stdout.write(out)
-            return
-        errors.append(f"attempt{attempt}({why})")
-        if "CRDT_BENCH_FATAL" in why:
-            # the child's own deterministic-failure sentinel (e.g. the
-            # ladder's conformance gate) — a retry re-measures
-            # everything and cannot succeed.  A unique sentinel, not
-            # bare "FATAL": library/driver abort text in the stderr
-            # tail must not suppress retries of transient flakes.
+        ok, _, why = _run_child(os.environ, probe_timeout_s, ["--probe"])
+        if not ok:
+            errors.append(f"probe{attempt}({why})")
+        else:
+            child_t = min(timeout_s,
+                          max(30, int(remaining()) - reserve_s))
+            ok, out, why = _run_child(os.environ, child_t)
+            if ok:
+                sys.stdout.write(out)
+                return
+            errors.append(f"attempt{attempt}({why})")
+            if "CRDT_BENCH_FATAL" in why:
+                # the child's own deterministic-failure sentinel (e.g.
+                # the ladder's conformance gate) — a retry re-measures
+                # everything and cannot succeed.  A unique sentinel, not
+                # bare "FATAL": library/driver abort text in the stderr
+                # tail must not suppress retries of transient flakes.
+                break
+        if attempt >= max_attempts or remaining() < reserve_s + 45:
             break
-        elapsed = time.monotonic() - t0
-        if attempt >= max_attempts or (attempt >= 2 and elapsed > budget_s):
-            break
-        time.sleep(15 * attempt)
+        time.sleep(max(0, min(15 * attempt, remaining() - reserve_s - 30)))
 
     # salvage: completed ladder/droprate steps from this session are real
     # measurements — emit them as an explicitly-incomplete artifact
@@ -1086,10 +1136,14 @@ def main():
     if not ladder:
         # CPU fallback keeps the round's artifact parseable and honest:
         # the platform field says "cpu", vs_baseline stays the same
-        # single-core spec yardstick.
+        # single-core spec yardstick.  The whole CPU path measures in
+        # ~15s; the cap exists only to keep a pathological host inside
+        # the supervisor wall.
         from __graft_entry__ import _scrubbed_cpu_env
 
-        ok, out, why = _run_child(_scrubbed_cpu_env(1), timeout_s)
+        cpu_t = min(int(os.environ.get("CRDT_BENCH_CPU_TIMEOUT_S", "120")),
+                    max(45, int(remaining())))
+        ok, out, why = _run_child(_scrubbed_cpu_env(1), cpu_t)
         if ok:
             lines = [ln for ln in out.splitlines() if ln.strip()]
             rec = json.loads(lines[-1])

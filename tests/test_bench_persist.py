@@ -109,6 +109,29 @@ def test_partial_lines_are_json(tmp_path):
     assert rec["_step"] == "drop0.1"
 
 
+def test_probe_child_prints_json(tmp_path):
+    """The supervisor's liveness probe (bench.py --probe) must print one
+    JSON line naming the backend it reached and exit 0 — on a CPU-pinned
+    env here; the driver path runs it against the ambient TPU tunnel
+    before committing to any full-length measurement attempt."""
+    import subprocess
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from __graft_entry__ import _scrubbed_cpu_env
+
+    env = dict(_scrubbed_cpu_env(1), CRDT_BENCH_CHILD="1")
+    proc = subprocess.run(
+        [sys.executable,
+         str(Path(bench.__file__).resolve()), "--probe"],
+        env=env, timeout=120, capture_output=True, text=True,
+        cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr
+    rec = json.loads(proc.stdout.strip())
+    assert rec["probe"] == "cpu"
+    assert rec["dispatch_s"] >= 0.0
+    assert not list(tmp_path.iterdir())  # probe writes no artifacts
+
+
 def test_time_drop_round_compiles_and_runs():
     """The droprate capture's on-chip timing program must compile and
     execute on CPU CI: it only ever ran under on_tpu before, so a break
