@@ -50,7 +50,7 @@ def _cmd_scenario() -> int:
 
 
 def _cmd_gossip(num_replicas: int, delta: bool = False,
-                drop_rate: float = 0.0) -> int:
+                drop_rate: float = 0.0, seed: int = 0) -> int:
     import numpy as np
 
     from go_crdt_playground_tpu.config import Config
@@ -70,7 +70,7 @@ def _cmd_gossip(num_replicas: int, delta: bool = False,
     if drop_rate > 0.0:
         import jax
 
-        key = jax.random.key(0)
+        key = jax.random.key(seed)
     rounds, state = gossip.rounds_to_convergence(
         state, key=key, drop_rate=drop_rate, delta=delta)
     digest = collectives.state_digest(state.present, state.vv)
@@ -119,6 +119,9 @@ def main(argv=None) -> int:
 
     g.add_argument("--drop-rate", type=_rate, default=0.0,
                    help="per-replica exchange loss probability per round")
+    g.add_argument("--seed", type=int, default=0,
+                   help="PRNG seed for the drop mask (each seed samples "
+                        "an independent loss realization)")
     s = sub.add_parser("serve")
     s.add_argument("--port", type=int, default=0)
     args = p.parse_args(argv)
@@ -126,7 +129,7 @@ def main(argv=None) -> int:
         return _cmd_scenario()
     if args.cmd == "gossip":
         return _cmd_gossip(args.replicas, delta=args.delta,
-                           drop_rate=args.drop_rate)
+                           drop_rate=args.drop_rate, seed=args.seed)
     if args.cmd == "serve":
         return _cmd_serve(args.port)
     return 2

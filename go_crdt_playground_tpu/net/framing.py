@@ -51,7 +51,20 @@ def _recv_exact(sock: socket.socket, n: int,
     the WHOLE read must finish by then: the per-recv socket timeout is
     re-derived from the remaining budget each iteration, so a peer
     trickling one byte per timeout window cannot hold the read open
-    indefinitely the way a bare settimeout allows."""
+    indefinitely the way a bare settimeout allows.  The socket's own
+    timeout configuration is restored on exit (success or raise), so
+    the deadline never leaks onto the socket for later callers."""
+    if deadline is None:
+        return _recv_exact_inner(sock, n, None)
+    saved = sock.gettimeout()
+    try:
+        return _recv_exact_inner(sock, n, deadline)
+    finally:
+        sock.settimeout(saved)
+
+
+def _recv_exact_inner(sock: socket.socket, n: int,
+                      deadline: Optional[float]) -> bytes:
     chunks = []
     while n:
         if deadline is not None:

@@ -71,6 +71,7 @@ class Node:
                  delta_semantics: str = "v2",
                  strict_reference_semantics: bool = True,
                  recorder=None, conn_timeout_s: Optional[float] = None,
+                 hello_timeout_s: Optional[float] = None,
                  max_conns: Optional[int] = None):
         """recorder: optional obs.Recorder; when given, every exchange
         counts sync.exchanges / sync.bytes_sent / sync.bytes_received /
@@ -94,7 +95,13 @@ class Node:
         self._closing = False
         self.conn_timeout_s = (self.CONN_TIMEOUT_S if conn_timeout_s is None
                                else conn_timeout_s)
-        self.hello_timeout_s = min(self.HELLO_TIMEOUT_S, self.conn_timeout_s)
+        # tunable for slow-but-legitimate WAN dialers; still clamped by
+        # conn_timeout_s so the HELLO deadline can never exceed the
+        # payload deadline it exists to undercut
+        self.hello_timeout_s = min(
+            self.HELLO_TIMEOUT_S if hello_timeout_s is None
+            else hello_timeout_s,
+            self.conn_timeout_s)
         self._conn_slots = threading.BoundedSemaphore(
             self.MAX_CONNS if max_conns is None else max_conns)
 

@@ -806,6 +806,16 @@ def packed_block_ring_round_shardmap(state, mesh: Mesh, offset):
     if R % n:
         raise ValueError(f"R={R} not divisible by replica mesh dim {n}")
     blk = R // n
+    from go_crdt_playground_tpu.ops.pallas_merge import ring_supported
+    if not ring_supported(2 * blk):
+        # the kernel runs on the stacked [local; recv] 2*blk block, so
+        # the per-device block itself must satisfy the ring kernel's
+        # whole-aligned-blocks layout; failing here beats a
+        # kernel-internal layout assert (or a silently odd tiling)
+        raise ValueError(
+            f"per-device block {blk} (R={R} / {n} devices) stacks to a "
+            f"{2 * blk}-row kernel block, which the packed ring kernel "
+            "cannot tile (needs a multiple of 64 rows, at least 128)")
     offset = int(offset) % R
     if offset == 0:
         raise ValueError("offset 0 is a no-op round")

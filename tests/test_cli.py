@@ -102,3 +102,12 @@ def test_gossip_command_rejects_certain_loss():
     with pytest.raises(SystemExit) as exc:
         main(["gossip", "--drop-rate", "1.0"])
     assert exc.value.code == 2  # argparse usage error
+
+
+def test_gossip_command_seed_flag(capsys):
+    """--seed feeds the drop-mask PRNG so shell users can sample
+    independent loss realizations (ADVICE r4); every seed still
+    converges (drops only delay convergence, SURVEY §5.3)."""
+    assert main(["gossip", "--replicas", "8", "--drop-rate", "0.3",
+                 "--seed", "7"]) == 0
+    assert "converged in" in capsys.readouterr().out

@@ -452,3 +452,19 @@ def test_packed_block_ring_shardmap_bitwise_and_converges():
     unpacked = packed_mod.unpack_awset_delta(
         jax.tree.map(np.asarray, st), E)
     assert bool(collectives.converged(unpacked.present, unpacked.vv))
+
+
+def test_packed_block_ring_shardmap_rejects_untileable_block():
+    """An R/mesh combo whose per-device block stacks below the packed
+    ring kernel's tiling must fail at the API boundary with a clear
+    error, not inside kernel layout asserts (ADVICE r4)."""
+    from go_crdt_playground_tpu.models import packed as packed_mod
+
+    n = 8
+    R, E, A = n * 8, 96, 64  # blk=8 -> stacked block 16 rows: untileable
+    state = awset_delta.init(R, E, A)
+    packed = packed_mod.pack_awset_delta(state)
+    m = mesh_mod.make_mesh((n, 1))
+    sharded = mesh_mod.shard_state(packed, m)
+    with pytest.raises(ValueError, match="stacks to a 16-row"):
+        gossip.packed_block_ring_round_shardmap(sharded, m, 8)

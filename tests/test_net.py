@@ -339,8 +339,8 @@ def test_half_open_dial_releases_slot_at_hello_deadline():
     conn_timeout_s (ADVICE r3)."""
     import socket as socket_mod
 
-    server = Node(0, E, A, max_conns=1, conn_timeout_s=30.0)
-    server.hello_timeout_s = 0.5
+    server = Node(0, E, A, max_conns=1, conn_timeout_s=30.0,
+                  hello_timeout_s=0.5)
     with server:
         addr = server.serve()
         hog = socket_mod.create_connection(addr, timeout=5.0)
@@ -372,8 +372,8 @@ def test_trickling_dial_releases_slot_at_hello_deadline():
 
     import threading
 
-    server = Node(0, E, A, max_conns=1, conn_timeout_s=30.0)
-    server.hello_timeout_s = 0.5
+    server = Node(0, E, A, max_conns=1, conn_timeout_s=30.0,
+                  hello_timeout_s=0.5)
     with server:
         addr = server.serve()
         hog = socket_mod.create_connection(addr, timeout=5.0)
@@ -409,3 +409,36 @@ def test_trickling_dial_releases_slot_at_hello_deadline():
         finally:
             stop.set()
             hog.close()
+
+
+def test_hello_timeout_ctor_param_clamped():
+    """hello_timeout_s is a constructor parameter (not an attribute to
+    poke) and can never exceed conn_timeout_s — the HELLO deadline
+    exists to undercut the payload deadline (ADVICE r4)."""
+    n = Node(0, E, A, hello_timeout_s=7.0, conn_timeout_s=3.0)
+    assert n.hello_timeout_s == 3.0
+    n = Node(0, E, A, hello_timeout_s=0.25)
+    assert n.hello_timeout_s == 0.25
+    assert Node(0, E, A).hello_timeout_s == Node.HELLO_TIMEOUT_S
+
+
+def test_recv_exact_restores_socket_timeout():
+    """A deadline passed to _recv_exact mutates the socket timeout per
+    recv; the restore must live in _recv_exact itself so DIRECT callers
+    (not just recv_frame) cannot leak a shortened timeout onto the
+    socket (ADVICE r4)."""
+    import socket as socket_mod
+
+    a, b = socket_mod.socketpair()
+    try:
+        a.settimeout(12.5)
+        b.sendall(b"xyz")
+        assert framing._recv_exact(a, 3, time.monotonic() + 5.0) == b"xyz"
+        assert a.gettimeout() == 12.5
+        # the raising path restores too
+        with pytest.raises(socket_mod.timeout):
+            framing._recv_exact(a, 1, time.monotonic() - 1.0)
+        assert a.gettimeout() == 12.5
+    finally:
+        a.close()
+        b.close()
