@@ -149,3 +149,26 @@ def test_time_drop_round_compiles_and_runs():
                                            start=4, min_delta=1e-4,
                                            repeats=1)
         assert per_round > 0.0
+
+
+def test_northstar_ici_model_math():
+    """The v5e-4 projection must be a traffic model, not linear scaling
+    (VERDICT r4 weakness #3): block-aligned dissemination offsets ship
+    whole packed blocks over the ring cut; intra-block offsets are free.
+    Pins the arithmetic at the north-star shape."""
+    m = bench.northstar_ici_model(1.2, 1 << 20, 256, 256, n_chips=4)
+    # PackedAWSetDeltaState row: vv+processed (2*256*4) + 4 dot arrays
+    # (4*256*4) + 2 bitpacked membership rows (2*32) + actor (4)
+    assert m["packed_row_bytes"] == 2 * 256 * 4 + 4 * 256 * 4 + 64 + 4
+    # 20 offsets, blk=2^18: only 2^18 (1 hop) and 2^19 (2 hops) cross
+    assert [c["offset"] for c in m["crossing_rounds"]] == [1 << 18, 1 << 19]
+    assert [c["ring_hops"] for c in m["crossing_rounds"]] == [1, 2]
+    assert m["ici_link_bytes"] == (1 << 18) * m["packed_row_bytes"] * 3
+    assert m["compute_s"] == 0.3
+    assert m["ici_s"] == round(m["ici_link_bytes"] / 45e9, 4)
+    assert m["model_s"] == max(m["compute_s"], m["ici_s"])
+    assert m["serialized_bound_s"] == round(m["compute_s"] + m["ici_s"], 4)
+    # ICI-bound regime: with 64 chips compute shrinks and the ring cut
+    # dominates, so the model must NOT report the linear number
+    m64 = bench.northstar_ici_model(1.2, 1 << 20, 256, 256, n_chips=64)
+    assert m64["model_s"] == m64["ici_s"] > m64["compute_s"]
