@@ -89,10 +89,29 @@ def _auto_kernel(state, delta_semantics: Optional[str] = None,
     ops/pallas_merge.py regime notes)."""
     from go_crdt_playground_tpu.ops.pallas_merge import MAX_FUSED_ACTORS
 
+    fusible = (state.vv.shape[-1] <= MAX_FUSED_ACTORS
+               and delta_semantics in (None, "v2", "reference"))
     ok = (jax.default_backend() == "tpu"
           and (not single_device or jax.device_count() == 1)
-          and state.vv.shape[-1] <= MAX_FUSED_ACTORS
-          and delta_semantics in (None, "v2", "reference"))
+          and fusible)
+    if (not ok and fusible and single_device
+            and jax.default_backend() == "tpu"
+            and jax.device_count() > 1):
+        # the ONLY reason this fleet fell off the fused path is the
+        # multi-device process: a bare pallas_call has no GSPMD
+        # partitioning rule under an arbitrary perm, and the XLA HasDot
+        # gather lowers pathologically on TPU (~40x, see
+        # ops/pallas_merge.py regime notes).  Don't let users pay that
+        # silently — the mesh-native rounds keep the fused kernel.
+        import warnings
+
+        warnings.warn(
+            "multi-device TPU process: this gossip round is running the "
+            "XLA gather path (~40x slower than the fused kernel on TPU). "
+            "Use ring_round_shardmap / delta-ring or "
+            "butterfly_round_shardmap for mesh schedules, or pass "
+            "kernel='xla' to acknowledge the slow path.",
+            stacklevel=3)
     return "pallas" if ok else "xla"
 
 
@@ -723,6 +742,94 @@ def ring_round_shardmap(state: AWSetState, mesh: Mesh,
     if kernel == "auto":
         kernel = _auto_kernel(state, single_device=False)
     return _ring_step_compiled(mesh, type(state), kernel)(state)
+
+
+@functools.lru_cache(maxsize=None)
+def _butterfly_step_compiled(mesh: Mesh, state_cls, stage: int,
+                             kernel: str):
+    """Cached jitted shard_map butterfly stage per (mesh, state type,
+    stage, kernel).
+
+    The XOR pairing decomposes cleanly over a power-of-two block layout
+    (global row r = d*blk + i):
+
+      * 2^stage <  blk — block-LOCAL: i ^ 2^stage stays inside the
+        block, so the stage is a per-shard permuted merge with zero
+        communication (the fused multi-row kernel per shard on TPU);
+      * 2^stage >= blk — device-pair swap: partner row is the SAME
+        intra index on device d ^ (2^stage/blk), so the stage is one
+        symmetric ppermute of whole blocks + the pairwise-rows merge.
+    """
+    n = mesh.shape[REPLICA_AXIS]
+    s = 1 << stage
+    specs = partition_specs(state_cls)
+
+    def step(local):
+        blk = local.vv.shape[0]
+        if s < blk:
+            local_perm = (jnp.arange(blk, dtype=jnp.uint32)
+                          ^ jnp.uint32(s))
+            if kernel == "pallas":
+                from go_crdt_playground_tpu.ops.pallas_merge import (
+                    pallas_gossip_round_rows)
+
+                return pallas_gossip_round_rows(local, local_perm)
+            src = jax.tree.map(lambda x: x[local_perm], local)
+            merged, _ = merge_pairwise(local, src)
+            return merged
+        pairs = [(d, d ^ (s // blk)) for d in range(n)]
+        recv = jax.tree.map(
+            lambda x: jax.lax.ppermute(x, REPLICA_AXIS, pairs), local)
+        if kernel == "pallas":
+            from go_crdt_playground_tpu.ops.pallas_merge import (
+                pallas_merge_pairwise_rows)
+
+            return pallas_merge_pairwise_rows(local, recv)
+        merged, _ = merge_pairwise(local, recv)
+        return merged
+
+    return jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs,
+                      check_vma=(kernel != "pallas"))
+    )
+
+
+def butterfly_round_shardmap(state: AWSetState, mesh: Mesh, stage: int,
+                             kernel: str = "auto") -> AWSetState:
+    """One butterfly stage (partner = r XOR 2^stage, SURVEY §5.7c) with
+    the replica axis explicitly sharded — the mesh-native realization of
+    butterfly_perm, bitwise-identical to ``gossip_round(state,
+    butterfly_perm(R, stage))``.
+
+    Stages below the per-device block size are block-local (zero ICI);
+    stages at or above it are one whole-block ppermute between XOR
+    device pairs.  Either way the merge runs the fused kernel per shard
+    on TPU meshes, so butterfly schedules never pay the multi-device
+    XLA HasDot penalty that _auto_kernel warns about.
+
+    Full-state AWSet family only (same restriction as
+    ring_round_shardmap: the merge kernel has no cross-element
+    reductions, so element-sharded blocks are self-contained).
+    """
+    R = state.vv.shape[0]
+    n = mesh.shape[REPLICA_AXIS]
+    if R & (R - 1):
+        raise ValueError(f"butterfly needs a power-of-two replica count "
+                         f"(R={R})")
+    if R % n:
+        raise ValueError(f"R={R} not divisible by replica mesh dim {n}")
+    blk = R // n
+    if blk & (blk - 1):
+        raise ValueError(
+            f"per-device block {blk} must be a power of two for the XOR "
+            "pairing to decompose into block-local and block-swap stages")
+    if not 0 <= stage or (1 << stage) >= R:
+        raise ValueError(
+            f"butterfly stage {stage} out of range for R={R} "
+            "(need 1 << stage < R)")
+    if kernel == "auto":
+        kernel = _auto_kernel(state, single_device=False)
+    return _butterfly_step_compiled(mesh, type(state), stage, kernel)(state)
 
 
 # ---------------------------------------------------------------------------
