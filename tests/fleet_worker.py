@@ -1,0 +1,85 @@
+"""One process of the host-fleet soak test (tests/test_fleet_soak.py).
+
+Runs a single networked replica (net.peer.Node) through a lossy-fleet
+anti-entropy schedule: phase 1 syncs through the parent's lossy proxies
+(drops surface as socket errors — anti-entropy self-heals, SURVEY §5.3),
+phase 2 sweeps every peer directly so the final digests must agree.
+
+Protocol on stdio (parent = tests/test_fleet_soak.py):
+  -> "PORT <p>"            after the node's server is up
+  <- "ADDRS <2n ports>"    n proxy ports then n direct ports
+  -> "PHASE1"              after the lossy sweeps
+  <- "PHASE2"              all workers finished phase 1
+  -> "PHASE2DONE"          after the clean all-pairs sweep
+  <- "REPORT"              all workers finished phase 2 (no sync can
+                           mutate state after this point)
+  -> one JSON line {"members": [...], "vv": [...]}
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    idx, n, num_elements = (int(a) for a in sys.argv[1:4])
+    from go_crdt_playground_tpu.net import Node
+
+    node = Node(idx, num_elements, n, conn_timeout_s=5.0)
+    node.add(*range(idx * 4, idx * 4 + 4))  # private element slice
+    _, port = node.serve()
+    print(f"PORT {port}", flush=True)
+
+    parts = sys.stdin.readline().split()
+    assert parts[0] == "ADDRS", parts
+    ports = [int(p) for p in parts[1:]]
+    proxy, direct = ports[:n], ports[n:]
+
+    rng = random.Random(1000 + idx)
+    lost = 0
+    for _sweep in range(4):
+        order = [j for j in range(n) if j != idx]
+        rng.shuffle(order)  # reordering: every sweep hits peers anew
+        for j in order:
+            # duplication: a repeated exchange must be idempotent
+            dials = 2 if rng.random() < 0.15 else 1
+            for _ in range(dials):
+                try:
+                    node.sync_with(("127.0.0.1", proxy[j]), timeout=4.0)
+                except Exception:
+                    lost += 1  # a lost exchange, never lost data
+    print("PHASE1", flush=True)
+    assert sys.stdin.readline().strip() == "PHASE2"
+
+    # clean direct sweep: after every pair exchanged at least once
+    # post-quiescence, all replicas hold the global union
+    for j in range(n):
+        if j == idx:
+            continue
+        for _attempt in range(40):
+            try:
+                node.sync_with(("127.0.0.1", direct[j]), timeout=4.0)
+                break
+            except Exception:
+                time.sleep(0.1)
+        else:
+            print(f"FAIL unreachable {j}", flush=True)
+            return 1
+    print("PHASE2DONE", flush=True)
+    assert sys.stdin.readline().strip() == "REPORT"
+    print(json.dumps({
+        "members": [int(e) for e in node.members()],
+        "vv": [int(v) for v in node.vv()],
+        "lost": lost,
+    }), flush=True)
+    node.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
