@@ -1,4 +1,4 @@
-"""Packaging + native build hook.
+"""Native build hook (project metadata lives in pyproject.toml).
 
 The Python path needs no build step.  ``python setup.py build_ext
 --inplace`` compiles the optional C++ host codec
@@ -7,7 +7,7 @@ same artifact the package would otherwise build lazily on first use via
 go_crdt_playground_tpu.native.load().
 """
 
-from setuptools import Command, find_packages, setup
+from setuptools import Command, setup
 
 
 class BuildNativeCodec(Command):
@@ -34,15 +34,4 @@ class BuildNativeCodec(Command):
             print(f"native codec built: {native._lib_path()}")
 
 
-setup(
-    name="go_crdt_playground_tpu",
-    version="0.1.0",
-    description="TPU-native CRDT framework (JAX/XLA/Pallas)",
-    packages=find_packages(include=["go_crdt_playground_tpu*"]),
-    package_data={
-        "go_crdt_playground_tpu.native": ["codec.cpp"],
-        "go_crdt_playground_tpu.bridge": ["merger.proto"],
-    },
-    python_requires=">=3.10",
-    cmdclass={"build_ext": BuildNativeCodec},
-)
+setup(cmdclass={"build_ext": BuildNativeCodec})
