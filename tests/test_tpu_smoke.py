@@ -215,3 +215,22 @@ def test_ormap_ring_round_mosaic():
                                      kernel="xla")
     got = gossip.ormap_ring_gossip_round(st, 3)
     _assert_equal(want, got)
+
+
+def test_butterfly_shardmap_single_chip_mosaic():
+    """butterfly_round_shardmap's per-shard fused kernel under shard_map
+    must Mosaic-compile on the real chip.  On one device every XOR stage
+    is block-local (blk = R), so this proves the local-stage lowering —
+    the device-swap stages are pure ppermute + the pairwise kernel
+    already proven by the ring smoke."""
+    from go_crdt_playground_tpu.parallel import mesh as mesh_mod
+
+    state = _merge_state(9)
+    m = mesh_mod.make_mesh((1, 1))
+    sharded = mesh_mod.shard_state(state, m)
+    for stage in (0, 6):
+        want = gossip.gossip_round(
+            state, gossip.butterfly_perm(R, stage), kernel="xla")
+        got = gossip.butterfly_round_shardmap(sharded, m, stage,
+                                              kernel="pallas")
+        _assert_equal(want, got)
