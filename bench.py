@@ -187,17 +187,22 @@ def _scan_round_rate(round_fn, state, aux, start=16, max_n=1 << 17,
     timed repeat 16% slow (allocator/page churn on a fresh 2x1M-replica
     working set), the exact contamination BASELINE.md honesty rule 2
     documents."""
-    import functools
-
     import jax
     import jax.numpy as jnp
 
-    @functools.partial(jax.jit, static_argnames=("n",))
+    n_aux = jax.tree.leaves(aux)[0].shape[0]
+
+    @jax.jit
     def run(state, n):
-        def body(s, i):
-            return round_fn(s, jax.tree.map(lambda x: x[i], aux)), None
-        s, _ = jax.lax.scan(
-            body, state, jnp.arange(n) % jax.tree.leaves(aux)[0].shape[0])
+        # DYNAMIC trip count: the adaptive doubling search visits many
+        # round counts, and a static-length scan would recompile at
+        # every doubling — ~15-20s per compile through the remote-TPU
+        # tunnel, the dominant cost of a live ladder capture.  One
+        # fori_loop program serves every count (loop overhead is
+        # negligible against ms-scale rounds).
+        def body(i, s):
+            return round_fn(s, jax.tree.map(lambda x: x[i % n_aux], aux))
+        s = jax.lax.fori_loop(jnp.uint32(0), n, body, state)
         # the sync scalar MUST read every output leaf: the VV join chain
         # depends only on vv, so a vv-only fetch lets XLA dead-code the
         # entire membership/dot merge and the "measurement" collapses to
@@ -209,11 +214,11 @@ def _scan_round_rate(round_fn, state, aux, start=16, max_n=1 << 17,
     def timed(n):
         if n not in memo:  # each doubling reuses the previous full count
             for _ in range(max(1, warm_runs)):
-                float(run(state, n))
+                float(run(state, jnp.uint32(n)))
             times = []
             for _ in range(repeats):
                 t0 = time.perf_counter()
-                float(run(state, n))
+                float(run(state, jnp.uint32(n)))
                 times.append(time.perf_counter() - t0)
             memo[n] = times
         return min(memo[n])
