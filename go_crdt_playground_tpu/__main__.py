@@ -50,7 +50,8 @@ def _cmd_scenario() -> int:
 
 
 def _cmd_gossip(num_replicas: int, delta: bool = False,
-                drop_rate: float = 0.0, seed: int = 0) -> int:
+                drop_rate: float = 0.0, seed: int = 0,
+                schedule: str = "dissemination") -> int:
     import numpy as np
 
     from go_crdt_playground_tpu.config import Config
@@ -67,17 +68,18 @@ def _cmd_gossip(num_replicas: int, delta: bool = False,
         state = mod.add_element(
             state, np.uint32(r), np.uint32(rng.integers(E)))
     key = None
-    if drop_rate > 0.0:
+    if drop_rate > 0.0 or schedule == "random":
         import jax
 
         key = jax.random.key(seed)
     rounds, state = gossip.rounds_to_convergence(
-        state, key=key, drop_rate=drop_rate, delta=delta)
+        state, key=key, drop_rate=drop_rate, delta=delta,
+        schedule=schedule)
     digest = collectives.state_digest(state.present, state.vv)
     kind = "delta" if delta else "full-state"
     drop = f" under {drop_rate:.0%} drop" if drop_rate > 0.0 else ""
     print(f"{R} replicas ({kind} gossip{drop}) converged in {rounds} "
-          f"dissemination rounds; digest={int(np.asarray(digest)[0]):#x}")
+          f"{schedule} rounds; digest={int(np.asarray(digest)[0]):#x}")
     return 0
 
 
@@ -120,8 +122,11 @@ def main(argv=None) -> int:
     g.add_argument("--drop-rate", type=_rate, default=0.0,
                    help="per-replica exchange loss probability per round")
     g.add_argument("--seed", type=int, default=0,
-                   help="PRNG seed for the drop mask (each seed samples "
-                        "an independent loss realization)")
+                   help="PRNG seed for the drop mask / random schedule "
+                        "(each seed samples an independent realization)")
+    g.add_argument("--schedule", default="dissemination",
+                   choices=("dissemination", "ring", "random"),
+                   help="anti-entropy pairing schedule per round")
     s = sub.add_parser("serve")
     s.add_argument("--port", type=int, default=0)
     args = p.parse_args(argv)
@@ -129,7 +134,8 @@ def main(argv=None) -> int:
         return _cmd_scenario()
     if args.cmd == "gossip":
         return _cmd_gossip(args.replicas, delta=args.delta,
-                           drop_rate=args.drop_rate, seed=args.seed)
+                           drop_rate=args.drop_rate, seed=args.seed,
+                           schedule=args.schedule)
     if args.cmd == "serve":
         return _cmd_serve(args.port)
     return 2

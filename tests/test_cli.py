@@ -111,3 +111,13 @@ def test_gossip_command_seed_flag(capsys):
     assert main(["gossip", "--replicas", "8", "--drop-rate", "0.3",
                  "--seed", "7"]) == 0
     assert "converged in" in capsys.readouterr().out
+
+
+def test_gossip_command_schedule_flag(capsys):
+    """--schedule exposes the library's pairing schedules from the
+    shell; the random schedule derives its pairings from --seed."""
+    assert main(["gossip", "--replicas", "8",
+                 "--schedule", "random", "--seed", "5"]) == 0
+    assert "random rounds" in capsys.readouterr().out
+    assert main(["gossip", "--replicas", "8", "--schedule", "ring"]) == 0
+    assert "ring rounds" in capsys.readouterr().out
