@@ -125,7 +125,7 @@ def main(argv=None) -> int:
                    help="PRNG seed for the drop mask / random schedule "
                         "(each seed samples an independent realization)")
     g.add_argument("--schedule", default="dissemination",
-                   choices=("dissemination", "ring", "random"),
+                   choices=("dissemination", "ring", "random", "butterfly"),
                    help="anti-entropy pairing schedule per round")
     s = sub.add_parser("serve")
     s.add_argument("--port", type=int, default=0)

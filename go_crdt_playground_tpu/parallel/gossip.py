@@ -533,6 +533,14 @@ def _advance_program(delta: bool, schedule: str, delta_semantics: str,
             if schedule == "random":
                 perm = random_perm(jax.random.fold_in(key, 2 * rnd), R)
                 return round_fn(c, perm, drop, **kw), None
+            if schedule == "butterfly":
+                # stages cycle 0..log2(R)-1; the m distinct XOR stages
+                # are hypercube dissemination — all-pairs in exactly m
+                # rounds (R power-of-two, validated by the caller)
+                stage = rnd % jnp.uint32(R.bit_length() - 1)
+                perm = (jnp.arange(R, dtype=jnp.uint32)
+                        ^ (jnp.uint32(1) << stage))
+                return round_fn(c, perm, drop, **kw), None
             off = (jnp.uint32(1) if schedule == "ring"
                    else offsets_arr[rnd % offsets_arr.shape[0]])
             return ring_fn(c, off, drop, **kw), None
@@ -581,10 +589,14 @@ def rounds_to_convergence(
     """
     R = state.vv.shape[0]
     offsets = dissemination_offsets(R) or [1]
-    if schedule not in ("dissemination", "ring", "random"):
+    if schedule not in ("dissemination", "ring", "random", "butterfly"):
         raise ValueError(f"unknown schedule {schedule!r}")
     if schedule == "random" and key is None:
         raise ValueError("random schedule requires a key")
+    if schedule == "butterfly" and R & (R - 1):
+        raise ValueError(
+            f"butterfly schedule needs a power-of-two replica count "
+            f"(R={R})")
     if drop_rate > 0.0 and key is None:
         raise ValueError("drop_rate requires a key")
     offsets_arr = jnp.asarray(offsets, jnp.uint32)

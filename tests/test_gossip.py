@@ -527,3 +527,18 @@ def test_multi_device_tpu_slow_path_warns(monkeypatch):
     with warnings_mod.catch_warnings():
         warnings_mod.simplefilter("error")
         gossip.gossip_round(state, perm, kernel="xla")
+
+
+def test_butterfly_schedule_converges_in_exactly_log2_rounds():
+    """The butterfly schedule's m distinct XOR stages are hypercube
+    dissemination: a divergent power-of-two fleet converges in exactly
+    ceil(log2 R) rounds — the tight bound, not just <= with slack."""
+    import random
+    rng = random.Random(53)
+    state = _random_state(rng, R=16, E=32, A=16)
+    rounds, out = gossip.rounds_to_convergence(state, schedule="butterfly")
+    assert bool(collectives.converged(out.present, out.vv))
+    assert rounds == 4
+    with pytest.raises(ValueError, match="power-of-two"):
+        gossip.rounds_to_convergence(
+            _random_state(rng, R=12, A=12), schedule="butterfly")
