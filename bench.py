@@ -382,6 +382,35 @@ def measure_config4_reference(num_replicas=100_032, num_elements=256,
         delta_semantics="reference", strict_reference_semantics=True)
 
 
+def measure_config3_dotpacked(num_replicas=10_048, num_elements=256,
+                              num_writers=256):
+    """config3's fleet on the DOT-WORD layout (models/packed
+    .DotPackedAWSetState): dots fused to one uint32/element + bitpacked
+    membership, ~1.6x less HBM per ring round than the bool layout —
+    the committed evidence for the layout's traffic win (round 5)."""
+    from go_crdt_playground_tpu.models import packed as packed_mod
+    from go_crdt_playground_tpu.ops.pallas_merge import (
+        pallas_ring_round_rows_dotpacked)
+    from go_crdt_playground_tpu.parallel import gossip
+
+    import jax.numpy as jnp
+
+    state = packed_mod.pack_awset_dots(
+        build_state(num_replicas, num_elements, num_writers))
+    offsets = jnp.asarray(gossip.dissemination_offsets(num_replicas),
+                          jnp.uint32)
+    meas = _scan_round_rate(pallas_ring_round_rows_dotpacked, state,
+                            offsets, start=64, full=True)
+    return {
+        "metric": f"config3_dotpacked: AWSet {num_replicas} x "
+                  f"{num_elements} ring merge, dot-word + bitpacked "
+                  "membership layout",
+        "value": round(num_replicas / meas.per_round_s, 1),
+        "unit": "merges/sec/chip",
+        **meas.stats(num_replicas),
+    }
+
+
 def measure_config5(num_replicas=1_000_000, num_elements=256,
                     num_writers=256):
     """Mixed AWSet + 2P-Set at 1M replicas: one anti-entropy round of
@@ -981,7 +1010,9 @@ def run_ladder():
         }
 
     steps = [("config1", measure_config1), ("config2", measure_config2),
-             ("config3", config3), ("config4", measure_config4),
+             ("config3", config3),
+             ("config3_dotpacked", measure_config3_dotpacked),
+             ("config4", measure_config4),
              ("config4ref", measure_config4_reference),
              ("config5", measure_config5),
              ("config5_awset", measure_config5_awset)]

@@ -20,7 +20,9 @@ import jax.numpy as jnp
 
 from go_crdt_playground_tpu.models.awset import AWSetState
 from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
-from go_crdt_playground_tpu.ops.pallas_merge import pack_bits, unpack_bits
+from go_crdt_playground_tpu.ops.pallas_merge import (
+    _DOT_CMASK, _DOT_SHIFT, DOT_MAX_ACTORS, DOT_MAX_COUNTER, pack_bits,
+    unpack_bits)
 
 
 class PackedAWSetState(NamedTuple):
@@ -55,6 +57,53 @@ def unpack_awset(packed: PackedAWSetState, num_elements: int) -> AWSetState:
         vv=packed.vv,
         present=unpack_bits(packed.present_bits, num_elements),
         dot_actor=packed.dot_actor, dot_counter=packed.dot_counter,
+        actor=packed.actor)
+
+
+class DotPackedAWSetState(NamedTuple):
+    """Bitpacked membership AND dot-word layout: each element's (actor,
+    counter) dot lives in ONE uint32 ((actor << 20) | counter), so a
+    ring round streams one E-shaped array where the bool layout streams
+    two.  Opt-in: counters are capped at DOT_MAX_COUNTER (~1M adds per
+    actor — pack_awset_dots guards), actors at DOT_MAX_ACTORS (4096,
+    above MAX_FUSED_ACTORS)."""
+
+    vv: jnp.ndarray            # uint32[R, A]
+    present_bits: jnp.ndarray  # uint32[R, ceil(E/32)]
+    dots: jnp.ndarray          # uint32[R, E]: (actor << 20) | counter
+    actor: jnp.ndarray         # uint32[R]
+
+
+def pack_awset_dots(state: AWSetState) -> DotPackedAWSetState:
+    """Host-side pack with the layout's soundness guards: the word has
+    12 actor bits and 20 counter bits, and a counter at the cap could
+    alias a neighbouring actor's dot after overflowing — refuse loudly
+    instead (the same posture as utils/guards' uint32 headroom)."""
+    num_actors = state.vv.shape[1]
+    if num_actors > DOT_MAX_ACTORS:
+        raise ValueError(
+            f"dot-word layout holds {32 - _DOT_SHIFT} actor bits "
+            f"(A <= {DOT_MAX_ACTORS}); got A={num_actors}")
+    max_c = int(jnp.max(state.dot_counter)) if state.dot_counter.size else 0
+    if max_c > DOT_MAX_COUNTER:
+        raise ValueError(
+            f"dot counter {max_c} exceeds the dot-word layout's "
+            f"{_DOT_SHIFT}-bit counter cap {DOT_MAX_COUNTER}; use the "
+            "uint32 layouts for unbounded-counter fleets")
+    return DotPackedAWSetState(
+        vv=state.vv, present_bits=pack_bits(state.present),
+        dots=(state.dot_actor << _DOT_SHIFT) | state.dot_counter,
+        actor=state.actor)
+
+
+def unpack_awset_dots(packed: DotPackedAWSetState,
+                      num_elements: int) -> AWSetState:
+    dots = packed.dots
+    return AWSetState(
+        vv=packed.vv,
+        present=unpack_bits(packed.present_bits, num_elements),
+        dot_actor=dots >> _DOT_SHIFT,
+        dot_counter=dots & jnp.uint32(_DOT_CMASK),
         actor=packed.actor)
 
 

@@ -610,6 +610,44 @@ def _ring_src_reader(meta_ref, refs, n_named: int, interpret: bool,
     return pairs, outs
 
 
+# Dot-word layout: one uint32 per element lane, (actor << _DOT_SHIFT) |
+# counter.  12 actor bits cover MAX_FUSED_ACTORS with headroom; 20
+# counter bits cap per-actor adds at ~1M (pack_awset_dots guards).  The
+# merge algebra only ever compares counters and gathers by actor, so
+# shift+mask in VMEM recovers both for free relative to the HBM read of
+# a second E-shaped array — the dot arrays are the dominant ring-round
+# traffic (2KB of the bool layout's ~3.3KB row).
+_DOT_SHIFT = 20
+_DOT_CMASK = (1 << _DOT_SHIFT) - 1
+DOT_MAX_ACTORS = 1 << (32 - _DOT_SHIFT)
+DOT_MAX_COUNTER = _DOT_CMASK
+
+
+def _make_ring_kernel_dotpacked(interpret: bool, packed_w: int,
+                                aligned: bool):
+    """Ring kernel on the dot-word layout: operands are vv (A-shaped),
+    bitpacked membership (word-shaped), and the packed dot word
+    (E-shaped).  Unpacks both in VMEM, runs the bitwise-pinned
+    _merge_algebra, repacks on the way out."""
+    def kernel(meta_ref, *refs):
+        pairs, out_refs = _ring_src_reader(meta_ref, refs, 3, interpret,
+                                           aligned)
+        (dvv, svv), (dp, sp), (ddot, sdot) = pairs
+        blk_e = ddot.shape[-1]
+        dp = _kernel_unpack_bits(dp, blk_e).astype(jnp.uint8)
+        sp = _kernel_unpack_bits(sp, blk_e).astype(jnp.uint8)
+        cmask = jnp.uint32(_DOT_CMASK)
+        vv, p_u8, da, dc = _merge_algebra(
+            dvv, svv, dp, sp, ddot >> _DOT_SHIFT, sdot >> _DOT_SHIFT,
+            ddot & cmask, sdot & cmask)
+        ovv_ref, op_ref, odot_ref = out_refs
+        ovv_ref[...] = vv
+        op_ref[...] = _kernel_pack_bits(p_u8, packed_w)
+        odot_ref[...] = (da << _DOT_SHIFT) | dc
+
+    return kernel
+
+
 def _make_ring_kernel(interpret: bool, packed_w: int = 0,
                       aligned: bool = False):
     """packed_w > 0: the membership operand/output is bitpacked
@@ -833,6 +871,82 @@ def pallas_ring_round_rows_packed(state, offset, *,
                                           packed_w=w, aligned=al))
     return PackedAWSetState(vv=vv, present_bits=pb, dot_actor=da,
                             dot_counter=dc, actor=state.actor)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "aligned"))
+def _fused_rows_ring_dotpacked(arrays, offset, interpret: bool,
+                               aligned: bool = False):
+    """Ring round on (vv, present_bits, dots): the dot-word layout's
+    E-shaped traffic is ONE uint32 array instead of two, on top of the
+    bitpacked membership — ~1.6x less HBM per round than the bool
+    layout at A=E=256.  Same block/window machinery as
+    _fused_rows_ring."""
+    vv, pres_bits, dots = arrays
+    num_r, num_e = dots.shape
+    num_a = vv.shape[1]
+    packed_w = pres_bits.shape[1]
+    r_pad, e_pad, a_pad, blk = row_block_layout(num_r, num_e, num_a, 512)
+    assert r_pad == num_r, "callers must check ring_supported()"
+    blk, e_pad, w_blk, total_w = _packed_tiling(e_pad, packed_w)
+    nb = num_r // _BLOCK_R
+    group = 2 if aligned else 3
+    if a_pad != num_a:
+        vv = jnp.pad(vv, ((0, 0), (0, a_pad - num_a)))
+    if total_w != packed_w:
+        pres_bits = jnp.pad(pres_bits, ((0, 0), (0, total_w - packed_w)))
+    dots = jnp.pad(dots, ((0, 0), (0, e_pad - num_e)))
+
+    meta = ring_meta(offset, num_r)
+    in_specs, out_specs = ring_block_specs(nb, blk, a_pad, a_named=1,
+                                           e_named=2, aligned=aligned)
+    # the membership group (e-arrays slot 0) carries word blocks
+    b_blk = lambda m: pl.BlockSpec((_BLOCK_R, w_blk), m)  # noqa: E731
+    maps = [s.index_map for s in in_specs[group:2 * group]]
+    in_specs[group:2 * group] = [b_blk(m) for m in maps]
+    out_specs[1] = b_blk(maps[0])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb, e_pad // blk),
+        in_specs=in_specs,
+        out_specs=out_specs,
+    )
+    ins = [x for arr in (vv, pres_bits, dots) for x in (arr,) * group]
+    out_vv, out_p, out_dot = pl.pallas_call(
+        _make_ring_kernel_dotpacked(interpret, w_blk, aligned),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((num_r, a_pad), jnp.uint32),
+            jax.ShapeDtypeStruct((num_r, total_w), jnp.uint32),
+            jax.ShapeDtypeStruct((num_r, e_pad), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(meta, *ins)
+    return (out_vv[:, :num_a], out_p[:, :packed_w], out_dot[:, :num_e])
+
+
+def pallas_ring_round_rows_dotpacked(state, offset, *,
+                                     interpret: bool | None = None):
+    """One fused ring round on the DOT-WORD layout
+    (models.packed.DotPackedAWSetState): membership bitpacked AND the
+    (actor, counter) dot fused into one uint32 word per element, so a
+    round streams one E-shaped array where the bool layout streams two
+    E-shaped uint32 arrays plus a byte mask.  Bitwise-equal (through
+    pack/unpack) to pallas_ring_round_rows; pinned by
+    tests/test_packed.py."""
+    from go_crdt_playground_tpu.models.packed import DotPackedAWSetState
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not ring_supported(state.present_bits.shape[0]):
+        raise ValueError("dot-packed ring kernel needs "
+                         "ring_supported(R); unpack and use the "
+                         "bool-layout paths instead")
+    vv, pb, dots = _ring_round_dispatch(
+        (state.vv, state.present_bits, state.dots), offset,
+        lambda a, o, al: _fused_rows_ring_dotpacked(a, o, interpret,
+                                                    aligned=al))
+    return DotPackedAWSetState(vv=vv, present_bits=pb, dots=dots,
+                               actor=state.actor)
 
 
 def pallas_gossip_round_rows(state: AWSetState, perm, *,

@@ -182,3 +182,75 @@ def test_packed_non_chunk_multiple_width():
         np.testing.assert_array_equal(
             np.asarray(getattr(want, name)),
             np.asarray(getattr(got, name)), err_msg=name)
+
+
+@pytest.mark.parametrize("offset", [1, 65, 64, 127])
+def test_dotpacked_ring_round_matches_bool(offset):
+    """The dot-word layout (one uint32 per element: actor<<20|counter,
+    plus bitpacked membership) must be invisible in results: the fused
+    ring round agrees bitwise with the bool layout through
+    pack/unpack, on both the windowed and aligned kernel forms."""
+    rng = np.random.default_rng(21)
+    state = rand_state(rng, R, 256, 5)
+    want = pallas_merge.pallas_ring_round_rows(state, offset)
+    got_packed = pallas_merge.pallas_ring_round_rows_dotpacked(
+        packed_mod.pack_awset_dots(state), offset)
+    got = packed_mod.unpack_awset_dots(got_packed, 256)
+    for name in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, name)),
+            np.asarray(getattr(got, name)), err_msg=name)
+
+
+def test_dotpacked_roundtrip_and_schedule_converges():
+    rng = np.random.default_rng(23)
+    state = rand_state(rng, R, 96, 8)
+    rt = packed_mod.unpack_awset_dots(
+        packed_mod.pack_awset_dots(state), 96)
+    for name in state._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(state, name)),
+                                      np.asarray(getattr(rt, name)),
+                                      err_msg=name)
+    # stays in the packed domain across a whole dissemination schedule
+    p = packed_mod.pack_awset_dots(state)
+    for off in gossip.dissemination_offsets(R):
+        p = pallas_merge.pallas_ring_round_rows_dotpacked(p, off)
+    out = packed_mod.unpack_awset_dots(p, 96)
+    ref = state
+    for off in gossip.dissemination_offsets(R):
+        ref = pallas_merge.pallas_ring_round_rows(ref, off)
+    for name in ref._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(ref, name)),
+                                      np.asarray(getattr(out, name)),
+                                      err_msg=name)
+
+
+def test_dotpacked_beyond_one_word_group():
+    """Word-axis tiling (E > 4096) on the dot-word kernel."""
+    rng = np.random.default_rng(27)
+    state = rand_state(rng, R, 4100, 7)
+    for offset in (3, 64):
+        want = pallas_merge.pallas_ring_round_rows(state, offset)
+        got = packed_mod.unpack_awset_dots(
+            pallas_merge.pallas_ring_round_rows_dotpacked(
+                packed_mod.pack_awset_dots(state), offset), 4100)
+        for name in want._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(want, name)),
+                np.asarray(getattr(got, name)),
+                err_msg=f"{offset}/{name}")
+
+
+def test_dotpacked_pack_guards():
+    """The 20-bit counter / 12-bit actor caps must refuse loudly at
+    pack time — an overflowed counter would alias a neighbouring
+    actor's bits and corrupt merges silently."""
+    rng = np.random.default_rng(29)
+    state = rand_state(rng, R, 32, 8)
+    big = state._replace(dot_counter=state.dot_counter.at[0, 0].set(
+        jnp.uint32(packed_mod.DOT_MAX_COUNTER + 1)))
+    with pytest.raises(ValueError, match="counter"):
+        packed_mod.pack_awset_dots(big)
+    wide = rand_state(rng, R, 32, 5000)
+    with pytest.raises(ValueError, match="actor bits"):
+        packed_mod.pack_awset_dots(wide)

@@ -234,3 +234,20 @@ def test_butterfly_shardmap_single_chip_mosaic():
         got = gossip.butterfly_round_shardmap(sharded, m, stage,
                                               kernel="pallas")
         _assert_equal(want, got)
+
+
+@pytest.mark.parametrize("offset", [1, 65])
+def test_dotpacked_ring_kernel_mosaic(offset):
+    """The dot-word ring kernel (shift/mask unpack of (actor, counter)
+    from one uint32, ~1.6x less HBM than the bool layout) must
+    Mosaic-compile and agree with the bool layout on the real chip."""
+    from go_crdt_playground_tpu.models import packed as packed_mod
+
+    state = _merge_state(13)
+    want = pallas_merge.pallas_ring_round_rows(state, offset,
+                                               interpret=False)
+    got = packed_mod.unpack_awset_dots(
+        pallas_merge.pallas_ring_round_rows_dotpacked(
+            packed_mod.pack_awset_dots(state), offset,
+            interpret=False), E)
+    _assert_equal(want, got)
