@@ -812,18 +812,28 @@ def measure_northstar(num_replicas=None, num_elements=256, num_writers=256):
     # CRDT_NORTHSTAR_PACKED=1 runs the schedule on the bitpacked layout
     # (models/packed.py): membership crosses HBM as uint32[R, E/32] —
     # the measured bitpack round-time delta for VERDICT r2 item #3.
-    packed = os.environ.get("CRDT_NORTHSTAR_PACKED") == "1"
+    # =dots runs the DOT-WORD layout (membership bitpacked AND both dot
+    # pairs fused to one uint32 word each, ~1.6x less HBM per round).
+    packed = os.environ.get("CRDT_NORTHSTAR_PACKED", "")
+    if packed not in ("", "0", "1", "dots"):
+        raise ValueError(f"CRDT_NORTHSTAR_PACKED={packed!r}: use 1 "
+                         "(bitpacked membership) or dots (dot-word)")
+    packed = packed if packed in ("1", "dots") else ""
     if packed:
         from go_crdt_playground_tpu.models import packed as packed_mod
         from go_crdt_playground_tpu.ops.pallas_delta import (
+            pallas_delta_ring_round_dotpacked,
             pallas_delta_ring_round_packed)
+        round_packed = (pallas_delta_ring_round_dotpacked
+                        if packed == "dots"
+                        else pallas_delta_ring_round_packed)
 
     @functools.partial(jax.jit, static_argnames=("n",), donate_argnums=0)
     def run_schedule(state, n):
         def body(s, i):
             off = offs[i % n_rounds]
             if packed:
-                return pallas_delta_ring_round_packed(s, off), None
+                return round_packed(s, off), None
             return gossip.delta_ring_gossip_round(
                 s, off, delta_semantics="v2"), None
         state, _ = jax.lax.scan(body, state, jnp.arange(n))
@@ -850,7 +860,9 @@ def measure_northstar(num_replicas=None, num_elements=256, num_writers=256):
 
     def _make_fleet():
         fleet = _delta_fleet(num_replicas, num_elements, num_writers)
-        if packed:
+        if packed == "dots":
+            fleet = packed_mod.pack_awset_delta_dots(fleet)
+        elif packed:
             fleet = packed_mod.pack_awset_delta(fleet)
         return fleet
 
@@ -862,7 +874,9 @@ def measure_northstar(num_replicas=None, num_elements=256, num_writers=256):
         float(jnp.asarray(warm.vv[0, 0]))
         del warm
     t1, state = timed(n_rounds)
-    if packed:
+    if packed == "dots":
+        state = packed_mod.unpack_awset_delta_dots(state, num_elements)
+    elif packed:
         state = packed_mod.unpack_awset_delta(state, num_elements)
     converged = bool(gossip.converged_jit(state.present, state.vv))
     del state
@@ -883,7 +897,7 @@ def measure_northstar(num_replicas=None, num_elements=256, num_writers=256):
         "metric": f"north star: {num_replicas} x {num_elements}-element "
                   "delta-AWSet replicas, all-pairs converged "
                   f"({n_rounds} dissemination rounds, v2 delta gossip"
-                  f"{', bitpacked membership' if packed else ''})",
+                  f"{', dot-word layout' if packed == 'dots' else ', bitpacked membership' if packed else ''})",
         "value": round(t1, 4),
         "unit": "seconds (single chip, incl. one ~70ms tunnel sync)",
         "converged": converged,
@@ -912,11 +926,12 @@ def run_northstar():
         print("CRDT_BENCH_FATAL: fleet did not converge", file=sys.stderr)
         sys.exit(1)
     print(json.dumps(result))
-    # the bitpacked variant records NEXT TO the bool artifact, so the
-    # packed-vs-bool round-time delta survives as a committed pair
-    artifact = ("NORTHSTAR_PACKED.json"
-                if os.environ.get("CRDT_NORTHSTAR_PACKED") == "1"
-                else "NORTHSTAR.json")
+    # the packed variants record NEXT TO the bool artifact, so the
+    # layout round-time deltas survive as a committed set
+    variant = os.environ.get("CRDT_NORTHSTAR_PACKED", "")
+    artifact = {"1": "NORTHSTAR_PACKED.json",
+                "dots": "NORTHSTAR_DOTPACKED.json"}.get(
+                    variant, "NORTHSTAR.json")
     with open(artifact, "w") as f:
         json.dump(result, f, indent=2)
     return result

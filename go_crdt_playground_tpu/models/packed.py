@@ -79,17 +79,7 @@ def pack_awset_dots(state: AWSetState) -> DotPackedAWSetState:
     12 actor bits and 20 counter bits, and a counter at the cap could
     alias a neighbouring actor's dot after overflowing — refuse loudly
     instead (the same posture as utils/guards' uint32 headroom)."""
-    num_actors = state.vv.shape[1]
-    if num_actors > DOT_MAX_ACTORS:
-        raise ValueError(
-            f"dot-word layout holds {32 - _DOT_SHIFT} actor bits "
-            f"(A <= {DOT_MAX_ACTORS}); got A={num_actors}")
-    max_c = int(jnp.max(state.dot_counter)) if state.dot_counter.size else 0
-    if max_c > DOT_MAX_COUNTER:
-        raise ValueError(
-            f"dot counter {max_c} exceeds the dot-word layout's "
-            f"{_DOT_SHIFT}-bit counter cap {DOT_MAX_COUNTER}; use the "
-            "uint32 layouts for unbounded-counter fleets")
+    _check_dot_caps(state.vv.shape[1], state.dot_counter)
     return DotPackedAWSetState(
         vv=state.vv, present_bits=pack_bits(state.present),
         dots=(state.dot_actor << _DOT_SHIFT) | state.dot_counter,
@@ -105,6 +95,62 @@ def unpack_awset_dots(packed: DotPackedAWSetState,
         dot_actor=dots >> _DOT_SHIFT,
         dot_counter=dots & jnp.uint32(_DOT_CMASK),
         actor=packed.actor)
+
+
+class DotPackedAWSetDeltaState(NamedTuple):
+    """δ-state analogue of DotPackedAWSetState: membership bitpacked
+    and BOTH dot pairs (add + deletion) fused to one uint32 word per
+    element — the δ ring round's six E-shaped arrays become four, two
+    of them 32x narrower."""
+
+    vv: jnp.ndarray            # uint32[R, A]
+    present_bits: jnp.ndarray  # uint32[R, ceil(E/32)]
+    dots: jnp.ndarray          # uint32[R, E]: (actor << 20) | counter
+    actor: jnp.ndarray         # uint32[R]
+    deleted_bits: jnp.ndarray  # uint32[R, ceil(E/32)]
+    del_dots: jnp.ndarray      # uint32[R, E]
+    processed: jnp.ndarray     # uint32[R, A]
+
+
+def _check_dot_caps(num_actors: int, *counters) -> None:
+    if num_actors > DOT_MAX_ACTORS:
+        raise ValueError(
+            f"dot-word layout holds {32 - _DOT_SHIFT} actor bits "
+            f"(A <= {DOT_MAX_ACTORS}); got A={num_actors}")
+    for c in counters:
+        max_c = int(jnp.max(c)) if c.size else 0
+        if max_c > DOT_MAX_COUNTER:
+            raise ValueError(
+                f"dot counter {max_c} exceeds the dot-word layout's "
+                f"{_DOT_SHIFT}-bit counter cap {DOT_MAX_COUNTER}; use "
+                "the uint32 layouts for unbounded-counter fleets")
+
+
+def pack_awset_delta_dots(state: AWSetDeltaState) -> DotPackedAWSetDeltaState:
+    _check_dot_caps(state.vv.shape[1], state.dot_counter,
+                    state.del_dot_counter)
+    return DotPackedAWSetDeltaState(
+        vv=state.vv, present_bits=pack_bits(state.present),
+        dots=(state.dot_actor << _DOT_SHIFT) | state.dot_counter,
+        actor=state.actor, deleted_bits=pack_bits(state.deleted),
+        del_dots=((state.del_dot_actor << _DOT_SHIFT)
+                  | state.del_dot_counter),
+        processed=state.processed)
+
+
+def unpack_awset_delta_dots(packed: DotPackedAWSetDeltaState,
+                            num_elements: int) -> AWSetDeltaState:
+    cmask = jnp.uint32(_DOT_CMASK)
+    return AWSetDeltaState(
+        vv=packed.vv,
+        present=unpack_bits(packed.present_bits, num_elements),
+        dot_actor=packed.dots >> _DOT_SHIFT,
+        dot_counter=packed.dots & cmask,
+        actor=packed.actor,
+        deleted=unpack_bits(packed.deleted_bits, num_elements),
+        del_dot_actor=packed.del_dots >> _DOT_SHIFT,
+        del_dot_counter=packed.del_dots & cmask,
+        processed=packed.processed)
 
 
 def pack_awset_delta(state: AWSetDeltaState) -> PackedAWSetDeltaState:

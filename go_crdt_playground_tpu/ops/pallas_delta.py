@@ -50,12 +50,17 @@ from jax.experimental.pallas import tpu as pltpu
 
 from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
 from go_crdt_playground_tpu.ops.pallas_merge import (
-    _BLOCK_R, _ring_round_dispatch, _ring_window, gather_rows,
-    ring_block_specs, ring_meta, ring_supported, row_block_layout)
+    _BLOCK_R, _DOT_CMASK, _DOT_SHIFT, _ring_round_dispatch, _ring_window,
+    gather_rows, ring_block_specs, ring_meta, ring_supported,
+    row_block_layout)
 
 _A_NAMED = ("vv", "processed")
 _E_NAMED = ("present", "dot_actor", "dot_counter", "deleted",
             "del_dot_actor", "del_dot_counter")
+# dot-word layout (pallas_merge._DOT_SHIFT): each dot pair rides as one
+# uint32 word, so the δ ring's six E-shaped operands become four — two
+# bitpacked membership word arrays + two dot-word arrays
+_E_NAMED_DOTS = ("present", "dots", "deleted", "del_dots")
 
 
 def _delta_algebra(dst, src, s_actor, mode: str = "v2"):
@@ -297,19 +302,24 @@ _PACKED_NAMES = ("present", "deleted")
 
 
 def _make_delta_ring_kernel(interpret: bool, packed_w: int = 0,
-                            mode: str = "v2", aligned: bool = False):
+                            mode: str = "v2", aligned: bool = False,
+                            dot_packed: bool = False):
     """packed_w > 0: ``present``/``deleted`` operands/outputs are
     bitpacked uint32[blk_r, packed_w]; unpack after windowing, repack
     before writing (pallas_merge bit helpers).  aligned: single-src-
     block form — one partner block per array instead of the lo/hi
     window pair, halving partner-read HBM traffic; valid only when
     offset % _BLOCK_R == 0 (callers dispatch via _ring_round_dispatch).
-    mode="reference" threads the strict-quirk scratch (last ref)."""
+    mode="reference" threads the strict-quirk scratch (last ref).
+    dot_packed: the two dot pairs ride as single uint32 words
+    (pallas_merge dot-word layout), unpacked with shift/mask in VMEM;
+    requires packed_w (the layout always bitpacks membership)."""
     from go_crdt_playground_tpu.ops.pallas_merge import (
         _kernel_pack_bits, _kernel_unpack_bits)
 
+    assert packed_w or not dot_packed
     group = 2 if aligned else 3
-    names = _A_NAMED + _E_NAMED
+    names = _A_NAMED + (_E_NAMED_DOTS if dot_packed else _E_NAMED)
 
     def kernel(meta_ref, sact_ref, *refs):
         scratch_ref = None
@@ -317,7 +327,7 @@ def _make_delta_ring_kernel(interpret: bool, packed_w: int = 0,
             *refs, scratch_ref = refs
         win = functools.partial(_ring_window, o_mod=meta_ref[1],
                                 interpret=interpret)
-        blk_e = refs[group * 3].shape[-1]   # the dot_actor dst block
+        blk_e = refs[group * 3].shape[-1]   # the dot(s) dst block
         dst, src = {}, {}
         for k, name in enumerate(names):
             g = refs[group * k: group * k + group]
@@ -328,12 +338,28 @@ def _make_delta_ring_kernel(interpret: bool, packed_w: int = 0,
                 s = _kernel_unpack_bits(s, blk_e).astype(jnp.uint8)
             dst[name] = d
             src[name] = s
+        if dot_packed:
+            cmask = jnp.uint32(_DOT_CMASK)
+            for side in (dst, src):
+                for pre, wname in (("", "dots"), ("del_", "del_dots")):
+                    w = side.pop(wname)
+                    side[pre + "dot_actor"] = w >> _DOT_SHIFT
+                    side[pre + "dot_counter"] = w & cmask
         out_refs = refs[group * len(names):]
         outs, extras = _delta_algebra(dst, src, sact_ref[...], mode)
-        for ref, name, val in zip(out_refs, names, outs):
-            if packed_w and name in _PACKED_NAMES:
-                val = _kernel_pack_bits(val, packed_w)
-            ref[...] = val
+        if dot_packed:
+            vvo, proco, p, da, dc, d, dda, ddc = outs
+            outs = (vvo, proco, _kernel_pack_bits(p, packed_w),
+                    (da << _DOT_SHIFT) | dc,
+                    _kernel_pack_bits(d, packed_w),
+                    (dda << _DOT_SHIFT) | ddc)
+            for ref, val in zip(out_refs, outs):
+                ref[...] = val
+        else:
+            for ref, name, val in zip(out_refs, names, outs):
+                if packed_w and name in _PACKED_NAMES:
+                    val = _kernel_pack_bits(val, packed_w)
+                ref[...] = val
         if mode == "reference":
             _strict_vv_epilogue(out_refs[0], dst["vv"], extras,
                                 scratch_ref)
@@ -343,10 +369,10 @@ def _make_delta_ring_kernel(interpret: bool, packed_w: int = 0,
 
 @functools.partial(jax.jit,
                    static_argnames=("block_e", "interpret", "packed_w",
-                                    "mode", "aligned"))
+                                    "mode", "aligned", "dot_packed"))
 def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
                       packed_w: int = 0, mode: str = "v2",
-                      aligned: bool = False):
+                      aligned: bool = False, dot_packed: bool = False):
     """packed_w > 0: arrays["present"]/["deleted"] are bitpacked
     uint32[R, packed_w] (models.packed layout); the element grid tiles
     in 4096-element chunks (= one lane group of words each,
@@ -356,7 +382,8 @@ def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
     offset % _BLOCK_R == 0 (callers dispatch via _ring_round_dispatch)."""
     from go_crdt_playground_tpu.ops.pallas_merge import _packed_tiling
 
-    num_r, num_e = arrays["dot_actor"].shape
+    names = _A_NAMED + (_E_NAMED_DOTS if dot_packed else _E_NAMED)
+    num_r, num_e = arrays[names[3]].shape
     num_a = arrays["vv"].shape[1]
     r_pad, e_pad, a_pad, blk = row_block_layout(num_r, num_e, num_a,
                                                 block_e)
@@ -379,8 +406,8 @@ def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
         return jnp.pad(x, ((0, 0), (0, last - x.shape[1])))
 
     in_specs, out_specs = ring_block_specs(
-        nb, blk, a_pad, a_named=len(_A_NAMED), e_named=len(_E_NAMED),
-        aligned=aligned)
+        nb, blk, a_pad, a_named=len(_A_NAMED),
+        e_named=len(names) - len(_A_NAMED), aligned=aligned)
     b_blk = lambda m: pl.BlockSpec((_BLOCK_R, w_blk), m)  # noqa: E731
     # bits blocks advance with the element grid step: word block j of a
     # row serves element block j, so the index maps must be the E-style
@@ -389,7 +416,7 @@ def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
     e0 = group * len(_A_NAMED)
     src_maps = [in_specs[e0 + g].index_map for g in range(group)]
     ins = [s_actor]
-    for k, name in enumerate(_A_NAMED + _E_NAMED):
+    for k, name in enumerate(names):
         if packed_w and name in _PACKED_NAMES:
             x = pad(arrays[name], total_w)
             in_specs[group * k: group * k + group] = [
@@ -399,12 +426,23 @@ def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
             x = pad(arrays[name], a_pad if name in _A_NAMED else e_pad)
         ins += [x] * group
 
-    out_shape = _out_shapes(num_r, a_pad, e_pad)
-    if packed_w:
-        for k, name in enumerate(_A_NAMED + _E_NAMED):
-            if name in _PACKED_NAMES:
-                out_shape[k] = jax.ShapeDtypeStruct((num_r, total_w),
-                                                    jnp.uint32)
+    if dot_packed:
+        u32 = jnp.uint32
+        out_shape = [
+            jax.ShapeDtypeStruct((num_r, a_pad), u32),
+            jax.ShapeDtypeStruct((num_r, a_pad), u32),
+            jax.ShapeDtypeStruct((num_r, total_w), u32),
+            jax.ShapeDtypeStruct((num_r, e_pad), u32),
+            jax.ShapeDtypeStruct((num_r, total_w), u32),
+            jax.ShapeDtypeStruct((num_r, e_pad), u32),
+        ]
+    else:
+        out_shape = _out_shapes(num_r, a_pad, e_pad)
+        if packed_w:
+            for k, name in enumerate(names):
+                if name in _PACKED_NAMES:
+                    out_shape[k] = jax.ShapeDtypeStruct((num_r, total_w),
+                                                        jnp.uint32)
     s_blk = pl.BlockSpec((_BLOCK_R, 1), lambda i, j, meta: (i, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -415,11 +453,16 @@ def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
                         if mode == "reference" else []),
     )
     outs = pl.pallas_call(
-        _make_delta_ring_kernel(interpret, w_blk, mode, aligned),
+        _make_delta_ring_kernel(interpret, w_blk, mode, aligned,
+                                dot_packed),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
     )(meta, *ins)
+    if dot_packed:
+        vv, proc, pb, dots, db, del_dots = outs
+        return (vv[:, :num_a], proc[:, :num_a], pb[:, :packed_w],
+                dots[:, :num_e], db[:, :packed_w], del_dots[:, :num_e])
     vv, proc, p, da, dc, d, dda, ddc = outs
     trim_p = ((lambda x: x[:, :packed_w]) if packed_w
               else (lambda x: x[:, :num_e]))
@@ -538,3 +581,40 @@ def pallas_delta_ring_round_packed(state, offset, *,
         vv=vv, present_bits=pb, dot_actor=da, dot_counter=dc,
         actor=state.actor, deleted_bits=db, del_dot_actor=dda,
         del_dot_counter=ddc, processed=proc)
+
+
+def pallas_delta_ring_round_dotpacked(state, offset, *,
+                                      interpret: bool | None = None):
+    """One fused δ ring round on the DOT-WORD layout
+    (models.packed.DotPackedAWSetDeltaState): membership bitpacked AND
+    both dot pairs fused to one uint32 word each, so the round streams
+    two E-shaped arrays where the bool layout streams four uint32
+    arrays plus two byte masks (~4.2KB vs ~6.7KB per row at A=E=256 —
+    the north-star schedule's dominant traffic).  v2 semantics only
+    (the north-star/production δ path); bitwise-equal through
+    pack/unpack to pallas_delta_ring_round, pinned by
+    tests/test_packed.py."""
+    from go_crdt_playground_tpu.models.packed import (
+        DotPackedAWSetDeltaState)
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not ring_supported(state.present_bits.shape[0]):
+        raise ValueError("dot-packed ring kernel needs "
+                         "ring_supported(R); unpack and use the "
+                         "bool-layout paths instead")
+    arrays = {
+        "vv": state.vv, "processed": state.processed,
+        "present": state.present_bits, "dots": state.dots,
+        "deleted": state.deleted_bits, "del_dots": state.del_dots,
+        "actor": state.actor,
+    }
+    w = state.present_bits.shape[1]
+    vv, proc, pb, dots, db, del_dots = _ring_round_dispatch(
+        arrays, offset,
+        lambda a, o, al: _fused_delta_ring(a, o, 512, interpret,
+                                           packed_w=w, aligned=al,
+                                           dot_packed=True))
+    return DotPackedAWSetDeltaState(
+        vv=vv, present_bits=pb, dots=dots, actor=state.actor,
+        deleted_bits=db, del_dots=del_dots, processed=proc)

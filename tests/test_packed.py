@@ -254,3 +254,60 @@ def test_dotpacked_pack_guards():
     wide = rand_state(rng, R, 32, 5000)
     with pytest.raises(ValueError, match="actor bits"):
         packed_mod.pack_awset_dots(wide)
+
+
+@pytest.mark.parametrize("offset", [1, 64, 65, 127])
+def test_dotpacked_delta_ring_round_matches_bool(offset):
+    """The δ dot-word ring (both dot pairs as single words + bitpacked
+    membership) must agree bitwise with the bool-layout δ ring through
+    pack/unpack — windowed and aligned kernel forms."""
+    import random
+
+    from tests.test_pallas_delta import _scenario_state
+
+    rng = random.Random(71)
+    state = _scenario_state(rng, R, 128, 8)
+    want = pallas_delta.pallas_delta_ring_round(state, offset)
+    got_packed = pallas_delta.pallas_delta_ring_round_dotpacked(
+        packed_mod.pack_awset_delta_dots(state), offset)
+    got = packed_mod.unpack_awset_delta_dots(got_packed, 128)
+    for name in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, name)),
+            np.asarray(getattr(got, name)), err_msg=name)
+
+
+def test_dotpacked_delta_schedule_stays_packed_and_converges():
+    """A full dissemination schedule in the dot-word domain matches the
+    bool-layout schedule bitwise and converges."""
+    import random
+
+    from go_crdt_playground_tpu.parallel import collectives
+    from tests.test_pallas_delta import _scenario_state
+
+    rng = random.Random(73)
+    state = _scenario_state(rng, R, 96, 8)
+    p = packed_mod.pack_awset_delta_dots(state)
+    ref = state
+    for off in gossip.dissemination_offsets(R):
+        p = pallas_delta.pallas_delta_ring_round_dotpacked(p, off)
+        ref = pallas_delta.pallas_delta_ring_round(ref, off)
+    out = packed_mod.unpack_awset_delta_dots(p, 96)
+    for name in ref._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(ref, name)),
+                                      np.asarray(getattr(out, name)),
+                                      err_msg=name)
+    assert bool(collectives.converged(out.present, out.vv))
+
+
+def test_dotpacked_delta_pack_guards():
+    import random
+
+    from tests.test_pallas_delta import _scenario_state
+
+    rng = random.Random(79)
+    state = _scenario_state(rng, R, 32, 8)
+    big = state._replace(del_dot_counter=state.del_dot_counter.at[
+        0, 0].set(jnp.uint32(packed_mod.DOT_MAX_COUNTER + 1)))
+    with pytest.raises(ValueError, match="counter"):
+        packed_mod.pack_awset_delta_dots(big)

@@ -251,3 +251,20 @@ def test_dotpacked_ring_kernel_mosaic(offset):
             packed_mod.pack_awset_dots(state), offset,
             interpret=False), E)
     _assert_equal(want, got)
+
+
+@pytest.mark.parametrize("offset", [1, 65])
+def test_dotpacked_delta_ring_kernel_mosaic(offset):
+    """The δ dot-word ring kernel (both dot pairs shift/mask-unpacked
+    from single uint32 words — the north-star schedule's ~1.6x HBM cut)
+    must Mosaic-compile and agree with the bool layout on-chip."""
+    from go_crdt_playground_tpu.models import packed as packed_mod
+
+    state = _delta_state(17)
+    want = pallas_delta.pallas_delta_ring_round(state, offset,
+                                                interpret=False)
+    got = packed_mod.unpack_awset_delta_dots(
+        pallas_delta.pallas_delta_ring_round_dotpacked(
+            packed_mod.pack_awset_delta_dots(state), offset,
+            interpret=False), E)
+    _assert_equal(want, got)
