@@ -29,6 +29,8 @@ import numpy as np
 from go_crdt_playground_tpu.models.awset import AWSetState
 from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
 from go_crdt_playground_tpu.models.packed import (
+    DotPackedAWSetDeltaState,
+    DotPackedAWSetState,
     PackedAWSetDeltaState,
     PackedAWSetState,
 )
@@ -54,6 +56,8 @@ STATE_TYPES = {
         AWSetDeltaState,
         PackedAWSetState,
         PackedAWSetDeltaState,
+        DotPackedAWSetState,
+        DotPackedAWSetDeltaState,
         GCounterState,
         PNCounterState,
         TwoPSetState,

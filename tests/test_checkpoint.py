@@ -205,3 +205,38 @@ def test_packed_and_ormap_states_round_trip_typed(tmp_path):
         np.testing.assert_array_equal(np.asarray(getattr(ck2.state, name)),
                                       np.asarray(getattr(om, name)),
                                       err_msg=name)
+
+
+def test_dotpacked_states_round_trip_typed(tmp_path):
+    """The dot-word layouts restore as their typed states, bitwise
+    intact — same contract as the other packed forms."""
+    from go_crdt_playground_tpu.models import packed as packed_mod
+
+    state = awset_delta.init(4, 96, 4)
+    state = awset_delta.add_element(state, np.uint32(1), np.uint32(7))
+    for pack, name in (
+            (packed_mod.pack_awset_delta_dots, "DotPackedAWSetDeltaState"),
+    ):
+        p = pack(state)
+        path = str(tmp_path / f"{name}.ckpt")
+        ckpt.save_checkpoint(path, p)
+        ck = ckpt.restore_checkpoint(path)
+        assert type(ck.state).__name__ == name
+        for f in p._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ck.state, f)),
+                np.asarray(getattr(p, f)), err_msg=f)
+
+    from go_crdt_playground_tpu.models import awset as awset_mod
+
+    aw = awset_mod.init(4, 96, 4)
+    aw = awset_mod.add_element(aw, np.uint32(1), np.uint32(7))
+    p = packed_mod.pack_awset_dots(aw)
+    path = str(tmp_path / "dotset.ckpt")
+    ckpt.save_checkpoint(path, p)
+    ck = ckpt.restore_checkpoint(path)
+    assert type(ck.state).__name__ == "DotPackedAWSetState"
+    for f in p._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(ck.state, f)),
+                                      np.asarray(getattr(p, f)),
+                                      err_msg=f)
