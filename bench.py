@@ -726,7 +726,8 @@ _V5E_ICI_LINK_GBS = 45.0
 
 def northstar_ici_model(total_compute_s, num_replicas, num_elements,
                         num_actors, n_chips=4,
-                        ici_link_gbs=_V5E_ICI_LINK_GBS):
+                        ici_link_gbs=_V5E_ICI_LINK_GBS,
+                        layout="packed"):
     """Traffic-model projection of the north-star schedule onto an
     n-chip ring — the defensible replacement for bare linear-DP
     scaling (the <1s claim must cite a model, not an assumption).
@@ -740,10 +741,12 @@ def northstar_ici_model(total_compute_s, num_replicas, num_elements,
     merge compute it feeds — and the no-overlap serialized sum is also
     reported as the pessimistic bound."""
     blk = num_replicas // n_chips
-    # bytes/row of PackedAWSetDeltaState: 2 VV-shaped uint32 rows
-    # (vv, processed), 4 dot uint32 rows (add + del actor/counter),
-    # 2 bitpacked membership rows, 1 actor id
-    row_bytes = (2 * num_actors * 4 + 4 * num_elements * 4
+    # bytes/row: 2 VV-shaped uint32 rows (vv, processed) + 2 bitpacked
+    # membership rows + 1 actor id, plus the dot arrays — 4 uint32 rows
+    # on the packed layout (add + del actor/counter), 2 dot-word rows
+    # on the dots layout (models.packed.DotPackedAWSetDeltaState)
+    dot_arrays = {"packed": 4, "dots": 2}[layout]
+    row_bytes = (2 * num_actors * 4 + dot_arrays * num_elements * 4
                  + 2 * (num_elements // 8) + 4)
     crossing = []
     link_bytes = 0
@@ -892,7 +895,9 @@ def measure_northstar(num_replicas=None, num_elements=256, num_writers=256):
     per_round = (t2 - t1) / n_rounds
     fit_total = per_round * n_rounds
     model = northstar_ici_model(fit_total, num_replicas, num_elements,
-                                num_writers)
+                                num_writers,
+                                layout="dots" if packed == "dots"
+                                else "packed")
     return {
         "metric": f"north star: {num_replicas} x {num_elements}-element "
                   "delta-AWSet replicas, all-pairs converged "

@@ -850,21 +850,27 @@ def butterfly_round_shardmap(state: AWSetState, mesh: Mesh, stage: int,
 
 
 @functools.lru_cache(maxsize=None)
-def _packed_block_ring_compiled(mesh: Mesh, shift: int, kernel_offset: int):
+def _packed_block_ring_compiled(mesh: Mesh, shift: int, kernel_offset: int,
+                                state_cls=None):
     from jax.sharding import PartitionSpec as P
 
-    from go_crdt_playground_tpu.models.packed import PackedAWSetDeltaState
+    from go_crdt_playground_tpu.models.packed import (
+        DotPackedAWSetDeltaState, PackedAWSetDeltaState)
     from go_crdt_playground_tpu.ops.pallas_delta import (
-        pallas_delta_ring_round_packed)
+        pallas_delta_ring_round_dotpacked, pallas_delta_ring_round_packed)
 
+    if state_cls is None:
+        state_cls = PackedAWSetDeltaState
+    round_fn = (pallas_delta_ring_round_dotpacked
+                if state_cls is DotPackedAWSetDeltaState
+                else pallas_delta_ring_round_packed)
     n = mesh.shape[REPLICA_AXIS]
     # device d receives the block of device (d + shift) mod n
     pairs = [((i + shift) % n, i) for i in range(n)]
     row = P(REPLICA_AXIS, None)
-    specs = PackedAWSetDeltaState(
-        vv=row, present_bits=row, dot_actor=row, dot_counter=row,
-        actor=P(REPLICA_AXIS), deleted_bits=row, del_dot_actor=row,
-        del_dot_counter=row, processed=row)
+    # every array is row-sharded 2-D except the 1-D actor column
+    specs = state_cls(**{f: (P(REPLICA_AXIS) if f == "actor" else row)
+                         for f in state_cls._fields})
 
     def step(local):
         if shift:
@@ -874,7 +880,7 @@ def _packed_block_ring_compiled(mesh: Mesh, shift: int, kernel_offset: int):
             recv = local
         stacked = jax.tree.map(
             lambda a, b: jnp.concatenate([a, b], axis=0), local, recv)
-        out = pallas_delta_ring_round_packed(stacked, kernel_offset)
+        out = round_fn(stacked, kernel_offset)
         return jax.tree.map(lambda x: x[: x.shape[0] // 2], out)
 
     # check_vma off for the same reason as _ring_step_compiled's pallas
@@ -946,4 +952,5 @@ def packed_block_ring_round_shardmap(state, mesh: Mesh, offset):
         raise ValueError(
             f"offset {offset} is neither intra-block (< {blk}) nor "
             f"block-aligned (multiple of {blk})")
-    return _packed_block_ring_compiled(mesh, shift, kernel_offset)(state)
+    return _packed_block_ring_compiled(mesh, shift, kernel_offset,
+                                       type(state))(state)
