@@ -52,7 +52,7 @@ def test_dryrun_multichip_8_devices():
         env=dict(os.environ), cwd=REPO, timeout=660,
         capture_output=True, text=True)
     assert proc.returncode == 0, proc.stderr[-2000:]
-    assert "dryrun_multichip ok: 4/4 sharded paths converged" in proc.stdout
+    assert "dryrun_multichip ok: 5/5 sharded paths converged" in proc.stdout
     assert "converged=False" not in proc.stdout
 
 
@@ -65,7 +65,7 @@ def test_dryrun_multichip_odd_device_count():
         capture_output=True, text=True)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "delta-default(3, 1)" in proc.stdout
-    assert "4/4 sharded paths converged" in proc.stdout
+    assert "5/5 sharded paths converged" in proc.stdout
 
 
 def test_entry_shape_triggers_fused_dispatch():
