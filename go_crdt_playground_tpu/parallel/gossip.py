@@ -855,15 +855,21 @@ def _packed_block_ring_compiled(mesh: Mesh, shift: int, kernel_offset: int,
     from jax.sharding import PartitionSpec as P
 
     from go_crdt_playground_tpu.models.packed import (
-        DotPackedAWSetDeltaState, PackedAWSetDeltaState)
+        DotPackedAWSetDeltaState, DotPackedAWSetState,
+        PackedAWSetDeltaState, PackedAWSetState)
     from go_crdt_playground_tpu.ops.pallas_delta import (
         pallas_delta_ring_round_dotpacked, pallas_delta_ring_round_packed)
+    from go_crdt_playground_tpu.ops.pallas_merge import (
+        pallas_ring_round_rows_dotpacked, pallas_ring_round_rows_packed)
 
     if state_cls is None:
         state_cls = PackedAWSetDeltaState
-    round_fn = (pallas_delta_ring_round_dotpacked
-                if state_cls is DotPackedAWSetDeltaState
-                else pallas_delta_ring_round_packed)
+    round_fn = {
+        PackedAWSetDeltaState: pallas_delta_ring_round_packed,
+        DotPackedAWSetDeltaState: pallas_delta_ring_round_dotpacked,
+        PackedAWSetState: pallas_ring_round_rows_packed,
+        DotPackedAWSetState: pallas_ring_round_rows_dotpacked,
+    }[state_cls]
     n = mesh.shape[REPLICA_AXIS]
     # device d receives the block of device (d + shift) mod n
     pairs = [((i + shift) % n, i) for i in range(n)]
@@ -894,10 +900,13 @@ def _packed_block_ring_compiled(mesh: Mesh, shift: int, kernel_offset: int,
 
 
 def packed_block_ring_round_shardmap(state, mesh: Mesh, offset):
-    """One BITPACKED δ gossip round (models/packed.py layout) with the
-    replica axis explicitly sharded: membership crosses ICI as
-    uint32[blk, E/32] words — 8x less wire traffic for the two
-    membership sections than the bool layouts.
+    """One packed-layout gossip round with the replica axis explicitly
+    sharded.  Accepts any of the four packed layouts (models/packed.py:
+    bitpacked or dot-word, full-state or δ) and dispatches the matching
+    single-device ring kernel per shard; membership crosses ICI as
+    uint32[blk, E/32] words — 8x less wire traffic for the membership
+    sections than the bool layouts — and the dot-word forms halve the
+    dot-section traffic on top.
 
     Pairing, with ``blk = R / n_devices`` rows per device:
 

@@ -577,3 +577,32 @@ def test_dotword_block_ring_shardmap_bitwise_and_converges():
         o *= 2
     out = packed_mod.unpack_awset_delta_dots(st, E)
     assert bool(collectives.converged(out.present, out.vv))
+
+
+def test_fullstate_packed_block_ring_shardmap_bitwise():
+    """The sharded block ring also serves the FULL-STATE packed layouts
+    (bitpacked and dot-word AWSetState): block-aligned offsets bitwise-
+    equal the single-device kernels."""
+    from go_crdt_playground_tpu.models import packed as packed_mod
+    from go_crdt_playground_tpu.ops import pallas_merge
+    from tests.test_packed import rand_state
+
+    n, blk = 8, 64
+    R, E, A = n * blk, 96, 8
+    rng = np.random.default_rng(87)
+    state = rand_state(rng, R, E, A)
+    m = mesh_mod.make_mesh((n, 1))
+    for pack, ring in (
+            (packed_mod.pack_awset,
+             pallas_merge.pallas_ring_round_rows_packed),
+            (packed_mod.pack_awset_dots,
+             pallas_merge.pallas_ring_round_rows_dotpacked)):
+        p = pack(state)
+        sharded = mesh_mod.shard_state(p, m)
+        got = gossip.packed_block_ring_round_shardmap(sharded, m, blk)
+        want = ring(p, blk)
+        for name in want._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(got, name)),
+                np.asarray(getattr(want, name)),
+                err_msg=f"{pack.__name__}/{name}")
