@@ -54,7 +54,6 @@ Reference quirks that ARE preserved (they are semantics, not bugs):
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 __all__ = [

@@ -4,13 +4,12 @@ the shape a Go conformance harness would take (SURVEY §7.3 step 1)."""
 
 import random
 
-import numpy as np
 import pytest
 
 from go_crdt_playground_tpu.bridge import (MergerClient, MergerServer,
                                            convert, serve_grpc)
 from go_crdt_playground_tpu.bridge import merger_pb2 as pb
-from go_crdt_playground_tpu.models.spec import (AWSet, AWSetDelta, Dot,
+from go_crdt_playground_tpu.models.spec import (AWSet, AWSetDelta,
                                                 VersionVector)
 from go_crdt_playground_tpu.utils.guards import UINT32_MAX
 

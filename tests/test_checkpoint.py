@@ -9,7 +9,6 @@ import numpy as np
 import pytest
 
 import jax
-import jax.numpy as jnp
 
 from go_crdt_playground_tpu.models import awset, awset_delta
 from go_crdt_playground_tpu.models.spec import AWSet, VersionVector

@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from go_crdt_playground_tpu.models import awset
-from go_crdt_playground_tpu.models.spec import AWSet, Dot, VersionVector
+from go_crdt_playground_tpu.models.spec import AWSet, VersionVector
 from go_crdt_playground_tpu.ops import merge as merge_ops
 from go_crdt_playground_tpu.utils.codec import (
     ElementDict,

@@ -19,7 +19,6 @@ Plus coverage the reference lacks (SURVEY §4 gaps): unequal-length VVs,
 
 import random
 
-import pytest
 
 from go_crdt_playground_tpu.models.spec import (
     AWSet,
