@@ -311,3 +311,51 @@ def test_dotpacked_delta_pack_guards():
         0, 0].set(jnp.uint32(packed_mod.DOT_MAX_COUNTER + 1)))
     with pytest.raises(ValueError, match="counter"):
         packed_mod.pack_awset_delta_dots(big)
+
+
+def test_dotpacked_traced_offset_schedule_matches_static():
+    """Production schedules feed offsets as DATA (one compiled program,
+    lax.cond aligned/windowed dispatch); the traced path must equal the
+    per-offset static calls for both dot-word kernels."""
+    import random
+
+    import jax
+
+    from tests.test_pallas_delta import _scenario_state
+
+    rng = np.random.default_rng(31)
+    st = packed_mod.pack_awset_dots(rand_state(rng, R, 96, 8))
+    offs = jnp.asarray([3, 64, 65], jnp.uint32)
+
+    @jax.jit
+    def sched(s):
+        def body(c, o):
+            return pallas_merge.pallas_ring_round_rows_dotpacked(c, o), None
+        return jax.lax.scan(body, s, offs)[0]
+
+    want = st
+    for o in (3, 64, 65):
+        want = pallas_merge.pallas_ring_round_rows_dotpacked(want, o)
+    got = sched(st)
+    for name in want._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(got, name)),
+                                      np.asarray(getattr(want, name)),
+                                      err_msg=name)
+
+    rngd = random.Random(33)
+    dst = packed_mod.pack_awset_delta_dots(_scenario_state(rngd, R, 96, 8))
+
+    @jax.jit
+    def dsched(s):
+        def body(c, o):
+            return pallas_delta.pallas_delta_ring_round_dotpacked(c, o), None
+        return jax.lax.scan(body, s, offs)[0]
+
+    dwant = dst
+    for o in (3, 64, 65):
+        dwant = pallas_delta.pallas_delta_ring_round_dotpacked(dwant, o)
+    dgot = dsched(dst)
+    for name in dwant._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(dgot, name)),
+                                      np.asarray(getattr(dwant, name)),
+                                      err_msg=f"delta/{name}")
