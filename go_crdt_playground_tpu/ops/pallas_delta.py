@@ -584,21 +584,25 @@ def pallas_delta_ring_round_packed(state, offset, *,
 
 
 def pallas_delta_ring_round_dotpacked(state, offset, *,
+                                      delta_semantics: str = "v2",
+                                      strict_reference_semantics:
+                                      bool = True,
                                       interpret: bool | None = None):
     """One fused δ ring round on the DOT-WORD layout
     (models.packed.DotPackedAWSetDeltaState): membership bitpacked AND
     both dot pairs fused to one uint32 word each, so the round streams
     two E-shaped arrays where the bool layout streams four uint32
     arrays plus two byte masks (~4.2KB vs ~6.7KB per row at A=E=256 —
-    the north-star schedule's dominant traffic).  v2 semantics only
-    (the north-star/production δ path); bitwise-equal through
-    pack/unpack to pallas_delta_ring_round, pinned by
-    tests/test_packed.py."""
+    the north-star schedule's dominant traffic).  All three δ
+    semantics modes (the strict empty-δ quirk's scratch epilogue is
+    layout-independent); bitwise-equal through pack/unpack to
+    pallas_delta_ring_round, pinned by tests/test_packed.py."""
     from go_crdt_playground_tpu.models.packed import (
         DotPackedAWSetDeltaState)
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    mode = _kernel_mode(delta_semantics, strict_reference_semantics)
     if not ring_supported(state.present_bits.shape[0]):
         raise ValueError("dot-packed ring kernel needs "
                          "ring_supported(R); unpack and use the "
@@ -613,8 +617,8 @@ def pallas_delta_ring_round_dotpacked(state, offset, *,
     vv, proc, pb, dots, db, del_dots = _ring_round_dispatch(
         arrays, offset,
         lambda a, o, al: _fused_delta_ring(a, o, 512, interpret,
-                                           packed_w=w, aligned=al,
-                                           dot_packed=True))
+                                           packed_w=w, mode=mode,
+                                           aligned=al, dot_packed=True))
     return DotPackedAWSetDeltaState(
         vv=vv, present_bits=pb, dots=dots, actor=state.actor,
         deleted_bits=db, del_dots=del_dots, processed=proc)

@@ -402,3 +402,32 @@ def test_dotpacked_ring_round_matches_spec_directly():
          "dot_counter": np.asarray(got.dot_counter),
          "actor": np.asarray(got.actor)}, dictionary)
     assert rendered == [str(s) for s in spec]
+
+
+@pytest.mark.parametrize("offset", [1, 64])
+@pytest.mark.parametrize("semantics,strict", [("reference", True),
+                                              ("reference", False)])
+def test_dotpacked_delta_ring_reference_modes_match_bool(offset, semantics,
+                                                         strict):
+    """The dot-word δ ring under STRICT-REFERENCE semantics (incl. the
+    empty-δ VV-skip scratch epilogue) and the loose variant must match
+    the bool-layout kernel bitwise — the quirk machinery is
+    layout-independent."""
+    import random
+
+    from tests.test_pallas_delta import _scenario_state
+
+    rng = random.Random(97)
+    state = _scenario_state(rng, R, 128, 8)
+    want = pallas_delta.pallas_delta_ring_round(
+        state, offset, delta_semantics=semantics,
+        strict_reference_semantics=strict)
+    got = packed_mod.unpack_awset_delta_dots(
+        pallas_delta.pallas_delta_ring_round_dotpacked(
+            packed_mod.pack_awset_delta_dots(state), offset,
+            delta_semantics=semantics, strict_reference_semantics=strict),
+        128)
+    for name in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, name)),
+            np.asarray(getattr(got, name)), err_msg=name)
