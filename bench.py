@@ -411,6 +411,31 @@ def measure_config3_dotpacked(num_replicas=10_048, num_elements=256,
     }
 
 
+def measure_config4_dotpacked(num_replicas=100_032, num_elements=256,
+                              num_writers=256):
+    """config4's fleet on the δ DOT-WORD layout (both dot pairs as
+    single uint32 words + bitpacked membership): directly comparable to
+    config4's v2 rate, evidencing the ~1.6x HBM cut on the δ path."""
+    from go_crdt_playground_tpu.models import packed as packed_mod
+    from go_crdt_playground_tpu.ops.pallas_delta import (
+        pallas_delta_ring_round_dotpacked)
+
+    state, offsets = _config4_delta_fleet(num_replicas, num_elements,
+                                          num_writers)
+    packed = packed_mod.pack_awset_delta_dots(state)
+    meas = _scan_round_rate(pallas_delta_ring_round_dotpacked, packed,
+                            offsets, start=8, max_n=256, warm_runs=2,
+                            full=True)
+    return {
+        "metric": f"config4_dotpacked: delta-AWSet {num_replicas} "
+                  "replicas, v2 delta gossip, dot-word + bitpacked "
+                  "membership layout",
+        "value": round(num_replicas / meas.per_round_s, 1),
+        "unit": "delta-merges/sec/chip",
+        **meas.stats(num_replicas),
+    }
+
+
 def measure_config5(num_replicas=1_000_000, num_elements=256,
                     num_writers=256):
     """Mixed AWSet + 2P-Set at 1M replicas: one anti-entropy round of
@@ -1033,6 +1058,7 @@ def run_ladder():
              ("config3", config3),
              ("config3_dotpacked", measure_config3_dotpacked),
              ("config4", measure_config4),
+             ("config4_dotpacked", measure_config4_dotpacked),
              ("config4ref", measure_config4_reference),
              ("config5", measure_config5),
              ("config5_awset", measure_config5_awset)]
