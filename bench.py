@@ -8,8 +8,10 @@ chip under the driver), and prints exactly one JSON line:
 
 ``python bench.py --ladder`` measures every config of the BASELINE.md
 measurement ladder (1: conformance-anchor spec rate, 2: GCounter 1K,
-3: AWSet 10K x 256, 4: delta-AWSet 100K gossip, 5: mixed AWSet+2P-Set
-1M), prints one JSON line per config, and writes BENCH_LADDER.json.
+3: AWSet 10K x 256 — plus its dot-word layout variant, 4: delta-AWSet
+100K gossip — plus its dot-word variant and the strict-reference mode,
+5: mixed AWSet+2P-Set 1M — plus the AWSet-only single-family rate),
+prints one JSON line per config, and writes BENCH_LADDER.json.
 
 The reference publishes no numbers (SURVEY §6: no Benchmark* functions,
 README is one line), and no Go toolchain exists in this environment, so
