@@ -172,3 +172,22 @@ def test_northstar_ici_model_math():
     # dominates, so the model must NOT report the linear number
     m64 = bench.northstar_ici_model(1.2, 1 << 20, 256, 256, n_chips=64)
     assert m64["model_s"] == m64["ici_s"] > m64["compute_s"]
+
+
+def test_new_ladder_steps_run_at_tiny_shapes(monkeypatch):
+    """The round-5 ladder steps (dot-word configs, AWSet-only config 5)
+    must run end-to-end at tiny shapes in CI — a signature or dispatch
+    break must not first surface mid-capture in a live TPU window."""
+    orig = bench._scan_round_rate
+
+    def quick(*a, **k):
+        k.update(min_delta=1e-3, max_n=32, repeats=2)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(bench, "_scan_round_rate", quick)
+    r3 = bench.measure_config3_dotpacked(128, 64, 64)
+    r4 = bench.measure_config4_dotpacked(128, 64, 64)
+    r5 = bench.measure_config5_awset(256, 64, 64)
+    for r in (r3, r4, r5):
+        assert r["value"] > 0, r["metric"]
+        assert r["repeats"] >= 1
