@@ -551,17 +551,22 @@ def pallas_delta_ring_round(state: AWSetDeltaState, offset, *,
 
 
 def pallas_delta_ring_round_packed(state, offset, *,
+                                   delta_semantics: str = "v2",
+                                   strict_reference_semantics:
+                                   bool = True,
                                    interpret: bool | None = None):
     """One fused δ ring round on the BITPACKED layout
     (models.packed.PackedAWSetDeltaState): ``present``/``deleted``
     cross HBM as uint32[R, E/32] — 8x less traffic and footprint for
     the two membership arrays (at the north-star fleet that is ~0.5GB
-    of state and ~1GB of peak HBM).  Bitwise-equal through pack/unpack
+    of state and ~1GB of peak HBM).  All three δ semantics modes, like
+    the bool and dot-word wrappers.  Bitwise-equal through pack/unpack
     to pallas_delta_ring_round; pinned by tests/test_packed.py."""
     from go_crdt_playground_tpu.models.packed import PackedAWSetDeltaState
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    mode = _kernel_mode(delta_semantics, strict_reference_semantics)
     if not ring_supported(state.present_bits.shape[0]):
         raise ValueError("packed ring kernel needs ring_supported(R); "
                          "unpack and use the bool-layout paths instead")
@@ -576,7 +581,8 @@ def pallas_delta_ring_round_packed(state, offset, *,
     vv, proc, pb, da, dc, db, dda, ddc = _ring_round_dispatch(
         arrays, offset,
         lambda a, o, al: _fused_delta_ring(a, o, 512, interpret,
-                                           packed_w=w, aligned=al))
+                                           packed_w=w, mode=mode,
+                                           aligned=al))
     return PackedAWSetDeltaState(
         vv=vv, present_bits=pb, dot_actor=da, dot_counter=dc,
         actor=state.actor, deleted_bits=db, del_dot_actor=dda,

@@ -431,3 +431,29 @@ def test_dotpacked_delta_ring_reference_modes_match_bool(offset, semantics,
         np.testing.assert_array_equal(
             np.asarray(getattr(want, name)),
             np.asarray(getattr(got, name)), err_msg=name)
+
+
+@pytest.mark.parametrize("strict", [True, False])
+def test_packed_delta_ring_reference_modes_match_bool(strict):
+    """The bitpacked δ ring under the reference semantics modes matches
+    the bool-layout kernel bitwise (symmetry with the dot-word wrapper)."""
+    import random
+
+    from tests.test_pallas_delta import _scenario_state
+
+    rng = random.Random(101)
+    state = _scenario_state(rng, R, 128, 8)
+    for offset in (1, 64):
+        want = pallas_delta.pallas_delta_ring_round(
+            state, offset, delta_semantics="reference",
+            strict_reference_semantics=strict)
+        got = packed_mod.unpack_awset_delta(
+            pallas_delta.pallas_delta_ring_round_packed(
+                packed_mod.pack_awset_delta(state), offset,
+                delta_semantics="reference",
+                strict_reference_semantics=strict), 128)
+        for name in want._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(want, name)),
+                np.asarray(getattr(got, name)),
+                err_msg=f"{offset}/{name}")
