@@ -50,9 +50,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
 from go_crdt_playground_tpu.ops.pallas_merge import (
-    _BLOCK_R, _DOT_CMASK, _DOT_SHIFT, _ring_round_dispatch, _ring_window,
-    gather_rows, ring_block_specs, ring_meta, ring_supported,
-    row_block_layout)
+    _BLOCK_R, _DOT_CMASK, _DOT_SHIFT, _RING_VMEM_LIMIT,
+    _ring_round_dispatch, _ring_window, gather_rows, ring_block_specs,
+    ring_meta, ring_supported, row_block_layout)
 
 _A_NAMED = ("vv", "processed")
 _E_NAMED = ("present", "dot_actor", "dot_counter", "deleted",
@@ -458,6 +458,7 @@ def _fused_delta_ring(arrays, offset, block_e: int, interpret: bool,
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
+        compiler_params=_RING_VMEM_LIMIT,
     )(meta, *ins)
     if dot_packed:
         vv, proc, pb, dots, db, del_dots = outs

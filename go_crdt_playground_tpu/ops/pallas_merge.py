@@ -513,29 +513,53 @@ def _kernel_unpack_bits(bits, blk_e: int):
     return bit != 0
 
 
+_PACK_SUB = 2048   # elements per pack sub-step (64 words' worth)
+
+
 def _kernel_pack_bits(mask_u8, w: int) -> jnp.ndarray:
     """In-kernel repack: uint8/bool[blk_r, blk_e] -> uint32[blk_r, W]
-    via two exact f32 matmuls (low/high 16 bits of each word; each
-    product sums <= 16 terms < 2^16, exact in f32).  The weight
-    operand is built one full lane group wide (zeros beyond W) so the
-    MXU sees a lane-aligned N dim; the result slices back to W."""
+    via exact f32 matmuls (low/high 16 bits of each word; each product
+    sums <= 16 terms < 2^16, exact in f32).  Elements are processed in
+    _PACK_SUB-wide sub-steps that all share ONE [_PACK_SUB, lane-pad]
+    weight pair, keeping the constant-mask VMEM footprint flat however
+    wide the block grows — a single 4096-wide weight pair is 4MB of
+    scoped VMEM, which pushed the windowed ring form 384KB past the
+    v5e 16MB stack limit at blk_e=4096 (real-chip compile OOM)."""
     blk_r, blk_e = mask_u8.shape
-    w_pad = _round_up(w, _LANE)
     as_i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)  # noqa: E731
-    m = mask_u8.astype(jnp.float32)
-    e_ids = jax.lax.broadcasted_iota(jnp.uint32, (blk_e, w_pad), 0)
-    word = jax.lax.broadcasted_iota(jnp.uint32, (blk_e, w_pad), 1)
+    sub_e = min(_round_up(blk_e, 32), _PACK_SUB)
+    sub_w = sub_e // 32
+    w_pad = _round_up(sub_w, _LANE)
+    e_ids = jax.lax.broadcasted_iota(jnp.uint32, (sub_e, w_pad), 0)
+    word = jax.lax.broadcasted_iota(jnp.uint32, (sub_e, w_pad), 1)
     in_word = (e_ids >> 5) == word
     bit = e_ids & 31
-    w_lo = jnp.where(in_word & (bit < 16),
-                     jnp.uint32(1) << (bit & 15), 0)
-    w_hi = jnp.where(in_word & (bit >= 16),
-                     jnp.uint32(1) << (bit & 15), 0)
-    lo = jnp.dot(m, as_i32(w_lo).astype(jnp.float32),
-                 preferred_element_type=jnp.float32).astype(jnp.int32)
-    hi = jnp.dot(m, as_i32(w_hi).astype(jnp.float32),
-                 preferred_element_type=jnp.float32).astype(jnp.int32)
-    packed = jax.lax.bitcast_convert_type(lo | (hi << 16), jnp.uint32)
+    w_lo = as_i32(jnp.where(in_word & (bit < 16),
+                            jnp.uint32(1) << (bit & 15), 0)
+                  ).astype(jnp.float32)
+    w_hi = as_i32(jnp.where(in_word & (bit >= 16),
+                            jnp.uint32(1) << (bit & 15), 0)
+                  ).astype(jnp.float32)
+    e_total = _round_up(blk_e, sub_e)
+    if e_total != blk_e:   # zero bits pad the ragged tail harmlessly
+        mask_u8 = jnp.concatenate(
+            [mask_u8,
+             jnp.zeros((blk_r, e_total - blk_e), mask_u8.dtype)], axis=1)
+    words = []
+    for e0 in range(0, e_total, sub_e):
+        # Mosaic has no direct uint8->f32 cast; hop through int32 (free
+        # on the VPU).
+        m = jax.lax.slice(mask_u8, (0, e0), (blk_r, e0 + sub_e)
+                          ).astype(jnp.int32).astype(jnp.float32)
+        lo = jnp.dot(m, w_lo,
+                     preferred_element_type=jnp.float32).astype(jnp.int32)
+        hi = jnp.dot(m, w_hi,
+                     preferred_element_type=jnp.float32).astype(jnp.int32)
+        words.append(jax.lax.slice(
+            jax.lax.bitcast_convert_type(lo | (hi << 16), jnp.uint32),
+            (0, 0), (blk_r, sub_w)))
+    packed = words[0] if len(words) == 1 else jnp.concatenate(words,
+                                                              axis=1)
     return jax.lax.slice(packed, (0, 0), (blk_r, w))
 
 
@@ -559,14 +583,24 @@ def _kernel_pack_bits(mask_u8, w: int) -> jnp.ndarray:
 
 _PACK_CHUNK = _LANE * _WORD   # 4096 elements = one 128-lane group of words
 
+# The WINDOWED (3-operand-group) ring form at the tiled blk_e=4096
+# double-buffers ~16.8MB of operand/output blocks — 384KB past Mosaic's
+# 16MB default scoped-VMEM budget, comfortably within the chip's
+# physical VMEM.  Raise the per-kernel cap for the ring kernels; the
+# aligned (2-group) and small-E whole-axis forms never near it.
+_RING_VMEM_LIMIT = pltpu.CompilerParams(
+    vmem_limit_bytes=32 * 1024 * 1024)
+
 
 def _packed_tiling(e_pad: int, packed_w: int):
     """Element/word tiling for the bitpacked ring kernels: one j step
-    per 4096-element chunk (exactly one lane group of words), so the
-    in-kernel unpack's native lane gather never spans more than one
-    group — this is what lifts the old E <= 4096 packed cap — and VMEM
-    per grid step stays bounded however large E grows.  At or below one
-    chunk the word axis rides whole (sub-lane word blocks are fine).
+    per 4096-element chunk (exactly one lane group of words — Pallas
+    requires word-axis blocks divisible by the 128-lane width, so this
+    is also the smallest legal tiled word block), so the in-kernel
+    unpack's native lane gather never spans more than one group — this
+    is what lifts the old E <= 4096 packed cap — and VMEM per grid step
+    stays bounded however large E grows.  At or below one chunk the
+    word axis rides whole (sub-lane word blocks are fine).
 
     Returns (blk_elements, e_pad, words_per_block, total_words)."""
     if e_pad <= _PACK_CHUNK:
@@ -798,6 +832,7 @@ def _fused_rows_ring(dst_arrays, offset, block_e: int, interpret: bool,
             jax.ShapeDtypeStruct((num_r, e_pad), jnp.uint32),
         ],
         interpret=interpret,
+        compiler_params=_RING_VMEM_LIMIT,
     )(meta, *ins)
     out_p = out_p[:, :packed_w] if packed_w else out_p[:, :num_e]
     return (out_vv[:, :num_a], out_p,
@@ -920,6 +955,7 @@ def _fused_rows_ring_dotpacked(arrays, offset, interpret: bool,
             jax.ShapeDtypeStruct((num_r, e_pad), jnp.uint32),
         ],
         interpret=interpret,
+        compiler_params=_RING_VMEM_LIMIT,
     )(meta, *ins)
     return (out_vv[:, :num_a], out_p[:, :packed_w], out_dot[:, :num_e])
 
