@@ -22,11 +22,9 @@ while [ "$runs" -lt "$MAX_RUNS" ]; do
         echo "ALIVE $(date -u) -> capture run $((runs + 1))" >> "$LOG"
         bash tools/capture_all.sh
         runs=$((runs + 1))
-        # If the last step's artifact landed on-chip, the sequence
-        # finished inside one window — stand down.
-        if grep -q '"platform": "tpu"' BENCH_LADDER.json 2>/dev/null \
-            && grep -q '"platform": "tpu"' NORTHSTAR_DOTPACKED.json \
-                2>/dev/null; then
+        # Stand down only when EVERY artifact has landed on-chip
+        # (same predicate set capture_all's per-step skips use).
+        if bash tools/capture_complete.sh; then
             echo "capture complete $(date -u)" >> "$LOG"
             break
         fi
