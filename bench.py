@@ -94,6 +94,34 @@ def measure_tpu(num_replicas=10_048, num_elements=256, num_writers=256,
     return rate
 
 
+def measure_tpu_dotpacked(num_replicas=10_048, num_elements=256,
+                          num_writers=256, full=False):
+    """measure_tpu's fleet on the DOT-WORD layout
+    (models/packed.DotPackedAWSetState): dots fused to one
+    uint32/element + bitpacked membership, ~1.6x less HBM per ring
+    round than the bool layout and bitwise-pinned against it.  Same
+    merge semantics, same metric — the default headline reports
+    whichever layout sustains the higher rate (the layout rides in the
+    JSON line's ``layout`` field)."""
+    import jax.numpy as jnp
+
+    from go_crdt_playground_tpu.models import packed as packed_mod
+    from go_crdt_playground_tpu.ops.pallas_merge import (
+        pallas_ring_round_rows_dotpacked)
+    from go_crdt_playground_tpu.parallel import gossip
+
+    state = packed_mod.pack_awset_dots(
+        build_state(num_replicas, num_elements, num_writers))
+    offsets = jnp.asarray(gossip.dissemination_offsets(num_replicas),
+                          jnp.uint32)
+    meas = _scan_round_rate(pallas_ring_round_rows_dotpacked, state,
+                            offsets, start=64, full=True)
+    rate = num_replicas / meas.per_round_s
+    if full:
+        return rate, meas.stats(num_replicas)
+    return rate
+
+
 def measure_spec_baseline(num_elements=256, merges=60, runs=5,
                           full=False):
     """Single-core dict-model pair-merge rate at the same element count.
@@ -389,27 +417,18 @@ def measure_config3_dotpacked(num_replicas=10_048, num_elements=256,
     """config3's fleet on the DOT-WORD layout (models/packed
     .DotPackedAWSetState): dots fused to one uint32/element + bitpacked
     membership, ~1.6x less HBM per ring round than the bool layout —
-    the committed evidence for the layout's traffic win (round 5)."""
-    from go_crdt_playground_tpu.models import packed as packed_mod
-    from go_crdt_playground_tpu.ops.pallas_merge import (
-        pallas_ring_round_rows_dotpacked)
-    from go_crdt_playground_tpu.parallel import gossip
-
-    import jax.numpy as jnp
-
-    state = packed_mod.pack_awset_dots(
-        build_state(num_replicas, num_elements, num_writers))
-    offsets = jnp.asarray(gossip.dissemination_offsets(num_replicas),
-                          jnp.uint32)
-    meas = _scan_round_rate(pallas_ring_round_rows_dotpacked, state,
-                            offsets, start=64, full=True)
+    the committed evidence for the layout's traffic win (round 5).
+    Delegates to measure_tpu_dotpacked so the ladder step and the
+    default headline's dot-word attempt time the SAME program."""
+    rate, stats = measure_tpu_dotpacked(num_replicas, num_elements,
+                                        num_writers, full=True)
     return {
         "metric": f"config3_dotpacked: AWSet {num_replicas} x "
                   f"{num_elements} ring merge, dot-word + bitpacked "
                   "membership layout",
-        "value": round(num_replicas / meas.per_round_s, 1),
+        "value": round(rate, 1),
         "unit": "merges/sec/chip",
-        **meas.stats(num_replicas),
+        **stats,
     }
 
 
@@ -979,6 +998,7 @@ def run_droprate():
 
 _LADDER_PARTIAL = "BENCH_LADDER.partial.jsonl"
 _DROP_PARTIAL = "DROP_CURVE.partial.jsonl"
+_HEADLINE_PARTIAL = "BENCH_HEADLINE.partial.jsonl"
 
 
 def _read_partial_records(path):
@@ -1031,6 +1051,30 @@ def _persist_partial(path, step, rec):
     with open(path, "a") as f:
         f.write(json.dumps(rec) + "\n")
     return rec
+
+
+def _salvage_headline(errors):
+    """Default-mode salvage: the child completed the bool-layout TPU
+    measurement and persisted it before dying in the optional dot-word
+    attempt — a real on-TPU number beats a CPU fallback.  Prints the
+    salvaged JSON line and returns True when one exists for THIS
+    session; consumes the partial file either way."""
+    if not os.path.exists(_HEADLINE_PARTIAL):
+        return False
+    recs = _read_partial_records(_HEADLINE_PARTIAL)
+    os.remove(_HEADLINE_PARTIAL)
+    sid = _session_id()
+    recs = [r for r in recs if r.get("_session", "") == sid
+            and r.get("platform") == "tpu"]
+    if not recs:
+        return False
+    rec = {k: v for k, v in recs[-1].items()
+           if k not in ("_step", "_session")}
+    rec["note"] = ("salvaged: bool-layout measurement completed; the "
+                   "child died in the optional dot-word attempt: "
+                   + "; ".join(errors))
+    print(json.dumps(rec))
+    return True
 
 
 def run_ladder():
@@ -1123,16 +1167,42 @@ def _child_main():
         return
     import jax
 
+    t_child = time.perf_counter()
     tpu_rate = measure_tpu()
     spec_rate, spec_rates = measure_spec_baseline(full=True)
-    print(json.dumps({
+    rec = {
         "metric": _HEADLINE_METRIC,
         "value": round(tpu_rate, 1),
         "unit": _HEADLINE_UNIT,
         "vs_baseline": round(tpu_rate / spec_rate, 1),
         "baseline_rates_raw": spec_rates,
         "platform": jax.default_backend(),
-    }))
+        "layout": "bool",
+    }
+    if jax.default_backend() == "tpu":
+        # a complete TPU record exists NOW — persist it so a hang in
+        # the optional dot-word attempt below gets salvaged by the
+        # supervisor instead of downgrading an already-measured TPU
+        # number to a CPU fallback
+        _persist_partial(_HEADLINE_PARTIAL, "headline", rec)
+    # Same semantics, less HBM: try the dot-word layout and report the
+    # faster of the two.  TPU-only (the win is an HBM-traffic property)
+    # and time-guarded: the attempt re-measures the same shape, so it
+    # needs its own ~measure_tpu-sized slice of the child wall.
+    if (jax.default_backend() == "tpu"
+            and time.perf_counter() - t_child < 90):
+        try:
+            dot_rate = measure_tpu_dotpacked()
+            rec["bool_layout_rate"] = rec["value"]
+            rec["dotword_rate"] = round(dot_rate, 1)
+            if dot_rate > tpu_rate:
+                rec["value"] = round(dot_rate, 1)
+                rec["vs_baseline"] = round(dot_rate / spec_rate, 1)
+                rec["layout"] = "dot-word"
+        except Exception as exc:   # fall back to the bool number
+            print(f"dot-word headline attempt failed: {exc!r}",
+                  file=sys.stderr)
+    print(json.dumps(rec))
 
 
 def _run_child(env, timeout_s, argv=None):
@@ -1245,6 +1315,8 @@ def main():
                           max(30, int(remaining()) - reserve_s))
             ok, out, why = _run_child(os.environ, child_t)
             if ok:
+                if not ladder and os.path.exists(_HEADLINE_PARTIAL):
+                    os.remove(_HEADLINE_PARTIAL)   # superseded
                 sys.stdout.write(out)
                 return
             errors.append(f"attempt{attempt}({why})")
@@ -1315,6 +1387,9 @@ def main():
             with open(artifact, "w") as f:
                 json.dump(out_recs, f, indent=2)
         sys.exit(1)
+
+    if not ladder and _salvage_headline(errors):
+        return
 
     if not ladder:
         # CPU fallback keeps the round's artifact parseable and honest:

@@ -191,3 +191,41 @@ def test_new_ladder_steps_run_at_tiny_shapes(monkeypatch):
     for r in (r3, r4, r5):
         assert r["value"] > 0, r["metric"]
         assert r["repeats"] >= 1
+
+
+def test_salvage_headline_prefers_session_tpu_record(tmp_path, monkeypatch,
+                                                     capsys):
+    """A bool-layout TPU headline persisted by a child later killed in
+    the optional dot-word attempt must be salvaged (not downgraded to a
+    CPU fallback), consuming the partial file."""
+    monkeypatch.chdir(tmp_path)
+    bench._persist_partial(bench._HEADLINE_PARTIAL, "headline",
+                           {"metric": "m", "value": 80.0,
+                            "platform": "tpu", "layout": "bool"})
+    assert bench._salvage_headline(["attempt1(timeout)"]) is True
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["value"] == 80.0
+    assert rec["platform"] == "tpu"
+    assert "_session" not in rec and "_step" not in rec
+    assert "salvaged" in rec["note"] and "attempt1(timeout)" in rec["note"]
+    assert not (tmp_path / bench._HEADLINE_PARTIAL).exists()
+
+
+def test_salvage_headline_rejects_cpu_and_foreign_sessions(tmp_path,
+                                                           monkeypatch,
+                                                           capsys):
+    monkeypatch.chdir(tmp_path)
+    # cpu record: never salvaged into a headline
+    bench._persist_partial(bench._HEADLINE_PARTIAL, "headline",
+                           {"metric": "m", "value": 1.0, "platform": "cpu"})
+    assert bench._salvage_headline([]) is False
+    assert not (tmp_path / bench._HEADLINE_PARTIAL).exists()
+    # foreign-session tpu record: predates this supervisor run
+    monkeypatch.setenv("CRDT_BENCH_SESSION", "other")
+    bench._persist_partial(bench._HEADLINE_PARTIAL, "headline",
+                           {"metric": "m", "value": 2.0, "platform": "tpu"})
+    monkeypatch.setenv("CRDT_BENCH_SESSION", "test-session")
+    assert bench._salvage_headline([]) is False
+    assert capsys.readouterr().out.strip() == ""
+    # absent file
+    assert bench._salvage_headline([]) is False

@@ -12,10 +12,10 @@
 # gate for trusting the rest), then the artifacts VERDICT r4 ranked.
 set -u
 cd /root/repo
+. tools/capture_predicates.sh
 LOG=/tmp/capture_all.log
 PY=python
 step() { echo "=== $(date -u +%H:%M:%S) $1" >> "$LOG"; }
-on_tpu() { grep -q '"platform": "tpu"' "$1" 2>/dev/null; }
 commit_if_changed() {  # $1 = message, $2.. = paths
     # Pathspec'd add AND commit: an unattended evidence commit must
     # never sweep up unrelated changes someone has staged.
@@ -33,7 +33,12 @@ else
         tests/test_tpu_smoke.py -q > /tmp/smoke.out 2>&1
     SMOKE_RC=$?
     tail -40 /tmp/smoke.out >> "$LOG"
-    if [ "$SMOKE_RC" -eq 0 ]; then
+    # rc=0 alone is NOT proof of an on-chip run: without a TPU backend
+    # the suite module-skips and pytest still exits 0.  Only a summary
+    # line of pure passes counts as on-chip evidence.
+    if [ "$SMOKE_RC" -eq 0 ] \
+        && tail -1 /tmp/smoke.out | grep -qE '[0-9]+ passed' \
+        && ! tail -1 /tmp/smoke.out | grep -qE 'skipped|failed|error'; then
         $PY - <<'EOF'
 import json, datetime
 tail = open("/tmp/smoke.out").read().strip().splitlines()[-1]
@@ -86,15 +91,7 @@ fi
 # but it is also the longest step, so it sits after the short ones.
 # Its supervisor salvages per-config partials, so even a window that
 # dies mid-ladder advances the capture.
-if on_tpu BENCH_LADDER.json && $PY - <<'EOF'
-import json, sys
-entries = json.load(open("BENCH_LADDER.json"))
-mets = " ".join(e.get("metric", "") for e in entries)
-need = ("config4ref", "config3_dotpacked", "config4_dotpacked",
-        "config5_awset")
-sys.exit(0 if all(n in mets for n in need) else 1)
-EOF
-then
+if ladder_r5_complete; then
     step "ladder: round-5 steps already on chip, skipping"
 else
     step "ladder"
@@ -114,9 +111,7 @@ else
             NORTHSTAR_DOTPACKED.json
 fi
 
-if on_tpu NORTHSTAR.json && $PY -c \
-    "import json,sys; sys.exit(0 if 'v5e4_model' in json.load(open('NORTHSTAR.json')) else 1)"
-then
+if northstar_modeled; then
     step "north star: measured + modeled, skipping refresh"
 else
     step "north star refresh (ICI model)"

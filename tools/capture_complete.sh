@@ -1,25 +1,12 @@
 #!/bin/bash
 # Exit 0 iff every round-5 on-chip evidence artifact has landed.
-# Shared by capture_all.sh (per-step skips mirror these predicates) and
-# capture_watcher.sh (stand-down check) so the two can never disagree
-# about what "done" means.
+# Predicates live in capture_predicates.sh, shared with capture_all.sh.
 cd /root/repo
-on_tpu() { grep -q '"platform": "tpu"' "$1" 2>/dev/null; }
+. tools/capture_predicates.sh
 on_tpu TPU_SMOKE_r05.json || exit 1
 on_tpu BENCH_SESSION_r05.json || exit 1
 on_tpu DROP_CURVE.json || exit 1
 on_tpu NORTHSTAR_PACKED.json || exit 1
 on_tpu NORTHSTAR_DOTPACKED.json || exit 1
-on_tpu NORTHSTAR.json || exit 1
-python -c "import json, sys; \
-    sys.exit(0 if 'v5e4_model' in json.load(open('NORTHSTAR.json')) \
-    else 1)" || exit 1
-on_tpu BENCH_LADDER.json || exit 1
-python - <<'EOF'
-import json, sys
-entries = json.load(open("BENCH_LADDER.json"))
-mets = " ".join(e.get("metric", "") for e in entries)
-need = ("config4ref", "config3_dotpacked", "config4_dotpacked",
-        "config5_awset")
-sys.exit(0 if all(n in mets for n in need) else 1)
-EOF
+northstar_modeled || exit 1
+ladder_r5_complete || exit 1

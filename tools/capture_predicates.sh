@@ -1,0 +1,23 @@
+# Shared evidence predicates, sourced by capture_all.sh (per-step
+# skips) and capture_complete.sh (watcher stand-down) so the two can
+# never disagree about what "captured" means.  shellcheck shell=bash
+on_tpu() { grep -q '"platform": "tpu"' "$1" 2>/dev/null; }
+
+ladder_r5_complete() {
+    on_tpu BENCH_LADDER.json || return 1
+    python - <<'EOF'
+import json, sys
+entries = json.load(open("BENCH_LADDER.json"))
+mets = " ".join(e.get("metric", "") for e in entries)
+need = ("config4ref", "config3_dotpacked", "config4_dotpacked",
+        "config5_awset")
+sys.exit(0 if all(n in mets for n in need) else 1)
+EOF
+}
+
+northstar_modeled() {
+    on_tpu NORTHSTAR.json || return 1
+    python -c "import json, sys; \
+        sys.exit(0 if 'v5e4_model' in json.load(open('NORTHSTAR.json')) \
+        else 1)"
+}
