@@ -585,11 +585,13 @@ _PACK_CHUNK = _LANE * _WORD   # 4096 elements = one 128-lane group of words
 
 # The WINDOWED (3-operand-group) ring form at the tiled blk_e=4096
 # double-buffers ~16.8MB of operand/output blocks — 384KB past Mosaic's
-# 16MB default scoped-VMEM budget, comfortably within the chip's
-# physical VMEM.  Raise the per-kernel cap for the ring kernels; the
-# aligned (2-group) and small-E whole-axis forms never near it.
+# 16MB default scoped-VMEM budget (a compiler flag default, not the
+# hardware: physical VMEM is far larger), and the δ twin carries FOUR
+# unpacked uint32 E-arrays (~35MB double-buffered).  Raise the
+# per-kernel cap for the ring kernels; the aligned (2-group) and
+# small-E whole-axis forms never near it.
 _RING_VMEM_LIMIT = pltpu.CompilerParams(
-    vmem_limit_bytes=32 * 1024 * 1024)
+    vmem_limit_bytes=64 * 1024 * 1024)
 
 
 def _packed_tiling(e_pad: int, packed_w: int):

@@ -37,11 +37,13 @@ R = 2 * pallas_merge._BLOCK_R
 E, A = 256, 256
 
 
-def _merge_state(seed=0):
+def _merge_state_wide(seed, num_e):
     rng = np.random.default_rng(seed)
-    present = rng.random((R, E)) < 0.5
-    da = np.where(present, rng.integers(0, A, (R, E)), 0).astype(np.uint32)
-    dc = np.where(present, rng.integers(1, 9, (R, E)), 0).astype(np.uint32)
+    present = rng.random((R, num_e)) < 0.5
+    da = np.where(present, rng.integers(0, A, (R, num_e)),
+                  0).astype(np.uint32)
+    dc = np.where(present, rng.integers(1, 9, (R, num_e)),
+                  0).astype(np.uint32)
     from go_crdt_playground_tpu.models.awset import AWSetState
 
     return AWSetState(
@@ -51,12 +53,18 @@ def _merge_state(seed=0):
         actor=jnp.arange(R, dtype=jnp.uint32) % A)
 
 
-def _delta_state(seed=1):
-    base = _merge_state(seed)
+def _merge_state(seed=0):
+    return _merge_state_wide(seed, E)
+
+
+def _delta_state(seed=1, num_e=E):
+    base = _merge_state_wide(seed, num_e)
     rng = np.random.default_rng(seed + 100)
-    deleted = rng.random((R, E)) < 0.1
-    dda = np.where(deleted, rng.integers(0, A, (R, E)), 0).astype(np.uint32)
-    ddc = np.where(deleted, rng.integers(0, 5, (R, E)), 0).astype(np.uint32)
+    deleted = rng.random((R, num_e)) < 0.1
+    dda = np.where(deleted, rng.integers(0, A, (R, num_e)),
+                   0).astype(np.uint32)
+    ddc = np.where(deleted, rng.integers(0, 5, (R, num_e)),
+                   0).astype(np.uint32)
     return awset_delta.AWSetDeltaState(
         vv=base.vv, present=base.present, dot_actor=base.dot_actor,
         dot_counter=base.dot_counter, actor=base.actor,
@@ -179,19 +187,8 @@ def test_packed_word_tiling_mosaic(num_e):
     and agree with the bool layout on the real chip — interpret-mode CI
     cannot prove the lowering."""
     from go_crdt_playground_tpu.models import packed as packed_mod
-    from go_crdt_playground_tpu.models.awset import AWSetState
 
-    rng = np.random.default_rng(11)
-    present = rng.random((R, num_e)) < 0.4
-    da = np.where(present, rng.integers(0, A, (R, num_e)),
-                  0).astype(np.uint32)
-    dc = np.where(present, rng.integers(1, 9, (R, num_e)),
-                  0).astype(np.uint32)
-    state = AWSetState(
-        vv=jnp.asarray(rng.integers(0, 10, (R, A)).astype(np.uint32)),
-        present=jnp.asarray(present), dot_actor=jnp.asarray(da),
-        dot_counter=jnp.asarray(dc),
-        actor=jnp.arange(R, dtype=jnp.uint32) % A)
+    state = _merge_state_wide(11, num_e)
     for offset in (3, 64):
         want = pallas_merge.pallas_ring_round_rows(state, offset,
                                                    interpret=False)
@@ -251,6 +248,34 @@ def test_dotpacked_ring_kernel_mosaic(offset):
             packed_mod.pack_awset_dots(state), offset,
             interpret=False), E)
     _assert_equal(want, got)
+
+
+@pytest.mark.parametrize("kind", ["packed", "dots"])
+def test_delta_word_tiling_mosaic(kind):
+    """The word-tiled δ grids beyond E=4096 must Mosaic-compile and
+    agree with the bool layout on-chip.  The packed (non-dot-word) form
+    carries FOUR unpacked uint32 E-arrays and is the largest
+    windowed-form VMEM demand of any kernel (_RING_VMEM_LIMIT's
+    sizing case); offset 3 exercises that windowed form, 64 the
+    aligned one."""
+    from go_crdt_playground_tpu.models import packed as packed_mod
+
+    num_e = 8192
+    state = _delta_state(21, num_e)
+    for offset in (3, 64):
+        want = pallas_delta.pallas_delta_ring_round(state, offset,
+                                                    interpret=False)
+        if kind == "packed":
+            got = packed_mod.unpack_awset_delta(
+                pallas_delta.pallas_delta_ring_round_packed(
+                    packed_mod.pack_awset_delta(state), offset,
+                    interpret=False), num_e)
+        else:
+            got = packed_mod.unpack_awset_delta_dots(
+                pallas_delta.pallas_delta_ring_round_dotpacked(
+                    packed_mod.pack_awset_delta_dots(state), offset,
+                    interpret=False), num_e)
+        _assert_equal(want, got)
 
 
 @pytest.mark.parametrize("offset", [1, 65])
