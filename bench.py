@@ -1000,6 +1000,13 @@ _LADDER_PARTIAL = "BENCH_LADDER.partial.jsonl"
 _DROP_PARTIAL = "DROP_CURVE.partial.jsonl"
 _HEADLINE_PARTIAL = "BENCH_HEADLINE.partial.jsonl"
 
+# Canonical artifact order for ladder steps — shared by run_ladder and
+# the supervisor's salvage writer so partial sessions keep the same
+# config1..config5 positional layout every round's artifact has used.
+_LADDER_ORDER = ("config1", "config2", "config3", "config3_dotpacked",
+                 "config4", "config4_dotpacked", "config4ref",
+                 "config5", "config5_awset")
+
 
 def _read_partial_records(path):
     """Every parseable record in a partial file.  A child killed mid-write
@@ -1108,7 +1115,18 @@ def run_ladder():
              ("config4ref", measure_config4_reference),
              ("config5", measure_config5),
              ("config5_awset", measure_config5_awset)]
-    results = []
+    canonical = [s for s, _ in steps]
+    assert canonical == list(_LADDER_ORDER), "keep _LADDER_ORDER in sync"
+    # EXECUTION order puts the round-5 additions first: tunnel windows
+    # run ~15 minutes, so evidence that has never been captured must
+    # land before re-measurement of configs already committed from
+    # round 4.  The artifact itself stays in canonical config order,
+    # and a window that dies mid-session still salvages honestly
+    # (INCOMPLETE note) whichever steps completed.
+    new_first = ("config3_dotpacked", "config4_dotpacked", "config4ref",
+                 "config5_awset")
+    steps.sort(key=lambda sf: sf[0] not in new_first)  # stable
+    recs = {}
     for step, fn in steps:
         if step in done:
             rec = done[step]
@@ -1116,9 +1134,10 @@ def run_ladder():
             rec = fn()
             rec["platform"] = platform
             rec = _persist_partial(_LADDER_PARTIAL, step, rec)
-        results.append({k: v for k, v in rec.items()
-                        if k not in ("_step", "_session")})
-        print(json.dumps(results[-1]), flush=True)
+        recs[step] = {k: v for k, v in rec.items()
+                      if k not in ("_step", "_session")}
+        print(json.dumps(recs[step]), flush=True)
+    results = [recs[s] for s in canonical]
     with open("BENCH_LADDER.json", "w") as f:
         json.dump(results, f, indent=2)
     os.remove(_LADDER_PARTIAL)
@@ -1379,9 +1398,13 @@ def main():
             with open(artifact, "w") as f:
                 json.dump(out, f, indent=2)
         else:
-            out_recs = [dict({k: v for k, v in r.items()
+            ordered = sorted(
+                by_step, key=lambda s: (_LADDER_ORDER.index(s)
+                                        if s in _LADDER_ORDER
+                                        else len(_LADDER_ORDER)))
+            out_recs = [dict({k: v for k, v in by_step[s].items()
                               if k not in ("_step", "_session")},
-                             note=note) for r in by_step.values()]
+                             note=note) for s in ordered]
             for rec in out_recs:
                 print(json.dumps(rec))
             with open(artifact, "w") as f:

@@ -229,3 +229,43 @@ def test_salvage_headline_rejects_cpu_and_foreign_sessions(tmp_path,
     assert capsys.readouterr().out.strip() == ""
     # absent file
     assert bench._salvage_headline([]) is False
+
+
+def test_run_ladder_executes_new_steps_first_writes_canonical(tmp_path,
+                                                              monkeypatch,
+                                                              capsys):
+    """Execution order puts the never-captured round-5 steps first (a
+    ~15-min tunnel window must land missing evidence before
+    re-measuring committed configs), while the artifact keeps canonical
+    config order."""
+    monkeypatch.chdir(tmp_path)
+    order = []
+
+    def mk(name):
+        def fn(*a, **k):
+            order.append(name)
+            return {"metric": f"{name}: stub", "value": 1.0, "unit": "x"}
+        return fn
+
+    for name, attr in [("config1", "measure_config1"),
+                       ("config2", "measure_config2"),
+                       ("config3_dotpacked", "measure_config3_dotpacked"),
+                       ("config4", "measure_config4"),
+                       ("config4_dotpacked", "measure_config4_dotpacked"),
+                       ("config4ref", "measure_config4_reference"),
+                       ("config5", "measure_config5"),
+                       ("config5_awset", "measure_config5_awset")]:
+        monkeypatch.setattr(bench, attr, mk(name))
+    monkeypatch.setattr(bench, "measure_spec_baseline",
+                        lambda full=True: (1.0, [1.0]))
+    monkeypatch.setattr(bench, "measure_tpu",
+                        lambda full=False: (1.0, {}) if full else 1.0)
+    results = bench.run_ladder()
+    assert order[:4] == ["config3_dotpacked", "config4_dotpacked",
+                        "config4ref", "config5_awset"]
+    mets = [r["metric"].split(":")[0] for r in results]
+    assert mets == ["config1", "config2", "config3", "config3_dotpacked",
+                    "config4", "config4_dotpacked", "config4ref",
+                    "config5", "config5_awset"]
+    assert (tmp_path / "BENCH_LADDER.json").exists()
+    assert not (tmp_path / bench._LADDER_PARTIAL).exists()
