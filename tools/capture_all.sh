@@ -70,7 +70,12 @@ if on_tpu DROP_CURVE.json; then
     step "drop curve: already on chip, skipping"
 else
     step "drop curve"
-    timeout -k 10 1500 $PY bench.py --droprate >> "$LOG" 2>&1
+    # Inner supervisor budget < outer timeout: the supervisor must
+    # always outlive its children so it can salvage partials itself —
+    # an outer kill would orphan the partial file, and the next run's
+    # fresh session id ignores it by design.
+    CRDT_BENCH_TIMEOUT_S=1200 CRDT_BENCH_TOTAL_BUDGET_S=1350 \
+        timeout -k 10 1500 $PY bench.py --droprate >> "$LOG" 2>&1
     on_tpu DROP_CURVE.json && \
         commit_if_changed "On-chip DROP_CURVE: rounds-to-convergence + tpu_round_ms" \
             DROP_CURVE.json
@@ -80,7 +85,9 @@ if on_tpu NORTHSTAR_PACKED.json; then
     step "packed north star: already on chip, skipping"
 else
     step "packed north star"
-    CRDT_NORTHSTAR_PACKED=1 timeout -k 10 1500 $PY bench.py --northstar >> "$LOG" 2>&1
+    CRDT_NORTHSTAR_PACKED=1 CRDT_BENCH_TIMEOUT_S=1200 \
+        CRDT_BENCH_TOTAL_BUDGET_S=1350 \
+        timeout -k 10 1500 $PY bench.py --northstar >> "$LOG" 2>&1
     on_tpu NORTHSTAR_PACKED.json && \
         commit_if_changed "NORTHSTAR_PACKED: packed-layout north-star run on chip" \
             NORTHSTAR_PACKED.json
@@ -95,7 +102,8 @@ if ladder_r5_complete; then
     step "ladder: round-5 steps already on chip, skipping"
 else
     step "ladder"
-    timeout -k 10 2700 $PY bench.py --ladder >> "$LOG" 2>&1
+    CRDT_BENCH_TIMEOUT_S=2200 CRDT_BENCH_TOTAL_BUDGET_S=2400 \
+        timeout -k 10 2700 $PY bench.py --ladder >> "$LOG" 2>&1
     on_tpu BENCH_LADDER.json && \
         commit_if_changed "On-chip nine-step ladder (config4ref, dot-word, config5_awset)" \
             BENCH_LADDER.json
@@ -105,7 +113,9 @@ if on_tpu NORTHSTAR_DOTPACKED.json; then
     step "dot-word north star: already on chip, skipping"
 else
     step "dot-word north star"
-    CRDT_NORTHSTAR_PACKED=dots timeout -k 10 1500 $PY bench.py --northstar >> "$LOG" 2>&1
+    CRDT_NORTHSTAR_PACKED=dots CRDT_BENCH_TIMEOUT_S=1200 \
+        CRDT_BENCH_TOTAL_BUDGET_S=1350 \
+        timeout -k 10 1500 $PY bench.py --northstar >> "$LOG" 2>&1
     on_tpu NORTHSTAR_DOTPACKED.json && \
         commit_if_changed "NORTHSTAR_DOTPACKED: dot-word-layout north-star run on chip" \
             NORTHSTAR_DOTPACKED.json
@@ -115,7 +125,8 @@ if northstar_modeled; then
     step "north star: measured + modeled, skipping refresh"
 else
     step "north star refresh (ICI model)"
-    timeout -k 10 1500 $PY bench.py --northstar >> "$LOG" 2>&1
+    CRDT_BENCH_TIMEOUT_S=1200 CRDT_BENCH_TOTAL_BUDGET_S=1350 \
+        timeout -k 10 1500 $PY bench.py --northstar >> "$LOG" 2>&1
     on_tpu NORTHSTAR.json && \
         commit_if_changed "NORTHSTAR refresh: ICI-aware v5e-4 model alongside the measurement" \
             NORTHSTAR.json

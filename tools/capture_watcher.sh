@@ -12,7 +12,7 @@
 set -u
 cd /root/repo
 LOG=/tmp/capture_watcher.log
-MAX_RUNS=${MAX_RUNS:-3}
+MAX_RUNS=${MAX_RUNS:-10}
 runs=0
 echo "watcher armed $(date -u)" >> "$LOG"
 while [ "$runs" -lt "$MAX_RUNS" ]; do
