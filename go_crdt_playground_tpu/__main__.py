@@ -105,6 +105,13 @@ def _cmd_serve(port: int) -> int:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="go_crdt_playground_tpu")
+    p.add_argument("--platform", default="auto",
+                   choices=("auto", "cpu", "tpu"),
+                   help="pin the JAX backend before first device use. "
+                        "'cpu' escapes a dead remote-TPU tunnel: the "
+                        "axon plugin ignores the JAX_PLATFORMS env "
+                        "var, so an in-process pin is the only way to "
+                        "keep the CLI usable when the tunnel is down")
     sub = p.add_subparsers(dest="cmd", required=True)
     sub.add_parser("scenario")
     g = sub.add_parser("gossip")
@@ -130,6 +137,15 @@ def main(argv=None) -> int:
     s = sub.add_parser("serve")
     s.add_argument("--port", type=int, default=0)
     args = p.parse_args(argv)
+    if args.platform != "auto":
+        import jax
+
+        # 'tpu' resolves as a priority list: the remote-TPU plugin
+        # registers its platform as 'axon' while a real on-host TPU
+        # registers 'tpu' — first available wins either way.
+        jax.config.update("jax_platforms",
+                          "tpu,axon" if args.platform == "tpu"
+                          else args.platform)
     if args.cmd == "scenario":
         return _cmd_scenario()
     if args.cmd == "gossip":

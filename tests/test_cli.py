@@ -121,3 +121,16 @@ def test_gossip_command_schedule_flag(capsys):
     assert "random rounds" in capsys.readouterr().out
     assert main(["gossip", "--replicas", "8", "--schedule", "ring"]) == 0
     assert "ring rounds" in capsys.readouterr().out
+
+
+def test_platform_flag_pins_backend(capsys):
+    """--platform cpu pins the backend in-process (the axon TPU plugin
+    ignores JAX_PLATFORMS, so this flag is the only way the CLI stays
+    usable when the remote tunnel is down).  Asserting the config value
+    pins the wiring itself — under the conftest the scenario would pass
+    even without the pin."""
+    import jax
+
+    assert main(["--platform", "cpu", "scenario"]) == 0
+    assert jax.config.jax_platforms == "cpu"
+    assert "add-wins holds: True" in capsys.readouterr().out
