@@ -54,8 +54,8 @@ EOF
     step "smoke rc=$SMOKE_RC: $(tail -1 /tmp/smoke.out)"
 fi
 
-if on_tpu BENCH_SESSION_r05.json; then
-    step "headline: already on chip, skipping"
+if headline_complete; then
+    step "headline: already on chip (layout race included), skipping"
 else
     step "headline (driver contract)"
     timeout -k 10 700 $PY bench.py > /tmp/headline.json 2>> "$LOG"

@@ -4,7 +4,7 @@
 cd /root/repo
 . tools/capture_predicates.sh
 on_tpu TPU_SMOKE_r05.json || exit 1
-on_tpu BENCH_SESSION_r05.json || exit 1
+headline_complete || exit 1
 on_tpu DROP_CURVE.json || exit 1
 on_tpu NORTHSTAR_PACKED.json || exit 1
 on_tpu NORTHSTAR_DOTPACKED.json || exit 1

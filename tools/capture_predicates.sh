@@ -15,6 +15,14 @@ sys.exit(0 if all(n in mets for n in need) else 1)
 EOF
 }
 
+headline_complete() {
+    # Captured by the CURRENT default mode (which races the dot-word
+    # layout against bool and reports the faster): a pre-race capture
+    # lacks the layout field and deserves a re-run.
+    on_tpu BENCH_SESSION_r05.json \
+        && grep -q '"layout"' BENCH_SESSION_r05.json 2>/dev/null
+}
+
 northstar_modeled() {
     on_tpu NORTHSTAR.json || return 1
     python -c "import json, sys; \

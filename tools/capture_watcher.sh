@@ -29,6 +29,6 @@ while [ "$runs" -lt "$MAX_RUNS" ]; do
             break
         fi
     fi
-    sleep 240
+    sleep 150
 done
 echo "watcher exiting $(date -u)" >> "$LOG"
