@@ -269,3 +269,33 @@ def test_run_ladder_executes_new_steps_first_writes_canonical(tmp_path,
                     "config5", "config5_awset"]
     assert (tmp_path / "BENCH_LADDER.json").exists()
     assert not (tmp_path / bench._LADDER_PARTIAL).exists()
+
+
+def test_driver_preempts_capture_group(monkeypatch, tmp_path):
+    """The driver's bench run must kill an active capture process group
+    (chip arbitration: an unattended capture sharing the TPU would
+    halve the judged headline) and clean up stale markers."""
+    import os
+    import subprocess
+    import time
+
+    cap = str(tmp_path / "capture.active")
+    drv = str(tmp_path / "driver.active")
+    monkeypatch.setattr(bench, "_CAPTURE_MARKER", cap)
+    monkeypatch.setattr(bench, "_DRIVER_MARKER", drv)
+    p = subprocess.Popen(["sleep", "30"], start_new_session=True)
+    Path(cap).write_text(str(p.pid))
+    bench._preempt_capture()
+    time.sleep(0.5)
+    assert p.poll() is not None
+    assert not Path(cap).exists()
+    bench._post_driver_marker()
+    assert Path(drv).read_text() == str(os.getpid())
+    # stale marker: a REAL dead pgid (own session, reaped) — a literal
+    # like 999999 could name a live group under a raised pid_max and
+    # the preempt would kill an unrelated process
+    dead = subprocess.Popen(["true"], start_new_session=True)
+    dead.wait()
+    Path(cap).write_text(str(dead.pid))
+    bench._preempt_capture()
+    assert not Path(cap).exists()

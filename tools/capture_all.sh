@@ -12,10 +12,29 @@
 # gate for trusting the rest), then the artifacts VERDICT r4 ranked.
 set -u
 cd /root/repo
+# Chip arbitration with the driver's round-end bench (which preempts
+# this whole process group via killpg on the advertised pgid): the
+# marker must carry a REAL group-leader id, so re-exec under setsid
+# when this shell is not its own group leader (direct `bash
+# tools/capture_all.sh` from another script, cron, ...).
+if [ "$(ps -o pgid= -p $$ | tr -d ' ')" != "$$" ]; then
+    exec setsid -w bash "$0" "$@"
+fi
 . tools/capture_predicates.sh
 LOG=/tmp/capture_all.log
 PY=python
-step() { echo "=== $(date -u +%H:%M:%S) $1" >> "$LOG"; }
+export CRDT_CAPTURE_STEP=1
+echo "$$" > /tmp/crdt_capture.active
+trap 'rm -f /tmp/crdt_capture.active' EXIT
+wait_driver() {
+    while [ -f /tmp/crdt_driver_bench.active ]; do
+        local pid
+        pid=$(cat /tmp/crdt_driver_bench.active 2>/dev/null)
+        kill -0 "$pid" 2>/dev/null || { rm -f /tmp/crdt_driver_bench.active; break; }
+        sleep 10
+    done
+}
+step() { echo "=== $(date -u +%H:%M:%S) $1" >> "$LOG"; wait_driver; }
 commit_if_changed() {  # $1 = message, $2.. = paths
     # Pathspec'd add AND commit: an unattended evidence commit must
     # never sweep up unrelated changes someone has staged.

@@ -20,7 +20,11 @@ while [ "$runs" -lt "$MAX_RUNS" ]; do
         "import jax, jax.numpy as jnp; assert jax.devices(); print(float(jnp.ones((4,4)).sum()))" \
         >> "$LOG" 2>&1; then
         echo "ALIVE $(date -u) -> capture run $((runs + 1))" >> "$LOG"
-        bash tools/capture_all.sh
+        # Own session/process group: the driver's round-end bench
+        # preempts a capture by killpg on the pid capture_all posts,
+        # which must take out the capture tree WITHOUT the watcher
+        # (it should survive to re-arm).  -w keeps this sequential.
+        setsid -w bash tools/capture_all.sh
         runs=$((runs + 1))
         # Stand down only when EVERY artifact has landed on-chip
         # (same predicate set capture_all's per-step skips use).
