@@ -299,3 +299,23 @@ def test_driver_preempts_capture_group(monkeypatch, tmp_path):
     Path(cap).write_text(str(dead.pid))
     bench._preempt_capture()
     assert not Path(cap).exists()
+
+
+def test_roofline_row_bytes_and_artifact(tmp_path, monkeypatch, capsys):
+    """The static HBM model's row-bytes must match the regime notes'
+    audited figures (BASELINE.md config 3: 3,328 B/row bool, 100.3MB
+    aligned round; DESIGN 11: ~2.1KB dot-word, ~6.7KB delta bool)."""
+    assert bench._row_bytes(256, 256, "awset", "bool") == 3328
+    assert bench._row_bytes(256, 256, "awset", "dots") == 2080
+    assert bench._row_bytes(256, 256, "delta", "bool") == 6656
+    assert bench._row_bytes(256, 256, "delta", "dots") == 4160
+    monkeypatch.chdir(tmp_path)   # no BENCH_LADDER.json here
+    out = bench.run_roofline()
+    assert (tmp_path / "ROOFLINE.json").exists()
+    by_cfg = {r["config"]: r for r in out["rows"]}
+    assert by_cfg["config3"]["aligned_round_mb"] == 100.3
+    assert by_cfg["config3"]["roofline_round_ms"] == 0.1225
+    assert by_cfg["config3_dotpacked"]["roofline_rate"] > \
+        by_cfg["config3"]["roofline_rate"] * 1.5
+    assert "measured_rate" not in by_cfg["config3"]
+    json.loads(capsys.readouterr().out.strip())

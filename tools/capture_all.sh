@@ -24,13 +24,23 @@ fi
 LOG=/tmp/capture_all.log
 PY=python
 export CRDT_CAPTURE_STEP=1
-echo "$$" > /tmp/crdt_capture.active
+echo "$$" > /tmp/crdt_capture.active.$$ && \
+    mv /tmp/crdt_capture.active.$$ /tmp/crdt_capture.active   # atomic
 trap 'rm -f /tmp/crdt_capture.active' EXIT
 wait_driver() {
     while [ -f /tmp/crdt_driver_bench.active ]; do
-        local pid
+        local pid age
         pid=$(cat /tmp/crdt_driver_bench.active 2>/dev/null)
-        kill -0 "$pid" 2>/dev/null || { rm -f /tmp/crdt_driver_bench.active; break; }
+        # staleness bound: a SIGKILLed driver never removes its marker
+        # and its pid can be recycled, so kill -0 alone could stall
+        # captures forever.  No driver bench run outlives ~15 min;
+        # anything older is stale regardless of pid liveness.
+        age=$(( $(date +%s) - $(stat -c %Y /tmp/crdt_driver_bench.active \
+                                2>/dev/null || echo 0) ))
+        if [ "$age" -gt 1800 ] || ! kill -0 "$pid" 2>/dev/null; then
+            rm -f /tmp/crdt_driver_bench.active
+            break
+        fi
         sleep 10
     done
 }
@@ -150,5 +160,13 @@ else
         commit_if_changed "NORTHSTAR refresh: ICI-aware v5e-4 model alongside the measurement" \
             NORTHSTAR.json
 fi
+
+# Always refresh the static roofline model last: it joins measured
+# rates from whatever artifacts the steps above just landed (cheap,
+# no device needed).
+step "roofline refresh"
+$PY bench.py --roofline >> "$LOG" 2>&1
+commit_if_changed "ROOFLINE refresh: measured joins from the new captures" \
+    ROOFLINE.json
 
 step "done"
