@@ -1,0 +1,20 @@
+"""The examples/ scripts are the switching user's first session — they
+must stay runnable exactly as documented (python examples/<name>.py
+from the repo root, no install)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.mark.parametrize("name", ["quickstart.py", "tcp_sync.py"])
+def test_example_runs_verbatim(name):
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / name)],
+        capture_output=True, text=True, timeout=240, cwd=str(REPO))
+    assert proc.returncode == 0, proc.stderr
+    assert "OK" in proc.stdout
