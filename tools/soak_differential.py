@@ -6,8 +6,10 @@ re-asserts the same equalities, hunting the rare divergence a fixed
 seed can't reach.  Families covered per iteration:
 
   * full-state: XLA gossip_round vs fused ring (bool) vs bitpacked vs
-    dot-word, windowed AND aligned offsets;
-  * delta: v2 bool ring vs bitpacked vs dot-word ring.
+    dot-word, windowed AND aligned offsets; plus random and butterfly
+    permutations through the general-perm fused kernel;
+  * delta: v2 bool ring vs bitpacked vs dot-word ring, plus the
+    strict-reference mode (fused empty-delta VV-skip) vs XLA.
 
 Run:  python tools/soak_differential.py [minutes]   (default 30)
 Progress + any failure reproducer seed goes to stdout; nonzero exit on
@@ -92,6 +94,18 @@ def one_iteration(seed):
             packed_mod.pack_awset_dots(state), offset), num_e)
     assert_equal(want, got_d, "dotword-ring")
 
+    # general permutations through the non-ring fused kernel
+    perm = jnp.asarray(rng.permutation(num_r).astype(np.uint32))
+    assert_equal(gossip.gossip_round(state, perm, kernel="xla"),
+                 pallas_merge.pallas_gossip_round_rows(state, perm),
+                 "random-perm")
+    if num_r & (num_r - 1) == 0:   # butterfly needs a power of two
+        stage = int(rng.integers(0, num_r.bit_length() - 1))
+        bperm = gossip.butterfly_perm(num_r, stage)
+        assert_equal(gossip.gossip_round(state, bperm, kernel="xla"),
+                     pallas_merge.pallas_gossip_round_rows(state, bperm),
+                     "butterfly-perm")
+
     dstate = rand_delta_state(rng, num_r, num_e, num_a)
     dwant = pallas_delta.pallas_delta_ring_round(dstate, offset)
     dgot_p = packed_mod.unpack_awset_delta(
@@ -102,6 +116,16 @@ def one_iteration(seed):
         pallas_delta.pallas_delta_ring_round_dotpacked(
             packed_mod.pack_awset_delta_dots(dstate), offset), num_e)
     assert_equal(dwant, dgot_d, "delta-dotword-ring")
+
+    # strict-reference delta semantics (the fused empty-delta VV-skip)
+    swant = gossip.delta_gossip_round(
+        dstate, gossip.ring_perm(num_r, offset),
+        delta_semantics="reference", strict_reference_semantics=True,
+        kernel="xla")
+    sgot = pallas_delta.pallas_delta_ring_round(
+        dstate, offset, delta_semantics="reference",
+        strict_reference_semantics=True)
+    assert_equal(swant, sgot, "delta-strict-reference-ring")
 
 
 def main() -> int:
@@ -118,6 +142,10 @@ def main() -> int:
             return 1
         n += 1
         if n % 10 == 0:
+            # fresh shapes every iteration mean fresh executables: the
+            # in-process compile cache grows without bound and the
+            # process eventually dies in LLVM with ENOMEM — drop it
+            jax.clear_caches()
             print(f"{n} iterations clean (last seed {seed})", flush=True)
     print(f"soak complete: {n} iterations, 0 divergences "
           f"(seeds {seed0}..{seed0 + n - 1})", flush=True)
