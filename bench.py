@@ -1607,7 +1607,9 @@ def main():
             rec["note"] = ("ambient (TPU) backend unavailable: "
                            + "; ".join(errors) + " — CPU fallback; "
                            "committed on-chip evidence for this round "
-                           "lives in BENCH_LADDER.json / NORTHSTAR.json "
+                           "lives in BENCH_SESSION_r05.json (this "
+                           "round's in-session driver-contract capture) "
+                           "and BENCH_LADDER.json / NORTHSTAR.json "
                            "(platform fields say tpu)")
             print(json.dumps(rec))
             return
