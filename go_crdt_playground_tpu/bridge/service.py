@@ -245,10 +245,27 @@ class MergerServer:
 
 class MergerClient:
     """Python-side client for the TCP transport (tests and tooling; a Go
-    harness implements the same five-byte header + proto body)."""
+    harness implements the same five-byte header + proto body).
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+    ``backoff``: optional ``utils.backoff.BackoffPolicy`` — when given,
+    the dial retries transient ``OSError`` failures on the shared
+    jittered-exponential schedule (the same policy object the
+    anti-entropy supervisor uses, so bridge tooling and the sync runtime
+    degrade under one tunable law).  The default stays one-shot: an
+    interactive client should fail fast unless its caller opted in."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 backoff=None, backoff_seed: int = 0):
+        if backoff is None:
+            self._sock = socket.create_connection((host, port),
+                                                  timeout=timeout)
+        else:
+            from go_crdt_playground_tpu.utils.backoff import retry_call
+
+            self._sock = retry_call(
+                lambda: socket.create_connection((host, port),
+                                                 timeout=timeout),
+                backoff, retry_on=(OSError,), seed=backoff_seed)
 
     def ping(self) -> bool:
         send_frame(self._sock, METHOD_PING, b"")

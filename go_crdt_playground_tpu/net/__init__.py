@@ -10,4 +10,12 @@ exchange exactly the payload the reference's ``MakeDeltaMergeData``
 models, and apply it with the same kernels the on-chip gossip uses.
 """
 
-from go_crdt_playground_tpu.net.peer import Node, SyncStats  # noqa: F401
+from go_crdt_playground_tpu.net.antientropy import (CircuitBreaker,  # noqa: F401
+                                                    SyncSupervisor,
+                                                    classify_failure)
+from go_crdt_playground_tpu.net.faults import (ChaosProxy,  # noqa: F401
+                                               ChaosScenario)
+from go_crdt_playground_tpu.net.peer import (ConnectFailed,  # noqa: F401
+                                             Node, PeerProtocolError,
+                                             PeerReset, PeerTimeout,
+                                             SyncError, SyncStats)

@@ -1,0 +1,364 @@
+"""Socket-level chaos injection: a deterministic TCP interposer.
+
+The tensor layer already injects faults as masked lanes
+(parallel/gossip.py drop masks), but that validates the ALGEBRA, not the
+WIRE STACK.  ``ChaosProxy`` sits between real ``net.peer.Node``
+processes/threads and injects the failure modes a production network
+actually produces, so the idempotence/self-healing claim (SURVEY §5.3)
+is exercised against framing, deadlines, and the apply path itself:
+
+* **drop-before-HELLO** — the dial is accepted then closed before a
+  byte moves (a peer crashing right after accept);
+* **mid-frame truncation** — a random prefix is forwarded, then both
+  ends are cut abruptly (torn frames; the receiver must treat the
+  partial frame as all-or-nothing);
+* **delay** — a sleep before forwarding (exercises HELLO/frame
+  deadlines without violating protocol);
+* **duplicate delivery** — the client→server byte stream is recorded
+  and replayed on a fresh upstream connection after the original
+  exchange finishes (the same PAYLOAD applied twice: idempotence on the
+  actual wire bytes, not a simulated re-merge);
+* **byte garbling** — one byte is flipped in flight (framing must
+  reject, never half-apply);
+* **asymmetric partition** — the proxy refuses all inbound dials while
+  its node can still dial OUT to everyone else (one proxy per node
+  makes the partition asymmetric by construction); ``heal()`` lifts it.
+
+Determinism: every per-connection decision comes from one
+``random.Random(seed)`` drawn in accept order, or — for tests that need
+exact placement — from an explicit ``script`` of actions consumed
+first-connection-first.  Counters for every injected fault are exposed
+via ``counters()`` so tests can assert the chaos actually happened
+(a green chaos test with zero injected faults is a broken test).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# action verbs (script entries use these, with optional ":arg")
+ACT_OK = "ok"
+ACT_DROP = "drop"             # close before any byte (drop-before-HELLO)
+ACT_TRUNCATE = "truncate"     # "truncate:<nbytes>" — cut mid-frame
+ACT_DELAY = "delay"           # "delay:<seconds>"
+ACT_DUPLICATE = "duplicate"   # replay the client bytes after the exchange
+ACT_GARBLE = "garble"         # flip one byte of the client->server stream
+
+_RECORD_CAP = 1 << 20  # duplicate-replay buffer bound per connection
+
+
+def _validate_script_entry(entry: str) -> None:
+    """Reject malformed script entries at construction time — the only
+    other place they surface is inside the accept-loop thread, where a
+    ValueError kills the proxy silently and the test hangs on its
+    connect timeout instead of failing at the typo."""
+    verb, _, arg = entry.partition(":")
+    if verb not in (ACT_OK, ACT_DROP, ACT_TRUNCATE, ACT_DELAY,
+                    ACT_DUPLICATE, ACT_GARBLE):
+        raise ValueError(f"unknown chaos script entry {entry!r}")
+    if arg:
+        if verb in (ACT_TRUNCATE, ACT_GARBLE):
+            int(arg)
+        elif verb == ACT_DELAY:
+            float(arg)
+
+
+@dataclass
+class ChaosScenario:
+    """Per-connection fault rates (each drawn independently, in this
+    order: drop, truncate, garble, delay, duplicate — at most one of
+    drop/truncate/garble fires per connection; delay and duplicate
+    compose with any of them)."""
+
+    drop_rate: float = 0.0
+    truncate_rate: float = 0.0
+    truncate_window: Tuple[int, int] = (1, 48)
+    garble_rate: float = 0.0
+    delay_rate: float = 0.0
+    delay_s: float = 0.02
+    duplicate_rate: float = 0.0
+    partitioned: bool = False
+
+    def __post_init__(self) -> None:
+        for name in ("drop_rate", "truncate_rate", "garble_rate",
+                     "delay_rate", "duplicate_rate"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name}={v} outside [0, 1]")
+        lo, hi = self.truncate_window
+        if not 0 <= lo <= hi:
+            # an inverted window would only surface as randint blowing
+            # up inside the accept-loop thread (silent proxy death)
+            raise ValueError(
+                f"truncate_window={self.truncate_window} needs 0 <= lo <= hi")
+
+
+@dataclass
+class _Plan:
+    """One connection's resolved fault plan."""
+
+    action: str = ACT_OK
+    cut_after: Optional[int] = None
+    delay_s: float = 0.0
+    duplicate: bool = False
+    garble: bool = False
+    # byte index (into the first client->server chunk) whose low bit is
+    # flipped; None = last byte.  Scripted garbles pin it so tests can
+    # target the magic (rejected before decode) or a body field
+    # (rejected by decode) deterministically.
+    garble_offset: Optional[int] = None
+
+
+class ChaosProxy:
+    """Deterministic lossy/byzantine TCP interposer in front of one
+    ``Node`` server.  Listens on an ephemeral localhost port
+    (``.port``), forwards to ``target``; thread-per-connection, cheap
+    enough for a dozen fleet members in one test process."""
+
+    def __init__(self, target: Tuple[str, int], seed: int = 0,
+                 scenario: Optional[ChaosScenario] = None,
+                 script: Optional[Sequence[str]] = None):
+        self.target = (target[0], int(target[1]))
+        self.scenario = scenario if scenario is not None else ChaosScenario()
+        self._script: List[str] = list(script or [])
+        for entry in self._script:
+            _validate_script_entry(entry)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {
+            "connections": 0, "refused": 0, "dropped": 0, "truncated": 0,
+            "garbled": 0, "delayed": 0, "duplicated": 0, "passed": 0,
+        }
+        self._closing = False
+        self._sock = socket.socket()
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(128)
+        self.port: int = self._sock.getsockname()[1]
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"chaos-proxy-{self.port}")
+        self._thread.start()
+
+    # -- control -----------------------------------------------------------
+
+    def partition(self) -> None:
+        """Start refusing ALL inbound dials (asymmetric: the node behind
+        this proxy can still dial out through other nodes' proxies)."""
+        with self._lock:
+            self.scenario.partitioned = True
+
+    def heal(self) -> None:
+        with self._lock:
+            self.scenario.partitioned = False
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ChaosProxy":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- per-connection planning (all RNG draws happen here, in accept
+    # -- order, under the lock — the determinism contract) ------------------
+
+    def _next_plan(self) -> Optional[_Plan]:
+        """None = refuse (partition).  Called under the lock."""
+        s = self.scenario
+        self._counters["connections"] += 1
+        if s.partitioned:
+            self._counters["refused"] += 1
+            return None
+        if self._script:
+            return self._plan_from_script(self._script.pop(0))
+        plan = _Plan()
+        lo, hi = s.truncate_window
+        # one draw per fault axis EVERY connection, whether or not an
+        # earlier axis already fired: the draw count per connection is
+        # constant, so a scenario's stream stays aligned across runs
+        # even when rates differ
+        r_drop = self._rng.random()
+        r_trunc = self._rng.random()
+        cut = self._rng.randint(lo, hi)
+        r_garble = self._rng.random()
+        r_delay = self._rng.random()
+        r_dup = self._rng.random()
+        if r_drop < s.drop_rate:
+            plan.action = ACT_DROP
+            self._counters["dropped"] += 1
+        elif r_trunc < s.truncate_rate:
+            plan.action = ACT_TRUNCATE
+            plan.cut_after = cut
+            self._counters["truncated"] += 1
+        elif r_garble < s.garble_rate:
+            plan.action = ACT_GARBLE
+            plan.garble = True
+            self._counters["garbled"] += 1
+        if r_delay < s.delay_rate:
+            plan.delay_s = s.delay_s
+            self._counters["delayed"] += 1
+        if r_dup < s.duplicate_rate and plan.action == ACT_OK:
+            plan.duplicate = True
+            self._counters["duplicated"] += 1
+        if plan.action == ACT_OK:
+            self._counters["passed"] += 1
+        return plan
+
+    def _plan_from_script(self, entry: str) -> _Plan:
+        verb, _, arg = entry.partition(":")
+        plan = _Plan()
+        if verb == ACT_DROP:
+            plan.action = ACT_DROP
+            self._counters["dropped"] += 1
+        elif verb == ACT_TRUNCATE:
+            plan.action = ACT_TRUNCATE
+            plan.cut_after = int(arg) if arg else 16
+            self._counters["truncated"] += 1
+        elif verb == ACT_GARBLE:
+            plan.action = ACT_GARBLE
+            plan.garble = True
+            plan.garble_offset = int(arg) if arg else None
+            self._counters["garbled"] += 1
+        elif verb == ACT_DELAY:
+            plan.delay_s = float(arg) if arg else self.scenario.delay_s
+            self._counters["delayed"] += 1
+        elif verb == ACT_DUPLICATE:
+            plan.duplicate = True
+            self._counters["duplicated"] += 1
+        elif verb == ACT_OK:
+            self._counters["passed"] += 1
+        else:
+            raise ValueError(f"unknown chaos script entry {entry!r}")
+        return plan
+
+    # -- data path ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            with self._lock:
+                plan = self._next_plan()
+            if plan is None or plan.action == ACT_DROP:
+                # refuse/drop-before-HELLO: abrupt close, zero bytes moved
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            threading.Thread(target=self._run_conn, args=(conn, plan),
+                             daemon=True).start()
+
+    def _run_conn(self, conn: socket.socket, plan: _Plan) -> None:
+        if plan.delay_s > 0:
+            time.sleep(plan.delay_s)
+        try:
+            upstream = socket.create_connection(self.target, timeout=5.0)
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        recorded: Optional[List[bytes]] = [] if plan.duplicate else None
+
+        def pump(src: socket.socket, dst: socket.socket,
+                 budget: Optional[int], garble: bool,
+                 garble_offset: Optional[int],
+                 record: Optional[List[bytes]]) -> None:
+            forwarded = 0
+            first = True
+            try:
+                while True:
+                    take = 4096 if budget is None else min(
+                        4096, budget - forwarded)
+                    if take <= 0:
+                        break
+                    data = src.recv(take)
+                    if not data:
+                        break
+                    if garble and first:
+                        # flip the low bit of one byte of the first
+                        # chunk (default: the last byte — past the magic
+                        # when the chunk spans a whole frame).  Note a
+                        # flip can land on bytes where the frame still
+                        # DECODES (e.g. inside a VV counter): that is
+                        # the point — the stack must either reject the
+                        # frame or absorb a semantically-valid one, and
+                        # anti-entropy heals the skew either way.
+                        i = (len(data) - 1 if garble_offset is None
+                             else min(garble_offset, len(data) - 1))
+                        data = (data[:i] + bytes([data[i] ^ 0x01])
+                                + data[i + 1:])
+                        first = False
+                    if record is not None and sum(
+                            len(c) for c in record) < _RECORD_CAP:
+                        record.append(data)
+                    dst.sendall(data)
+                    forwarded += len(data)
+            except OSError:
+                pass
+            finally:
+                # abrupt close of BOTH ends on exit: a budget cut lands
+                # as a torn frame on whichever side was mid-read
+                for s in (src, dst):
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+
+        cut = plan.cut_after if plan.action == ACT_TRUNCATE else None
+        t = threading.Thread(
+            target=pump, daemon=True,
+            args=(conn, upstream, cut, plan.garble, plan.garble_offset,
+                  recorded))
+        t.start()
+        pump(upstream, conn, cut, False, None, None)
+        t.join(timeout=5.0)
+        if plan.duplicate and recorded:
+            self._replay(b"".join(recorded))
+
+    def _replay(self, payload: bytes) -> None:
+        """Duplicate delivery: the recorded client→server bytes hit the
+        server a second time on a fresh connection.  Replies are drained
+        and discarded — the duplicate client is a ghost."""
+        try:
+            with socket.create_connection(self.target, timeout=5.0) as up:
+                up.sendall(payload)
+                up.settimeout(5.0)
+                while up.recv(4096):
+                    pass
+        except OSError:
+            pass  # the duplicate is best-effort by design
+
+
+def fleet_proxies(addrs: Sequence[Tuple[str, int]], seed: int = 0,
+                  scenario: Optional[ChaosScenario] = None
+                  ) -> List[ChaosProxy]:
+    """One ChaosProxy per fleet member, each with a seed derived from
+    ``seed`` and its index (deterministic fleet-wide chaos), sharing a
+    scenario TEMPLATE (each proxy gets its own copy so a partition on
+    one node does not partition the fleet)."""
+    out = []
+    for i, addr in enumerate(addrs):
+        sc = (dataclasses.replace(scenario) if scenario is not None
+              else ChaosScenario())
+        out.append(ChaosProxy(addr, seed=seed * 1000 + i, scenario=sc))
+    return out

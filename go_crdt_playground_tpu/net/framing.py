@@ -41,6 +41,14 @@ class ProtocolError(RuntimeError):
     pass
 
 
+class TruncatedFrame(ProtocolError):
+    """The connection closed mid-frame (torn frame).  Still a
+    ProtocolError for compatibility, but distinguishable: a torn frame
+    is TRANSPORT loss (transient — the resilient runtime retries it),
+    while other ProtocolErrors mean the peer spoke the protocol wrong
+    (deterministic — retrying the same bytes cannot help)."""
+
+
 class RemoteError(RuntimeError):
     """The peer reported a protocol-level failure (MSG_ERROR frame)."""
 
@@ -74,7 +82,7 @@ def _recv_exact_inner(sock: socket.socket, n: int,
             sock.settimeout(remaining)
         b = sock.recv(min(n, 1 << 20))
         if not b:
-            raise ProtocolError("connection closed mid-frame")
+            raise TruncatedFrame("connection closed mid-frame")
         chunks.append(b)
         n -= len(b)
     return b"".join(chunks)

@@ -22,6 +22,33 @@ condition ``Counter(src.Actor) <= 0``, awset-delta_test.go:53, evaluated
 from the advertised VV).  Apply uses the same kernels as the on-chip
 gossip path (ops/delta.py), so in-process, on-mesh, and cross-socket
 synchronization share one semantics implementation.
+
+Deadline model (both sides of the exchange):
+
+* The SERVER runs two budgets — a short whole-frame ``hello_timeout_s``
+  for the initial HELLO (a real client sends it immediately on connect,
+  so idle half-open dials release their connection slot in seconds) and
+  the longer ``conn_timeout_s`` for the PAYLOAD frame (which may carry a
+  full state image).
+* The CLIENT honors the same asymmetry: the TCP dial is bounded by
+  ``connect_timeout_s`` (default: the overall ``timeout``), the server's
+  HELLO reply — sent before any kernel work — by ``hello_timeout_s``
+  (default: this node's own ``hello_timeout_s``, clamped to ``timeout``),
+  and the PAYLOAD reply — which sits behind the server's apply+extract —
+  by the full ``timeout``.  Every frame deadline is ABSOLUTE for the
+  whole frame (framing.recv_frame's deadline semantics), so a trickling
+  peer cannot stretch an exchange past its budget.
+
+Failure typing: ``sync_with`` never leaks a raw ``OSError`` /
+``ProtocolError``.  Dial failures raise ``ConnectFailed``, any deadline
+raises ``PeerTimeout`` (with ``.phase`` naming the exchange step),
+transport failures mid-exchange raise ``PeerReset``, and malformed or
+out-of-order frames raise ``PeerProtocolError``.  Each keeps the legacy
+exception as a base (``OSError`` family / ``framing.ProtocolError``), so
+pre-hierarchy callers catching those still work; a server-reported
+``framing.RemoteError`` propagates unchanged (it is already typed and
+carries the remote message).  net/antientropy.py maps this hierarchy to
+failure classes for retry, circuit-breaker, and metric treatment.
 """
 
 from __future__ import annotations
@@ -36,6 +63,39 @@ from go_crdt_playground_tpu.net import framing
 from go_crdt_playground_tpu.net.framing import (MODE_DELTA, MODE_FULL,
                                                 MSG_HELLO, MSG_PAYLOAD,
                                                 ProtocolError)
+
+
+class SyncError(Exception):
+    """Base of every client-side sync failure.  A mixin base: concrete
+    subclasses ALSO inherit the legacy exception their call sites used
+    to leak (``OSError`` family / ``framing.ProtocolError``), so code
+    written against the old raw exceptions keeps catching these."""
+
+
+class ConnectFailed(SyncError, ConnectionError):
+    """The TCP dial itself failed (refused, unreachable, DNS)."""
+
+
+class PeerTimeout(SyncError, socket.timeout):
+    """A deadline expired.  ``phase`` names the exchange step that blew
+    its budget: "connect" | "hello" | "payload" — the supervisor treats
+    a connect timeout (peer likely down) differently from a frame
+    deadline (peer up but slow/wedged)."""
+
+    def __init__(self, message: str, phase: str):
+        super().__init__(message)
+        self.phase = phase
+
+
+class PeerReset(SyncError, ConnectionError):
+    """The transport failed mid-exchange (reset / broken pipe) after the
+    dial succeeded — distinct from ConnectFailed because the peer WAS
+    reachable, so breakers treat it as flakiness, not absence."""
+
+
+class PeerProtocolError(SyncError, ProtocolError):
+    """The peer spoke the protocol wrong (bad magic, unexpected frame
+    type, malformed body, torn frame)."""
 
 
 class SyncStats(NamedTuple):
@@ -400,27 +460,77 @@ class Node:
 
     # -- client -------------------------------------------------------------
 
-    def sync_with(self, addr: Tuple[str, int],
-                  timeout: float = 30.0) -> SyncStats:
-        """One push-pull anti-entropy exchange with the peer at addr."""
-        with socket.create_connection(addr, timeout=timeout) as sock:
-            sent = framing.send_frame(sock, MSG_HELLO, framing.encode_hello(
-                self.actor, self.num_elements, self.vv()))
-            msg_type, body = framing.recv_frame(sock)
-            if msg_type != MSG_HELLO:
-                raise ProtocolError(f"expected HELLO, got {msg_type}")
-            _, peer_vv = framing.decode_hello(
-                body, self.num_elements, self.num_actors)
-            recv = framing.frame_size(len(body))
-            with self._lock:
-                mode_sent, out = self._extract_msg(peer_vv)
-            sent += framing.send_frame(sock, MSG_PAYLOAD, out)
-            msg_type, body = framing.recv_frame(sock)
-            if msg_type != MSG_PAYLOAD:
-                raise ProtocolError(f"expected PAYLOAD, got {msg_type}")
-            recv += framing.frame_size(len(body))
-            with self._lock:
-                mode_recv = self._apply_msg(body)
+    def sync_with(self, addr: Tuple[str, int], timeout: float = 30.0, *,
+                  connect_timeout_s: Optional[float] = None,
+                  hello_timeout_s: Optional[float] = None) -> SyncStats:
+        """One push-pull anti-entropy exchange with the peer at addr.
+
+        ``timeout`` bounds the PAYLOAD reply (the expensive step: the
+        server extracts it after applying ours).  The dial is bounded by
+        ``connect_timeout_s`` (default: ``timeout``) and the HELLO reply
+        — which the server sends before any kernel work — by
+        ``hello_timeout_s`` (default: this node's own ``hello_timeout_s``,
+        clamped to ``timeout``): the client-side mirror of the server's
+        HELLO/payload budget asymmetry.  See the module docstring for the
+        full deadline model.  Raises only the typed ``SyncError``
+        hierarchy (plus ``framing.RemoteError`` for server-reported
+        failures).
+        """
+        connect_t = timeout if connect_timeout_s is None else \
+            connect_timeout_s
+        hello_t = min(self.hello_timeout_s if hello_timeout_s is None
+                      else hello_timeout_s, timeout)
+        try:
+            sock = socket.create_connection(addr, timeout=connect_t)
+        except socket.timeout as e:
+            raise PeerTimeout(f"connect to {addr}: {e}",
+                              phase="connect") from e
+        except OSError as e:
+            raise ConnectFailed(f"connect to {addr}: {e}") from e
+        # create_connection left connect_t as the socket's persistent
+        # timeout; sends must ride the payload budget (recv_frame manages
+        # its own deadline), else a short dead-peer-detection connect_t
+        # would bound a large FULL-state send.
+        sock.settimeout(timeout)
+        with sock:
+            phase = "hello"
+            try:
+                sent = framing.send_frame(
+                    sock, MSG_HELLO, framing.encode_hello(
+                        self.actor, self.num_elements, self.vv()))
+                msg_type, body = framing.recv_frame(sock, timeout=hello_t)
+                if msg_type != MSG_HELLO:
+                    raise ProtocolError(f"expected HELLO, got {msg_type}")
+                _, peer_vv = framing.decode_hello(
+                    body, self.num_elements, self.num_actors)
+                recv = framing.frame_size(len(body))
+                with self._lock:
+                    mode_sent, out = self._extract_msg(peer_vv)
+                phase = "payload"
+                sent += framing.send_frame(sock, MSG_PAYLOAD, out)
+                msg_type, body = framing.recv_frame(sock, timeout=timeout)
+                if msg_type != MSG_PAYLOAD:
+                    raise ProtocolError(f"expected PAYLOAD, got {msg_type}")
+                recv += framing.frame_size(len(body))
+                with self._lock:
+                    mode_recv = self._apply_msg(body)
+            except SyncError:
+                raise
+            except framing.RemoteError:
+                raise  # already typed; carries the server's message
+            except socket.timeout as e:
+                raise PeerTimeout(f"{phase} exchange with {addr}: {e}",
+                                  phase=phase) from e
+            except framing.TruncatedFrame as e:
+                # a torn frame is transport loss, not peer malice —
+                # surface it as the (retryable) reset class
+                raise PeerReset(
+                    f"{phase} exchange with {addr}: {e}") from e
+            except ProtocolError as e:
+                raise PeerProtocolError(str(e)) from e
+            except OSError as e:
+                raise PeerReset(
+                    f"{phase} exchange with {addr}: {e}") from e
         self._record(mode_sent, bytes_sent=sent, bytes_received=recv)
         return SyncStats(bytes_sent=sent, bytes_received=recv,
                          mode_sent=mode_sent, mode_received=mode_recv)

@@ -19,15 +19,19 @@ from typing import Dict
 class Recorder:
     """Thread-safe counters, value observations, and wall-clock timers.
 
-    count():   monotonically increasing totals (merges, rounds, bytes).
-    observe(): value streams summarized as n/sum/min/max.
-    time():    context manager feeding observe() with elapsed seconds.
+    count():     monotonically increasing totals (merges, rounds, bytes).
+    observe():   value streams summarized as n/sum/min/max.
+    time():      context manager feeding observe() with elapsed seconds.
+    set_gauge(): last-write-wins point-in-time values (e.g. the per-peer
+                 circuit-breaker state the sync supervisor exports:
+                 0=closed, 1=open, 2=half_open — net/antientropy.py).
     """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._observations: Dict[str, Dict[str, float]] = {}
+        self._gauges: Dict[str, float] = {}
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -62,15 +66,22 @@ class Recorder:
         finally:
             self.observe(name, time.perf_counter() - t0)
 
+    def set_gauge(self, name: str, value: float) -> None:
+        """Set a last-write-wins instantaneous value (unlike count(),
+        snapshot() reports the CURRENT value, not an accumulation)."""
+        with self._lock:
+            self._gauges[name] = value
+
     def snapshot(self) -> Dict[str, object]:
-        """Point-in-time copy: {"counters": {...}, "observations": {...}}
-        with per-stream mean added."""
+        """Point-in-time copy: {"counters": {...}, "observations": {...},
+        "gauges": {...}} with per-stream mean added."""
         with self._lock:
             obs = {
                 name: {**o, "mean": o["sum"] / o["n"]}
                 for name, o in self._observations.items()
             }
-            return {"counters": dict(self._counters), "observations": obs}
+            return {"counters": dict(self._counters), "observations": obs,
+                    "gauges": dict(self._gauges)}
 
 
 def payload_metrics(payload, wire: bool = True) -> Dict[str, int]:

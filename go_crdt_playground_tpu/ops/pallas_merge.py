@@ -590,7 +590,12 @@ _PACK_CHUNK = _LANE * _WORD   # 4096 elements = one 128-lane group of words
 # unpacked uint32 E-arrays (~35MB double-buffered).  Raise the
 # per-kernel cap for the ring kernels; the aligned (2-group) and
 # small-E whole-axis forms never near it.
-_RING_VMEM_LIMIT = pltpu.CompilerParams(
+# jax renamed pltpu.TPUCompilerParams -> CompilerParams; accept either
+# so one source serves both API generations (vmem_limit_bytes is spelled
+# the same in both).
+_COMPILER_PARAMS_CLS = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+_RING_VMEM_LIMIT = _COMPILER_PARAMS_CLS(
     vmem_limit_bytes=64 * 1024 * 1024)
 
 

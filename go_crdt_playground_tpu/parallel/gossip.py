@@ -46,6 +46,22 @@ from go_crdt_playground_tpu.parallel.mesh import (
 # measurement loop calls it up to max_rounds times.
 converged_jit = jax.jit(collectives.converged)
 
+
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """jax moved shard_map from jax.experimental to the top level and
+    renamed check_rep -> check_vma along the way; accept every
+    generation so one source serves them all (same dance as the
+    pltpu.CompilerParams shim in ops/pallas_merge.py)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    except TypeError:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+
 # ---------------------------------------------------------------------------
 # Pairing schedules (permutations of the replica axis)
 # ---------------------------------------------------------------------------
@@ -450,7 +466,7 @@ def _compact_ring_step_compiled(mesh: Mesh, k_changed: int, k_deleted: int):
                 local, dense)
 
     return jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs)
+        _shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs)
     )
 
 
@@ -681,7 +697,7 @@ def _ring_step_compiled(mesh: Mesh, state_cls, kernel: str):
     # the fused path (the bitwise-equality test vs the checked XLA path
     # is the stronger guarantee anyway).
     return jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs,
+        _shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs,
                       check_vma=(kernel != "pallas"))
     )
 
@@ -713,7 +729,7 @@ def _ep_ring_step_compiled(mesh: Mesh, state_cls):
         return merged._replace(vv=vv_local)
 
     return jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs)
+        _shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs)
     )
 
 
@@ -801,7 +817,7 @@ def _butterfly_step_compiled(mesh: Mesh, state_cls, stage: int,
         return merged
 
     return jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs,
+        _shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs,
                       check_vma=(kernel != "pallas"))
     )
 
@@ -894,7 +910,7 @@ def _packed_block_ring_compiled(mesh: Mesh, shift: int, kernel_offset: int,
     # annotation (the bitwise pin vs the global-jit packed round in
     # tests/test_gossip.py is the stronger guarantee).
     return jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs,
+        _shard_map(step, mesh=mesh, in_specs=(specs,), out_specs=specs,
                       check_vma=False)
     )
 

@@ -40,7 +40,23 @@ def initialize(coordinator_address: Optional[str] = None,
                process_id: Optional[int] = None) -> None:
     """Bring up JAX's distributed runtime (one call per host process,
     before any other JAX API).  Arguments default to the standard
-    environment autodetection (JAX_COORDINATOR_ADDRESS etc.)."""
+    environment autodetection (JAX_COORDINATOR_ADDRESS etc.).
+
+    On the CPU backend, multiprocess collectives need the gloo
+    transport, which some jax generations leave off by default
+    ("Multiprocess computations aren't implemented on the CPU
+    backend") — opt in when the knob exists so the 2-process CI run
+    and any CPU rehearsal of a multi-host deployment work out of the
+    box.  TPU backends ignore it."""
+    try:
+        current = jax.config._read("jax_cpu_collectives_implementation")
+    except Exception:  # noqa: BLE001 — private reader; absent/renamed ok
+        current = None
+    if current in (None, "", "none"):  # don't clobber an explicit choice
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):
+            pass  # knob absent (old jax) or gloo not built in
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -1,0 +1,125 @@
+"""Jittered exponential backoff — ONE policy object for every retry loop.
+
+Anti-entropy makes retries semantically free (a lost exchange is a lost
+gossip round, never lost data — SURVEY §5.3), which makes it tempting to
+retry hard and fast everywhere.  This module is the shared brake: the
+resilient sync runtime (net/antientropy.SyncSupervisor), the bridge
+client (bridge/service.MergerClient), and any tool-level soak loop draw
+their delays from the same ``BackoffPolicy`` so retry pressure is
+centrally tunable and — critically for the chaos tests — DETERMINISTIC
+under a fixed seed.
+
+Delay law for attempt k (0-based):
+
+    nominal_k = min(cap_s, base_s * multiplier**k)
+    delay_k   = nominal_k * (1 + jitter * u_k),   u_k ~ Uniform[-1, 1]
+
+so delays always stay inside ``[(1-jitter)*nominal, (1+jitter)*nominal]``
+(bounds pinned by tests/test_backoff.py) and the un-jittered nominal
+sequence is monotone non-decreasing with a hard cap.  Jitter draws come
+from a private ``random.Random(seed)`` — never the global RNG — so two
+policies with equal seeds replay identical schedules and a chaos
+scenario's timing is reproducible bit for bit.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterator, Optional, Tuple, Type
+
+
+@dataclass(frozen=True)
+class BackoffPolicy:
+    """Immutable retry-delay configuration (the policy is shared; the
+    mutable per-loop cursor lives in ``Backoff``)."""
+
+    base_s: float = 0.05
+    multiplier: float = 2.0
+    cap_s: float = 2.0
+    jitter: float = 0.1     # fraction of nominal, symmetric
+    max_retries: int = 3    # retries AFTER the first attempt
+
+    def __post_init__(self) -> None:
+        if self.base_s < 0 or self.cap_s < 0:
+            raise ValueError("backoff delays must be non-negative")
+        if self.multiplier < 1.0:
+            raise ValueError(
+                f"multiplier {self.multiplier} < 1 would make the nominal "
+                "sequence decay — that is a rate limiter, not a backoff")
+        if not 0.0 <= self.jitter < 1.0:
+            raise ValueError(f"jitter {self.jitter} outside [0, 1)")
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+
+    def nominal(self, attempt: int) -> float:
+        """Un-jittered delay after failed attempt ``attempt`` (0-based)."""
+        return min(self.cap_s, self.base_s * self.multiplier ** attempt)
+
+    def delays(self, seed: int = 0) -> Iterator[float]:
+        """The full jittered delay schedule (max_retries entries) as a
+        fresh deterministic stream — equal seeds replay equal delays."""
+        rng = random.Random(seed)
+        for k in range(self.max_retries):
+            n = self.nominal(k)
+            yield n * (1.0 + self.jitter * rng.uniform(-1.0, 1.0))
+
+
+class Backoff:
+    """Mutable cursor over one policy's delay schedule.
+
+    ``next_delay()`` returns the next jittered delay (or None once the
+    retry budget is spent); ``reset()`` rewinds after a success so the
+    next failure burst starts from base_s again.  Seeded: a supervisor
+    derives one Backoff per (round, peer) from its own seeded RNG, so
+    the whole fleet's timing replays under a fixed scenario seed.
+    """
+
+    def __init__(self, policy: BackoffPolicy, seed: int = 0):
+        self.policy = policy
+        self._seed = seed
+        self._rng = random.Random(seed)
+        self._attempt = 0
+
+    @property
+    def attempt(self) -> int:
+        return self._attempt
+
+    def next_delay(self) -> Optional[float]:
+        if self._attempt >= self.policy.max_retries:
+            return None
+        n = self.policy.nominal(self._attempt)
+        self._attempt += 1
+        return n * (1.0 + self.policy.jitter * self._rng.uniform(-1.0, 1.0))
+
+    def reset(self) -> None:
+        """Rewind the cursor AND the jitter stream: a reset Backoff
+        replays the same delays as a fresh one (determinism over the
+        whole supervisor run, not just the first failure burst)."""
+        self._rng = random.Random(self._seed)
+        self._attempt = 0
+
+
+def retry_call(fn: Callable[[], object], policy: BackoffPolicy,
+               retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+               seed: int = 0,
+               sleep: Callable[[float], None] = time.sleep,
+               on_retry: Optional[Callable[[BaseException, float], None]]
+               = None):
+    """Call ``fn`` with up to ``policy.max_retries`` retries on
+    ``retry_on`` exceptions, sleeping the policy's jittered delays in
+    between.  The LAST failure propagates unchanged (callers classify
+    the typed net.peer errors themselves).  ``sleep`` is injectable so
+    unit tests run the schedule at zero wall cost."""
+    bo = Backoff(policy, seed=seed)
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            d = bo.next_delay()
+            if d is None:
+                raise
+            if on_retry is not None:
+                on_retry(e, d)
+            sleep(d)

@@ -1,0 +1,397 @@
+"""The resilient anti-entropy runtime (net/antientropy.py).
+
+Breaker transition table and failure classification as pure units (no
+sockets, injected clock), then the SyncSupervisor against real Nodes on
+localhost: typed errors from sync_with, retry/breaker metrics on the
+Recorder, breaker recovery when a dead peer comes back, and the
+checkpoint-restart path (a killed-and-restored replica reconverges via
+the FULL-state first-contact branch)."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from go_crdt_playground_tpu.net import framing
+from go_crdt_playground_tpu.net.antientropy import (CLOSED, HALF_OPEN, OPEN,
+                                                    CircuitBreaker,
+                                                    SyncSupervisor,
+                                                    classify_failure)
+from go_crdt_playground_tpu.net.peer import (ConnectFailed, Node,
+                                             PeerProtocolError, PeerReset,
+                                             PeerTimeout, SyncError)
+from go_crdt_playground_tpu.obs import Recorder
+from go_crdt_playground_tpu.utils.backoff import BackoffPolicy
+
+E = 32
+A = 4
+
+FAST = BackoffPolicy(base_s=0.001, cap_s=0.005, max_retries=2, jitter=0.0)
+
+
+# -- circuit breaker: the transition table, no wall clock ------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_breaker(threshold=3, cooldown=10.0):
+    clk = FakeClock()
+    transitions = []
+    br = CircuitBreaker(failure_threshold=threshold, cooldown_s=cooldown,
+                        clock=clk,
+                        on_transition=lambda o, n: transitions.append((o, n)))
+    return br, clk, transitions
+
+
+def test_breaker_closed_until_threshold():
+    br, _, transitions = make_breaker(threshold=3)
+    assert br.state == CLOSED
+    for _ in range(2):
+        br.record_failure()
+        assert br.state == CLOSED and br.allow()
+    br.record_failure()
+    assert br.state == OPEN
+    assert transitions == [(CLOSED, OPEN)]
+
+
+def test_breaker_success_resets_consecutive_count():
+    br, _, _ = make_breaker(threshold=2)
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == CLOSED, "non-consecutive failures never open"
+
+
+def test_breaker_open_blocks_until_cooldown_then_single_probe():
+    br, clk, transitions = make_breaker(threshold=1, cooldown=10.0)
+    br.record_failure()
+    assert br.state == OPEN
+    assert not br.allow()
+    clk.t = 9.9
+    assert not br.allow(), "cooldown not yet elapsed"
+    clk.t = 10.0
+    assert br.allow(), "cooldown elapsed -> half-open probe granted"
+    assert br.state == HALF_OPEN
+    assert not br.allow(), "exactly ONE probe per half-open window"
+    assert transitions == [(CLOSED, OPEN), (OPEN, HALF_OPEN)]
+
+
+def test_breaker_probe_success_closes():
+    br, clk, transitions = make_breaker(threshold=1, cooldown=1.0)
+    br.record_failure()
+    clk.t = 1.0
+    assert br.allow()
+    br.record_success()
+    assert br.state == CLOSED and br.allow()
+    assert transitions[-1] == (HALF_OPEN, CLOSED)
+
+
+def test_breaker_probe_failure_reopens_with_fresh_cooldown():
+    br, clk, transitions = make_breaker(threshold=1, cooldown=5.0)
+    br.record_failure()           # -> OPEN at t=0
+    clk.t = 5.0
+    assert br.allow()             # -> HALF_OPEN
+    br.record_failure()           # probe failed -> OPEN, cooldown restarts
+    assert br.state == OPEN
+    clk.t = 9.9
+    assert not br.allow(), "cooldown must be FRESH from the probe failure"
+    clk.t = 10.0
+    assert br.allow()
+    assert transitions == [(CLOSED, OPEN), (OPEN, HALF_OPEN),
+                           (HALF_OPEN, OPEN), (OPEN, HALF_OPEN)]
+
+
+def test_breaker_trip_forces_open():
+    br, clk, _ = make_breaker(threshold=5, cooldown=3.0)
+    br.trip()
+    assert br.state == OPEN and not br.allow()
+    clk.t = 3.0
+    assert br.allow() and br.state == HALF_OPEN
+
+
+def test_breaker_validation():
+    with pytest.raises(ValueError):
+        CircuitBreaker(failure_threshold=0)
+    with pytest.raises(ValueError):
+        CircuitBreaker(cooldown_s=-1.0)
+
+
+# -- failure classification -------------------------------------------------
+
+
+def test_classification_table():
+    cases = [
+        (ConnectFailed("refused"), "connect_refused"),
+        (PeerTimeout("slow dial", phase="connect"), "connect_timeout"),
+        (PeerTimeout("slow hello", phase="hello"), "frame_deadline"),
+        (PeerTimeout("slow payload", phase="payload"), "frame_deadline"),
+        (PeerReset("torn"), "reset"),
+        (PeerProtocolError("bad magic"), "protocol"),
+        (framing.ProtocolError("bad magic"), "protocol"),
+        (framing.TruncatedFrame("closed mid-frame"), "reset"),
+        (framing.RemoteError("universe mismatch"), "remote"),
+        (ConnectionResetError("reset by peer"), "reset"),
+        (socket.timeout("raw"), "frame_deadline"),
+        (OSError("raw dial failure"), "connect_refused"),
+        (ValueError("not a sync failure"), "unknown"),
+    ]
+    for exc, expected in cases:
+        assert classify_failure(exc) == expected, (exc, expected)
+
+
+def test_typed_errors_keep_legacy_bases():
+    """The compatibility contract: pre-hierarchy callers catch
+    (OSError, framing.ProtocolError) — every typed error must land in
+    one of those nets."""
+    assert issubclass(ConnectFailed, OSError)
+    assert issubclass(ConnectFailed, SyncError)
+    assert issubclass(PeerTimeout, OSError)
+    assert issubclass(PeerTimeout, socket.timeout)
+    assert issubclass(PeerReset, OSError)
+    assert issubclass(PeerProtocolError, framing.ProtocolError)
+
+
+# -- typed errors out of the real sync_with --------------------------------
+
+
+def test_sync_with_raises_connect_failed_on_dead_port():
+    n = Node(0, E, A)
+    # a port nothing listens on: bind-then-close reserves a dead one
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    with pytest.raises(ConnectFailed):
+        n.sync_with(("127.0.0.1", port), timeout=2.0)
+
+
+def test_sync_with_raises_peer_timeout_on_silent_server():
+    # a server that accepts and never speaks: the HELLO reply deadline
+    # must fire (phase attribution pinned), not the payload timeout
+    silent = socket.create_server(("127.0.0.1", 0))
+    try:
+        n = Node(0, E, A)
+        t0 = time.monotonic()
+        with pytest.raises(PeerTimeout) as ei:
+            n.sync_with(silent.getsockname()[:2], timeout=30.0,
+                        hello_timeout_s=0.3)
+        assert ei.value.phase == "hello"
+        assert time.monotonic() - t0 < 5.0, \
+            "the short HELLO deadline must undercut the payload timeout"
+    finally:
+        silent.close()
+
+
+def test_sync_with_raises_peer_reset_on_abrupt_close():
+    done = threading.Event()
+
+    def accept_and_slam(srv):
+        conn, _ = srv.accept()
+        conn.close()
+        done.set()
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    threading.Thread(target=accept_and_slam, args=(srv,),
+                     daemon=True).start()
+    try:
+        n = Node(0, E, A)
+        with pytest.raises(PeerReset):
+            n.sync_with(srv.getsockname()[:2], timeout=2.0)
+        done.wait(2.0)
+    finally:
+        srv.close()
+
+
+def test_sync_with_remote_error_propagates_unwrapped():
+    a = Node(0, E, A)
+    b = Node(1, E * 2, A)  # element-universe mismatch
+    with b:
+        addr = b.serve()
+        with pytest.raises(framing.RemoteError, match="universe mismatch"):
+            a.sync_with(addr)
+
+
+# -- supervisor against real nodes -----------------------------------------
+
+
+def test_supervisor_converges_and_counts():
+    rec = Recorder()
+    a = Node(0, E, A, recorder=rec)
+    b = Node(1, E, A)
+    c = Node(2, E, A)
+    with b, c:
+        addr_b, addr_c = b.serve(), c.serve()
+        a.add(1)
+        b.add(2)
+        c.add(3)
+        sup = SyncSupervisor(a, [addr_b, addr_c], policy=FAST,
+                             interval_s=0.0, recorder=rec)
+        summary = sup.sync_round()
+        assert summary == {"succeeded": 2, "failed": 0, "skipped": 0}
+        assert set(a.members()) == {1, 2, 3}
+        counters = rec.snapshot()["counters"]
+        assert counters["sync.successes"] == 2
+        assert counters["sync.supervisor.rounds"] == 1
+
+
+def test_supervisor_retries_then_opens_breaker_on_dead_peer():
+    rec = Recorder()
+    a = Node(0, E, A, recorder=rec)
+    dead = ("127.0.0.1", 1)  # reserved port, nothing listens
+    sup = SyncSupervisor(a, [dead], policy=FAST, breaker_threshold=2,
+                         breaker_cooldown_s=30.0, interval_s=0.0,
+                         recorder=rec)
+    for _ in range(3):
+        sup.sync_round()
+    counters = rec.snapshot()["counters"]
+    # per-failure-class retry counts: every failed attempt classified,
+    # in-round retries counted separately
+    assert counters["sync.failures.connect_refused"] >= 4
+    assert counters["sync.retries.connect_refused"] >= 2
+    assert counters["sync.peer_failures"] == 2
+    assert counters["breaker.to_open"] == 1
+    # third round found the breaker OPEN: skipped, no connect attempted
+    assert counters["sync.skipped_open"] == 1
+    assert sup.breaker(dead).state == OPEN
+    # gauge mirrors the state (0=closed 1=open 2=half_open)
+    assert rec.snapshot()["gauges"]["breaker.state.127.0.0.1:1"] == 1
+
+
+def test_supervisor_breaker_recovers_when_peer_returns():
+    rec = Recorder()
+    a = Node(0, E, A, recorder=rec)
+    a.add(5)
+    b = Node(1, E, A)
+    # reserve a port for b WITHOUT serving yet
+    placeholder = socket.create_server(("127.0.0.1", 0))
+    host, port = placeholder.getsockname()[:2]
+    placeholder.close()
+    sup = SyncSupervisor(a, [(host, port)], policy=FAST,
+                         breaker_threshold=1, breaker_cooldown_s=0.05,
+                         interval_s=0.0, recorder=rec)
+    sup.sync_round()
+    assert sup.breaker((host, port)).state == OPEN
+    # peer comes up on that port; after the cooldown the half-open probe
+    # must succeed and close the breaker
+    with b:
+        b.serve(host=host, port=port)
+        deadline = time.monotonic() + 10.0
+        while sup.breaker((host, port)).state != CLOSED:
+            time.sleep(0.06)
+            sup.sync_round()
+            assert time.monotonic() < deadline, "breaker never recovered"
+        assert 5 in b.members()
+    counters = rec.snapshot()["counters"]
+    assert counters["breaker.to_open"] >= 1
+    assert counters["breaker.to_half_open"] >= 1
+    assert counters["breaker.to_closed"] >= 1
+
+
+def test_supervisor_trips_breaker_immediately_on_remote_error():
+    rec = Recorder()
+    a = Node(0, E, A, recorder=rec)
+    b = Node(1, E * 2, A)  # incompatible universe: deterministic failure
+    with b:
+        addr = b.serve()
+        sup = SyncSupervisor(a, [addr], policy=FAST, breaker_threshold=5,
+                             interval_s=0.0, recorder=rec)
+        sup.sync_round()
+        # one shot, no retries, breaker OPEN despite threshold 5: the
+        # peer REPORTED an incompatibility — hammering it cannot help
+        counters = rec.snapshot()["counters"]
+        assert counters["sync.failures.remote"] == 1
+        assert "sync.retries.remote" not in counters
+        assert sup.breaker(addr).state == OPEN
+
+
+def test_supervisor_run_until_and_pacing_is_injected():
+    rec = Recorder()
+    a = Node(0, E, A, recorder=rec)
+    b = Node(1, E, A)
+    sleeps = []
+    with b:
+        addr = b.serve()
+        b.add(7)
+        sup = SyncSupervisor(a, [addr], policy=FAST, interval_s=0.5,
+                             recorder=rec, sleep=sleeps.append)
+        rounds = sup.run(max_rounds=3,
+                         until=lambda: 7 in a.members())
+        assert rounds == 1, "until() must stop the loop at convergence"
+        assert not sleeps, "no pacing sleep after the final round"
+        sup.run(max_rounds=2)
+        assert len(sleeps) == 1 and 0.4 <= sleeps[0] <= 0.6, \
+            "jittered cadence flows through the injected sleep"
+
+
+def test_supervisor_background_thread_start_stop():
+    a = Node(0, E, A)
+    b = Node(1, E, A)
+    with b:
+        addr = b.serve()
+        b.add(9)
+        sup = SyncSupervisor(a, [addr], policy=FAST, interval_s=0.01)
+        sup.start()
+        with pytest.raises(RuntimeError):
+            sup.start()
+        deadline = time.monotonic() + 10.0
+        while 9 not in a.members() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        sup.stop()
+        assert 9 in a.members()
+
+
+# -- crash / recovery -------------------------------------------------------
+
+
+def test_supervisor_periodic_checkpoint_and_restart_full_resync(tmp_path):
+    """The crash-recovery story end to end: supervised checkpoints every
+    N rounds; the node is killed; SyncSupervisor.restore brings it back
+    from the checkpoint and the rejoined replica catches up through the
+    FULL-state first-contact branch."""
+    from go_crdt_playground_tpu.net.framing import MODE_FULL
+
+    ck = str(tmp_path / "node0.ckpt")
+    rec = Recorder()
+    a = Node(0, E, A, recorder=rec)
+    b = Node(1, E, A)
+    with b:
+        addr_b = b.serve()
+        a.add(1, 2)
+        sup = SyncSupervisor(a, [addr_b], policy=FAST, interval_s=0.0,
+                             recorder=rec, checkpoint_path=ck,
+                             checkpoint_every=2)
+        sup.sync_round()
+        sup.sync_round()   # round 2 -> checkpoint written
+        assert rec.snapshot()["counters"]["sync.checkpoints"] == 1
+        a.close()          # "kill" the node
+
+        # the fleet moves on while node 0 is down
+        b.add(3, 4)
+
+        # restart from the checkpoint; node 0 rejoins and catches up
+        rec2 = Recorder()
+        sup2 = SyncSupervisor.restore(ck, [addr_b], recorder=rec2,
+                                      policy=FAST, interval_s=0.0)
+        restored = sup2.node
+        assert restored.actor == 0
+        assert set(restored.members()) == {1, 2}, "checkpoint state only"
+
+        # a FRESH replica (actor 2) that never exchanged with node 0:
+        # its first contact with the restored node must ride FULL state
+        c = Node(2, E, A)
+        with c:
+            addr_c = c.serve()
+            stats = restored.sync_with(addr_c)
+            assert stats.mode_sent == MODE_FULL, \
+                "restored replica's first contact ships FULL state"
+        sup2.sync_round()
+        assert set(restored.members()) >= {1, 2, 3, 4}, \
+            "restored replica reconverged with the fleet"

@@ -1,0 +1,104 @@
+"""utils/backoff.py as a pure unit: no sockets, no sleeping, no wall
+clock — the delay law (jitter bounds, monotone cap, determinism under a
+fixed seed) is the contract both the anti-entropy supervisor and the
+bridge client retry on."""
+
+import pytest
+
+from go_crdt_playground_tpu.utils.backoff import (Backoff, BackoffPolicy,
+                                                  retry_call)
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="multiplier"):
+        BackoffPolicy(multiplier=0.5)
+    with pytest.raises(ValueError, match="jitter"):
+        BackoffPolicy(jitter=1.0)
+    with pytest.raises(ValueError, match="non-negative"):
+        BackoffPolicy(base_s=-1.0)
+    with pytest.raises(ValueError, match="max_retries"):
+        BackoffPolicy(max_retries=-1)
+
+
+def test_nominal_sequence_monotone_and_capped():
+    p = BackoffPolicy(base_s=0.1, multiplier=2.0, cap_s=0.75,
+                      max_retries=8, jitter=0.0)
+    noms = [p.nominal(k) for k in range(8)]
+    assert noms == sorted(noms), "nominal sequence must be monotone"
+    assert noms[0] == 0.1
+    assert all(n <= 0.75 for n in noms), "cap must bound every delay"
+    assert noms[-1] == 0.75, "the cap is reached, not asymptotically missed"
+
+
+def test_jitter_bounds():
+    p = BackoffPolicy(base_s=0.1, multiplier=2.0, cap_s=10.0,
+                      jitter=0.25, max_retries=6)
+    for seed in range(50):
+        for k, d in enumerate(p.delays(seed)):
+            n = p.nominal(k)
+            assert n * 0.75 <= d <= n * 1.25, (seed, k, d, n)
+
+
+def test_zero_jitter_is_exact():
+    p = BackoffPolicy(base_s=0.05, multiplier=3.0, cap_s=1.0,
+                      jitter=0.0, max_retries=4)
+    assert list(p.delays(0)) == pytest.approx([0.05, 0.15, 0.45, 1.0])
+
+
+def test_deterministic_under_fixed_seed():
+    p = BackoffPolicy(jitter=0.5, max_retries=10)
+    assert list(p.delays(42)) == list(p.delays(42))
+    assert list(p.delays(42)) != list(p.delays(43))
+
+
+def test_backoff_cursor_budget_and_reset_replay():
+    p = BackoffPolicy(base_s=0.01, max_retries=3, jitter=0.5)
+    bo = Backoff(p, seed=7)
+    first = [bo.next_delay() for _ in range(3)]
+    assert all(d is not None for d in first)
+    assert bo.next_delay() is None, "budget spent"
+    bo.reset()
+    assert [bo.next_delay() for _ in range(3)] == first, \
+        "reset must replay the same jitter stream (whole-run determinism)"
+
+
+def test_retry_call_succeeds_after_transient_failures():
+    p = BackoffPolicy(base_s=0.01, max_retries=3, jitter=0.0)
+    sleeps = []
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_call(flaky, p, sleep=sleeps.append) == "ok"
+    assert len(calls) == 3
+    assert sleeps == [0.01, 0.02], "two failures -> two policy delays"
+
+
+def test_retry_call_exhausts_budget_and_raises_last():
+    p = BackoffPolicy(base_s=0.0, max_retries=2, jitter=0.0)
+    calls = []
+
+    def dead():
+        calls.append(1)
+        raise ConnectionRefusedError("down")
+
+    with pytest.raises(ConnectionRefusedError):
+        retry_call(dead, p, sleep=lambda _: None)
+    assert len(calls) == 3, "1 attempt + max_retries retries"
+
+
+def test_retry_call_does_not_absorb_unlisted_exceptions():
+    p = BackoffPolicy(max_retries=5)
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("a bug, not weather")
+
+    with pytest.raises(ValueError):
+        retry_call(broken, p, sleep=lambda _: None)
+    assert len(calls) == 1, "non-retryable exceptions fail fast"

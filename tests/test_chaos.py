@@ -1,0 +1,392 @@
+"""Socket-level chaos harness (net/faults.ChaosProxy) + the resilient
+runtime driving real Nodes through it.
+
+The tensor layer's drop masks (parallel/gossip.py) validate the merge
+ALGEBRA under loss; these tests validate the WIRE STACK: framing,
+deadlines, the all-or-nothing apply, breaker degradation, and
+checkpoint restart — against injected drops, truncations, garbling,
+duplicates, and an asymmetric partition that later heals.  Scenarios
+are seeded/scripted so failures reproduce."""
+
+import dataclasses
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from go_crdt_playground_tpu.net import framing
+from go_crdt_playground_tpu.net.antientropy import SyncSupervisor
+from go_crdt_playground_tpu.net.faults import (ChaosProxy, ChaosScenario,
+                                               fleet_proxies)
+from go_crdt_playground_tpu.net.peer import (Node, PeerReset, SyncError)
+from go_crdt_playground_tpu.obs import Recorder
+from go_crdt_playground_tpu.utils.backoff import BackoffPolicy
+
+E = 48
+FAST = BackoffPolicy(base_s=0.002, cap_s=0.02, max_retries=2, jitter=0.0)
+
+
+def proxy_addr(p: ChaosProxy):
+    return ("127.0.0.1", p.port)
+
+
+def sync_eventually(node: Node, addr, deadline_s: float = 10.0):
+    """Retry a direct sync until it lands (for post-fault assertions)."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            return node.sync_with(addr, timeout=5.0)
+        except (OSError, framing.ProtocolError, framing.RemoteError):
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.02)
+
+
+# -- scripted single-fault behavior ----------------------------------------
+
+
+def test_scripted_drop_before_hello():
+    a, b = Node(0, E, 2), Node(1, E, 2)
+    with b:
+        proxy = ChaosProxy(b.serve(), script=["drop", "ok"])
+        with proxy:
+            a.add(1)
+            with pytest.raises(SyncError):
+                a.sync_with(proxy_addr(proxy), timeout=3.0)
+            assert b.members().size == 0, "dropped dial must apply nothing"
+            sync_eventually(a, proxy_addr(proxy))
+            assert 1 in b.members()
+            c = proxy.counters()
+            assert c["dropped"] == 1 and c["passed"] == 1
+
+
+def test_mid_frame_truncation_is_all_or_nothing():
+    """The acceptance property: a torn PAYLOAD frame must never leave a
+    partially-applied state — the server applies a frame only once it
+    has ALL of it (and decode precedes apply)."""
+    a, b = Node(0, E, 2), Node(1, E, 2)
+    with b:
+        # cut after 30 forwarded bytes: past the ~11-byte HELLO frame,
+        # inside the PAYLOAD frame carrying 20 adds
+        proxy = ChaosProxy(b.serve(), script=["truncate:30"])
+        with proxy:
+            a.add(*range(20))
+            with pytest.raises(PeerReset):
+                # torn frames surface as the RESET class (transport
+                # loss), which the supervisor retries — classification
+                # is part of the pinned behavior
+                a.sync_with(proxy_addr(proxy), timeout=3.0)
+            # the server saw a torn PAYLOAD: nothing may have applied
+            time.sleep(0.1)  # let the server handler finish unwinding
+            assert b.members().size == 0, \
+                "mid-frame truncation corrupted applied state"
+            assert proxy.counters()["truncated"] == 1
+            # script exhausted -> clean pass-through: now it converges
+            sync_eventually(a, proxy_addr(proxy))
+            np.testing.assert_array_equal(b.members(), np.arange(20))
+
+
+def test_garbled_magic_rejected_without_corruption():
+    """A flip in the frame preamble: the server rejects before decode,
+    the client sees the torn connection, nothing applies."""
+    a, b = Node(0, E, 2), Node(1, E, 2)
+    with b:
+        proxy = ChaosProxy(b.serve(), script=["garble:0"])
+        with proxy:
+            a.add(3, 7)
+            before = b.vv().copy()
+            with pytest.raises((SyncError, framing.RemoteError)):
+                a.sync_with(proxy_addr(proxy), timeout=3.0)
+            time.sleep(0.1)
+            assert b.members().size == 0
+            np.testing.assert_array_equal(b.vv(), before), \
+                "a garbled frame must not move the receiver's clock"
+            assert proxy.counters()["garbled"] == 1
+            sync_eventually(a, proxy_addr(proxy))
+            np.testing.assert_array_equal(b.members(), [3, 7])
+
+
+def test_garbled_body_field_rejected_as_remote_error():
+    """A flip inside the HELLO body (the element-universe varint): the
+    server's decode rejects it and reports MSG_ERROR — the client gets
+    the typed RemoteError, and again nothing applies."""
+    a, b = Node(0, E, 2), Node(1, E, 2)
+    with b:
+        # HELLO body layout: varint actor | varint E | vv-section; with
+        # magic(2)+type(1)+len(1) the E varint is frame byte 5
+        proxy = ChaosProxy(b.serve(), script=["garble:5"])
+        with proxy:
+            a.add(3)
+            with pytest.raises(framing.RemoteError,
+                               match="universe mismatch"):
+                a.sync_with(proxy_addr(proxy), timeout=3.0)
+            time.sleep(0.1)
+            assert b.members().size == 0
+            sync_eventually(a, proxy_addr(proxy))
+            np.testing.assert_array_equal(b.members(), [3])
+
+
+def test_duplicate_delivery_is_idempotent():
+    """The proxy records the client→server bytes and replays them on a
+    fresh connection: the same PAYLOAD applied twice — on the real wire
+    bytes — must be a no-op the second time (SURVEY §5.3 idempotence)."""
+    rec = Recorder()
+    a, b = Node(0, E, 2), Node(1, E, 2, recorder=rec)
+    with b:
+        proxy = ChaosProxy(b.serve(), script=["duplicate"])
+        with proxy:
+            a.add(1, 2, 3)
+            a.sync_with(proxy_addr(proxy), timeout=5.0)
+            members_after = set(b.members())
+            vv_after = b.vv().copy()
+            # wait for the ghost replay to hit the server
+            deadline = time.monotonic() + 10.0
+            while (rec.snapshot()["counters"].get("sync.exchanges", 0) < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert rec.snapshot()["counters"]["sync.exchanges"] == 2, \
+                "the duplicate delivery never reached the server"
+            assert set(b.members()) == members_after == {1, 2, 3}
+            np.testing.assert_array_equal(b.vv(), vv_after), \
+                "duplicate apply must not advance the clock"
+            assert proxy.counters()["duplicated"] == 1
+
+
+def test_seeded_scenario_rates_are_deterministic():
+    """Two proxies with the same seed and scenario plan identical fault
+    sequences (the determinism contract chaos runs replay on)."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    try:
+        sc = ChaosScenario(drop_rate=0.3, truncate_rate=0.2,
+                           duplicate_rate=0.2)
+        plans = []
+        for _ in range(2):
+            p = ChaosProxy(srv.getsockname()[:2], seed=99,
+                           scenario=dataclasses.replace(sc))
+            seq = [p._next_plan() for _ in range(40)]
+            plans.append([(pl.action, pl.cut_after, pl.duplicate)
+                          for pl in seq])
+            p.close()
+        assert plans[0] == plans[1]
+        acts = [a for a, _, _ in plans[0]]
+        assert "drop" in acts and "truncate" in acts, \
+            "at 30%/20% rates over 40 draws both faults must appear"
+    finally:
+        srv.close()
+
+
+def test_partition_refuses_then_heals():
+    a, b = Node(0, E, 2), Node(1, E, 2)
+    with b:
+        proxy = ChaosProxy(b.serve())
+        with proxy:
+            a.add(5)
+            proxy.partition()
+            with pytest.raises(SyncError):
+                a.sync_with(proxy_addr(proxy), timeout=3.0)
+            assert proxy.counters()["refused"] == 1
+            assert b.members().size == 0
+            proxy.heal()
+            sync_eventually(a, proxy_addr(proxy))
+            assert 5 in b.members()
+
+
+# -- the acceptance scenario ------------------------------------------------
+
+
+def test_seeded_chaos_fleet_acceptance(tmp_path):
+    """ISSUE acceptance: a seeded chaos scenario — ≥20% exchange drop,
+    one asymmetric partition that later heals, one guaranteed mid-frame
+    truncation — reaches full membership convergence across a ≥4-node
+    fleet, with breaker open/half-open/close transitions and
+    per-failure-class retry counts visible in Recorder.snapshot(), and a
+    killed-and-restored node (checkpoint restart) reconverging via the
+    FULL-state first-contact branch."""
+    from go_crdt_playground_tpu.net.framing import MODE_FULL
+
+    N_ACTIVE, N_ACTORS = 4, 5     # actor 4 joins late (FULL-path proof)
+    recs = [Recorder() for _ in range(N_ACTIVE)]
+    # a short server-side HELLO deadline keeps torn exchanges cheap so
+    # the chaos rounds stay fast (the client side inherits it too)
+    nodes = [Node(i, E, N_ACTORS, recorder=recs[i], hello_timeout_s=0.5)
+             for i in range(N_ACTIVE)]
+    proxies = []
+    sups = []
+    ck = str(tmp_path / "node3.ckpt")
+    try:
+        addrs = [n.serve() for n in nodes]
+        for i, n in enumerate(nodes):
+            n.add(*range(i * 8, i * 8 + 8))
+        scenario = ChaosScenario(drop_rate=0.25, truncate_rate=0.1,
+                                 duplicate_rate=0.1)
+        proxies = fleet_proxies(addrs, seed=17, scenario=scenario)
+        # one mid-frame truncation is GUARANTEED (not left to the rates):
+        # node 1's first inbound exchange tears inside the PAYLOAD frame
+        proxies[1]._script.append("truncate:30")
+        for i in range(N_ACTIVE):
+            peer_addrs = [proxy_addr(proxies[j])
+                          for j in range(N_ACTIVE) if j != i]
+            sups.append(SyncSupervisor(
+                nodes[i], peer_addrs, policy=FAST, sync_timeout_s=2.0,
+                breaker_threshold=2, breaker_cooldown_s=0.1,
+                interval_s=0.0, recorder=recs[i], seed=700 + i,
+                checkpoint_path=ck if i == 3 else None,
+                checkpoint_every=2 if i == 3 else 0))
+
+        def lockstep():
+            for s in sups:
+                s.sync_round()
+
+        expected = set(range(N_ACTIVE * 8))
+
+        def converged(members_expected, live_nodes):
+            vv0 = live_nodes[0].vv()
+            return all(set(n.members()) == members_expected
+                       and np.array_equal(n.vv(), vv0)
+                       for n in live_nodes)
+
+        # round 0 under loss, then partition node 0's inbound for three
+        # rounds (asymmetric: node 0 still dials OUT), then heal
+        lockstep()
+        proxies[0].partition()
+        for _ in range(3):
+            lockstep()
+            time.sleep(0.11)  # let breaker cooldowns elapse between rounds
+        proxies[0].heal()
+        deadline = time.monotonic() + 90.0
+        while not converged(expected, nodes):
+            assert time.monotonic() < deadline, (
+                "fleet failed to converge under chaos: " +
+                str([sorted(n.members()) for n in nodes]))
+            lockstep()
+            time.sleep(0.05)
+
+        # the chaos actually fired
+        census = {}
+        for p in proxies:
+            for k, v in p.counters().items():
+                census[k] = census.get(k, 0) + v
+        assert census["refused"] >= 1, "partition never refused a dial"
+        assert census["truncated"] >= 1, "no mid-frame truncation fired"
+        assert census["dropped"] >= 1, "25% drop rate never dropped"
+
+        # drain: the fleet can converge transitively before any OPEN
+        # breaker's half-open probe has fired — keep gossiping (the
+        # merge is idempotent; a converged fleet stays converged) until
+        # every breaker worked back to CLOSED, which is itself part of
+        # the acceptance story (open -> half-open -> closed visible)
+        def agg_counters():
+            out = {}
+            for r in recs:
+                for k, v in r.snapshot()["counters"].items():
+                    out[k] = out.get(k, 0) + v
+            return out
+
+        deadline = time.monotonic() + 60.0
+        while not all(
+                s.breaker(p).state == "closed"
+                for s in sups for p in s.peers):
+            assert time.monotonic() < deadline, \
+                "breakers never recovered after the heal"
+            lockstep()
+            time.sleep(0.11)
+
+        # degradation is visible in the recorders: breaker transitions
+        # and per-failure-class retry counts
+        agg = agg_counters()
+        assert agg.get("breaker.to_open", 0) >= 1, agg
+        assert agg.get("breaker.to_half_open", 0) >= 1, agg
+        assert agg.get("breaker.to_closed", 0) >= 1, agg
+        retry_classes = {k.split("sync.retries.")[1]: v
+                         for k, v in agg.items()
+                         if k.startswith("sync.retries.")}
+        assert retry_classes and all(v >= 1
+                                     for v in retry_classes.values()), agg
+        assert agg.get("sync.checkpoints", 0) >= 1, \
+            "node 3's supervisor never checkpointed"
+
+        # -- crash: kill node 3, fleet moves on ---------------------------
+        sups[3].stop(timeout=2.0)
+        nodes[3].close()
+        proxies[3].close()
+        nodes[0].add(40, 41)
+        for _ in range(2):
+            for s in sups[:3]:
+                s.sync_round()
+
+        # -- recovery: restore node 3 from its supervisor checkpoint ------
+        rec3 = Recorder()
+        sup3 = SyncSupervisor.restore(
+            ck, [proxy_addr(proxies[j]) for j in range(3)],
+            recorder=rec3, policy=FAST, sync_timeout_s=5.0,
+            interval_s=0.0, seed=703)
+        restored = sup3.node
+        assert restored.actor == 3
+        assert set(restored.members()) <= expected, \
+            "checkpoint must predate the kill"
+
+        # FULL-state first-contact branch: a late joiner (actor 4) that
+        # never exchanged with actor 3 — the restored node's first
+        # exchange toward it must ship FULL state
+        late = Node(4, E, N_ACTORS)
+        with late:
+            addr4 = late.serve()
+            late.add(44, 45)
+            restored.serve()
+            stats = restored.sync_with(addr4, timeout=5.0)
+            assert stats.mode_sent == MODE_FULL, \
+                "restored replica's first contact must ride FULL state"
+
+            # reconverge the whole (now 5-member) fleet; the survivors
+            # still sit behind their chaos proxies
+            expected2 = expected | {40, 41, 44, 45}
+            live = [nodes[0], nodes[1], nodes[2], restored, late]
+            deadline = time.monotonic() + 90.0
+            while not converged(expected2, live):
+                assert time.monotonic() < deadline, (
+                    "fleet failed to reconverge after restart: " +
+                    str([sorted(n.members()) for n in live]))
+                for s in sups[:3]:
+                    s.sync_round()
+                sup3.sync_round()
+                try:
+                    restored.sync_with(addr4, timeout=5.0)
+                except (OSError, framing.ProtocolError):
+                    pass
+                time.sleep(0.05)
+    finally:
+        for s in sups:
+            s.stop(timeout=1.0)
+        for p in proxies:
+            p.close()
+        for n in nodes:
+            n.close()
+
+
+# -- the long soak, CI-sized ------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_soak_quick_mode(tmp_path):
+    """tools/chaos_soak.py --quick must complete, converge at every
+    severity, and write a well-formed curve artifact.  slow-marked: the
+    tier-1 gate never pays for the soak."""
+    import json
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    import chaos_soak
+
+    out = str(tmp_path / "CHAOS_CURVE.json")
+    rc = chaos_soak.main(["--quick", "--out", out])
+    assert rc == 0
+    artifact = json.loads(Path(out).read_text())
+    assert artifact["curve"], "empty curve"
+    faulted = [e for e in artifact["curve"] if e["drop_rate"] > 0]
+    assert faulted and all(
+        e["faults_injected"]["dropped"] + e["faults_injected"]["truncated"]
+        > 0 for e in faulted), "quick soak injected no faults"
+    assert all(e["converged_runs"] == e["seeds"]
+               for e in artifact["curve"])

@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Socket-level chaos soak: rounds-to-convergence under injected faults.
+
+The tensor-layer DROP_CURVE.json measures convergence under drop masks —
+faults simulated INSIDE the kernels.  This tool measures the same
+north-star curve against the REAL wire stack: an in-process fleet of
+``net.peer.Node`` replicas, each serving behind a ``net.faults.ChaosProxy``
+(seeded drops-before-HELLO, mid-frame truncations, duplicate deliveries,
+an asymmetric partition episode that later heals), each driven by a
+``net.antientropy.SyncSupervisor`` (bounded retries, jittered backoff,
+per-peer circuit breakers).  One "round" is one supervisor pass over the
+peer set for every node, driven in lockstep so the x-axis matches the
+tensor curve's semantics.
+
+Output: CHAOS_CURVE.json — per-severity rounds-to-convergence
+(min/median/max over seeds), the injected-fault census, and the breaker
+transition counts, so the artifact proves the faults actually fired.
+
+Usage:
+    python tools/chaos_soak.py                # full sweep
+    python tools/chaos_soak.py --quick        # CI-sized (slow-marked
+                                              # pytest wraps this mode)
+    python tools/chaos_soak.py --out PATH     # default CHAOS_CURVE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_scenario(n_nodes: int, n_elements: int, drop_rate: float,
+                 truncate_rate: float, duplicate_rate: float, seed: int,
+                 max_rounds: int,
+                 partition_rounds: Optional[Tuple[int, int]] = None
+                 ) -> Dict[str, object]:
+    """One seeded fleet run; returns rounds-to-convergence + fault census.
+
+    ``partition_rounds=(a, b)`` asymmetrically partitions node 0 (its
+    proxy refuses all inbound; it still dials out) from round a until
+    round b, then heals.
+    """
+    from go_crdt_playground_tpu.net import Node, SyncSupervisor
+    from go_crdt_playground_tpu.net.faults import ChaosScenario, fleet_proxies
+    from go_crdt_playground_tpu.obs import Recorder
+    from go_crdt_playground_tpu.utils.backoff import BackoffPolicy
+
+    recorders = [Recorder() for _ in range(n_nodes)]
+    nodes = [Node(i, n_elements, n_nodes, recorder=recorders[i],
+                  conn_timeout_s=10.0, hello_timeout_s=0.5)
+             for i in range(n_nodes)]
+    supervisors: List[SyncSupervisor] = []
+    proxies = []
+    per_node = n_elements // n_nodes
+    try:
+        addrs = [n.serve() for n in nodes]
+        for i, n in enumerate(nodes):
+            n.add(*range(i * per_node, (i + 1) * per_node))
+        scenario = ChaosScenario(drop_rate=drop_rate,
+                                 truncate_rate=truncate_rate,
+                                 duplicate_rate=duplicate_rate)
+        proxies = fleet_proxies(addrs, seed=seed, scenario=scenario)
+        policy = BackoffPolicy(base_s=0.005, cap_s=0.05, max_retries=2)
+        for i in range(n_nodes):
+            peer_addrs = [("127.0.0.1", proxies[j].port)
+                          for j in range(n_nodes) if j != i]
+            # fanout 1: one partner per node per round — the socket
+            # analogue of the tensor curve's one-partner-per-round
+            # pairing, which is what makes the x-axes comparable
+            supervisors.append(SyncSupervisor(
+                nodes[i], peer_addrs, policy=policy,
+                sync_timeout_s=1.0, hello_timeout_s=0.4,
+                breaker_threshold=2, breaker_cooldown_s=0.1,
+                fanout=1, interval_s=0.0,
+                recorder=recorders[i], seed=seed * 100 + i))
+
+        expected = set(range(per_node * n_nodes))
+
+        def converged() -> bool:
+            import numpy as np
+
+            vv0 = nodes[0].vv()
+            return all(set(n.members()) == expected
+                       and np.array_equal(n.vv(), vv0) for n in nodes)
+
+        rounds = None
+        for rnd in range(max_rounds):
+            if partition_rounds is not None:
+                if rnd == partition_rounds[0]:
+                    proxies[0].partition()
+                elif rnd == partition_rounds[1]:
+                    proxies[0].heal()
+            for sup in supervisors:
+                sup.sync_round()
+            # never report convergence while the partition still holds a
+            # node dark — the healed fleet must RE-converge
+            in_partition = (partition_rounds is not None
+                            and partition_rounds[0] <= rnd
+                            < partition_rounds[1])
+            if not in_partition and converged():
+                rounds = rnd + 1
+                break
+
+        faults: Dict[str, int] = {}
+        for p in proxies:
+            for k, v in p.counters().items():
+                faults[k] = faults.get(k, 0) + v
+        breaker: Dict[str, int] = {}
+        retries = 0
+        for r in recorders:
+            snap = r.snapshot()["counters"]
+            for k, v in snap.items():
+                if k.startswith("breaker.to_"):
+                    breaker[k] = breaker.get(k, 0) + v
+                elif k.startswith("sync.retries."):
+                    retries += v
+        return {"rounds": rounds, "converged": rounds is not None,
+                "faults": faults, "breaker": breaker, "retries": retries}
+    finally:
+        for sup in supervisors:
+            sup.stop(timeout=1.0)
+        for p in proxies:
+            p.close()
+        for n in nodes:
+            n.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized sweep (the slow-marked pytest wrapper)")
+    ap.add_argument("--nodes", type=int, default=None)
+    ap.add_argument("--elements", type=int, default=None)
+    ap.add_argument("--seeds", type=int, default=None)
+    ap.add_argument("--max-rounds", type=int, default=60)
+    ap.add_argument("--out", default=os.path.join(REPO, "CHAOS_CURVE.json"))
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        n_nodes = args.nodes or 4
+        n_elements = args.elements or 32
+        n_seeds = args.seeds or 1
+        severities = [0.0, 0.25]
+    else:
+        n_nodes = args.nodes or 6
+        n_elements = args.elements or 60
+        n_seeds = args.seeds or 3
+        severities = [0.0, 0.1, 0.2, 0.3, 0.4]
+
+    t0 = time.time()
+    curve = []
+    for sev in severities:
+        runs = []
+        for s in range(n_seeds):
+            # severity drives BOTH connection-drop and truncation odds;
+            # every faulted severity also gets duplicates and a
+            # partition episode so the curve always exercises the
+            # heal + reconverge path, not just loss
+            runs.append(run_scenario(
+                n_nodes, n_elements,
+                drop_rate=sev, truncate_rate=sev / 2,
+                duplicate_rate=0.1 if sev > 0 else 0.0,
+                seed=11 + s, max_rounds=args.max_rounds,
+                partition_rounds=(0, 2) if sev > 0 else None))
+        rounds = [r["rounds"] for r in runs if r["converged"]]
+        faults: Dict[str, int] = {}
+        breaker: Dict[str, int] = {}
+        for r in runs:
+            for k, v in r["faults"].items():
+                faults[k] = faults.get(k, 0) + v
+            for k, v in r["breaker"].items():
+                breaker[k] = breaker.get(k, 0) + v
+        entry = {
+            "drop_rate": sev,
+            "truncate_rate": sev / 2,
+            "converged_runs": len(rounds),
+            "seeds": n_seeds,
+            "rounds_min": min(rounds) if rounds else None,
+            "rounds_median": (int(statistics.median(rounds))
+                              if rounds else None),
+            "rounds_max": max(rounds) if rounds else None,
+            "faults_injected": faults,
+            "breaker_transitions": breaker,
+            "retries": sum(r["retries"] for r in runs),
+        }
+        curve.append(entry)
+        print(json.dumps({"severity": sev, **{
+            k: entry[k] for k in ("rounds_median", "converged_runs",
+                                  "retries")}}), flush=True)
+
+    artifact = {
+        "metric": ("socket-level rounds-to-convergence vs fault severity "
+                   f"({n_nodes}-node Node fleet behind ChaosProxy, "
+                   "SyncSupervisor retries+breakers, lockstep rounds)"),
+        "value": next((e["rounds_median"] for e in curve
+                       if e["drop_rate"] == 0.0), None),
+        "unit": "rounds (at severity 0)",
+        "fleet": {"nodes": n_nodes, "elements": n_elements,
+                  "quick": bool(args.quick)},
+        "curve": curve,
+        "elapsed_s": round(time.time() - t0, 1),
+        "platform": "cpu",
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"wrote {args.out}")
+    # honest exit: a sweep where any severity failed to converge is a
+    # failure, not a curve
+    return 0 if all(e["converged_runs"] == e["seeds"] for e in curve) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
