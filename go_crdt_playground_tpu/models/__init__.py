@@ -6,5 +6,7 @@ for each CRDT family.
 """
 
 from go_crdt_playground_tpu.models import spec
+from go_crdt_playground_tpu.models.digest import (array_digest,  # noqa: F401
+                                                  state_digest)
 
-__all__ = ["spec"]
+__all__ = ["spec", "array_digest", "state_digest"]

@@ -14,7 +14,9 @@ from go_crdt_playground_tpu.net.antientropy import (CircuitBreaker,  # noqa: F40
                                                     SyncSupervisor,
                                                     classify_failure)
 from go_crdt_playground_tpu.net.faults import (ChaosProxy,  # noqa: F401
-                                               ChaosScenario)
+                                               ChaosScenario,
+                                               StorageFaults,
+                                               StorageScenario)
 from go_crdt_playground_tpu.net.peer import (ConnectFailed,  # noqa: F401
                                              Node, PeerProtocolError,
                                              PeerReset, PeerTimeout,

@@ -243,6 +243,14 @@ class SyncSupervisor:
 
     ``sleep`` and ``clock`` are injectable for wall-time-free tests; all
     randomness derives from ``seed``.
+
+    Checkpoint regimes (mutually exclusive): ``checkpoint_path`` is the
+    legacy single-file ``Node.save`` dump; ``durable_dir`` is the full
+    durability ladder (DESIGN.md §14) — a generational verified
+    ``CheckpointStore`` plus a ``DeltaWal`` attached to the node (if it
+    has none), so every merged/local δ is durable between checkpoints
+    and each ``checkpoint()`` truncates the log it just superseded.
+    ``SyncSupervisor.restore_durable`` is the matching restart path.
     """
 
     def __init__(self, node: Node, peers: Sequence[Addr], *,
@@ -257,9 +265,16 @@ class SyncSupervisor:
                  interval_jitter: float = 0.2,
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every: int = 0,
+                 durable_dir: Optional[str] = None,
+                 keep_generations: int = 3,
+                 wal_fsync: bool = True,
                  recorder=None, seed: int = 0,
                  sleep: Callable[[float], None] = time.sleep,
                  clock: Callable[[], float] = time.monotonic):
+        if durable_dir is not None and checkpoint_path is not None:
+            raise ValueError(
+                "durable_dir and checkpoint_path are alternative "
+                "checkpoint regimes; pass one")
         self.node = node
         self.policy = policy if policy is not None else BackoffPolicy()
         self.sync_timeout_s = sync_timeout_s
@@ -274,7 +289,23 @@ class SyncSupervisor:
         self.interval_jitter = interval_jitter
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = checkpoint_every
+        self.durable_dir = durable_dir
         self.recorder = recorder if recorder is not None else node.recorder
+        self._store = None
+        if durable_dir is not None:
+            from go_crdt_playground_tpu.utils.checkpoint import \
+                CheckpointStore
+            from go_crdt_playground_tpu.utils.wal import DeltaWal
+            import os as _os
+
+            self._store = CheckpointStore(
+                durable_dir, keep=keep_generations, recorder=self.recorder)
+            if node.wal is None:
+                # attach the log so every delta the supervisor's rounds
+                # merge (and every local mutation) is durable between
+                # the periodic checkpoints
+                node.wal = DeltaWal(_os.path.join(durable_dir, "wal"),
+                                    fsync=wal_fsync, recorder=self.recorder)
         self.seed = seed
         self._sleep = sleep
         self._clock = clock
@@ -355,11 +386,21 @@ class SyncSupervisor:
                 continue
             ok = self._sync_peer(addr, breaker)
             summary["succeeded" if ok else "failed"] += 1
+        if self.node.full_resync_pending:
+            # regressed-restore healing epoch: once every registered
+            # peer has served a forced-FULL exchange, the durable
+            # resync-pending flag can be retired
+            all_peers = self.peers
+            if all_peers and all(self.node.full_resync_done_for(p)
+                                 for p in all_peers):
+                self.node.clear_full_resync()
+                self._count("sync.full_resync_complete")
         self._count("sync.supervisor.rounds")
         with self._lock:
             self._rounds_done += 1
             rounds = self._rounds_done
-        if (self.checkpoint_path and self.checkpoint_every > 0
+        if ((self.checkpoint_path or self._store is not None)
+                and self.checkpoint_every > 0
                 and rounds % self.checkpoint_every == 0):
             self.checkpoint()
         return summary
@@ -481,12 +522,20 @@ class SyncSupervisor:
     # -- crash / recovery --------------------------------------------------
 
     def checkpoint(self) -> Optional[str]:
-        """Periodic crash-recovery dump (Node.save); returns the path."""
+        """Periodic crash-recovery dump.  With ``durable_dir`` this is
+        the full durability contract — ``Node.save_durable`` writes the
+        next verified generation AND truncates the WAL under one node
+        lock hold (the truncated records are exactly the ones the dump
+        contains); without it, the legacy single-file ``Node.save``.
+        Returns the written path."""
+        meta = {"supervisor_rounds": self._rounds_done}
+        if self._store is not None:
+            gen = self.node.save_durable(self._store, metadata=meta)
+            self._count("sync.checkpoints")
+            return self._store.path_for(gen)
         if not self.checkpoint_path:
             return None
-        path = self.node.save(self.checkpoint_path,
-                              metadata={"supervisor_rounds":
-                                        self._rounds_done})
+        path = self.node.save(self.checkpoint_path, metadata=meta)
         self._count("sync.checkpoints")
         return path
 
@@ -503,3 +552,20 @@ class SyncSupervisor:
         # (or pass checkpoint_every) without a duplicate-kwarg TypeError
         kwargs.setdefault("checkpoint_path", checkpoint_path)
         return cls(node, peers, recorder=recorder, **kwargs)
+
+    @classmethod
+    def restore_durable(cls, durable_dir: str, peers: Sequence[Addr],
+                        recorder=None, *, min_generation: int = 0,
+                        keep_generations: int = 3, fallback_init=None,
+                        **kwargs) -> "SyncSupervisor":
+        """Crash-recovery restart: newest VALID checkpoint generation
+        (falling back past corrupt ones, fenced by ``min_generation``)
+        plus a replay of the WAL tail (``Node.restore_durable``), wrapped
+        in a fresh supervisor that keeps checkpointing into the same
+        directory.  Anti-entropy then heals whatever the WAL-tail window
+        lost — at most the record in flight at the kill."""
+        node = Node.restore_durable(
+            durable_dir, recorder=recorder, min_generation=min_generation,
+            keep=keep_generations, fallback_init=fallback_init)
+        return cls(node, peers, recorder=recorder, durable_dir=durable_dir,
+                   keep_generations=keep_generations, **kwargs)

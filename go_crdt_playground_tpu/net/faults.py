@@ -30,11 +30,19 @@ exact placement — from an explicit ``script`` of actions consumed
 first-connection-first.  Counters for every injected fault are exposed
 via ``counters()`` so tests can assert the chaos actually happened
 (a green chaos test with zero injected faults is a broken test).
+
+The ``storage`` namespace (``StorageScenario`` / ``StorageFaults``)
+extends the same vocabulary to what DISKS do — torn writes, bit-flips,
+zero-fills against WAL segments and checkpoint generations — seeded and
+counter-exposed exactly like the socket faults, and consumable from the
+same ``ChaosScenario`` config (its ``storage`` field).  The crash soak
+(tools/crash_soak.py) is its primary driver.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 import random
 import socket
 import threading
@@ -49,6 +57,12 @@ ACT_TRUNCATE = "truncate"     # "truncate:<nbytes>" — cut mid-frame
 ACT_DELAY = "delay"           # "delay:<seconds>"
 ACT_DUPLICATE = "duplicate"   # replay the client bytes after the exchange
 ACT_GARBLE = "garble"         # flip one byte of the client->server stream
+
+# storage-namespace verbs (StorageFaults — file-level, for the
+# durability layer's WAL segments and checkpoint generations)
+STORAGE_TORN = "torn_write"   # truncate the file tail (a cut-short write)
+STORAGE_BITFLIP = "bit_flip"  # flip one bit near the tail (bit rot)
+STORAGE_ZERO = "zero_fill"    # zero a tail span (a lost-then-zeroed page)
 
 _RECORD_CAP = 1 << 20  # duplicate-replay buffer bound per connection
 
@@ -70,11 +84,45 @@ def _validate_script_entry(entry: str) -> None:
 
 
 @dataclass
+class StorageScenario:
+    """File-level fault rates — the ``storage`` namespace of the fault
+    vocabulary, covering what disks (not sockets) do to the durability
+    layer: torn writes (a crash mid-append cuts the file short),
+    bit-flips (media rot under a checkpoint that is never re-read until
+    recovery), and zero-fills (a journaling filesystem replaying a
+    metadata-only commit).  Rates are drawn per ``StorageFaults.inject``
+    call in fixed order (torn, bit-flip, zero-fill; at most one fires),
+    the same constant-draw-count determinism contract as the socket
+    scenario above.  Faults target the last ``tail_window`` bytes of the
+    file — the region recovery scans treat as the untrusted tail."""
+
+    torn_write_rate: float = 0.0
+    bit_flip_rate: float = 0.0
+    zero_fill_rate: float = 0.0
+    tail_window: int = 256
+    max_zero_span: int = 64
+
+    def __post_init__(self) -> None:
+        for name in ("torn_write_rate", "bit_flip_rate", "zero_fill_rate"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name}={v} outside [0, 1]")
+        if self.tail_window < 1:
+            raise ValueError(f"tail_window={self.tail_window} must be >= 1")
+        if self.max_zero_span < 1:
+            raise ValueError(
+                f"max_zero_span={self.max_zero_span} must be >= 1")
+
+
+@dataclass
 class ChaosScenario:
     """Per-connection fault rates (each drawn independently, in this
     order: drop, truncate, garble, delay, duplicate — at most one of
     drop/truncate/garble fires per connection; delay and duplicate
-    compose with any of them)."""
+    compose with any of them).  ``storage`` carries the file-level fault
+    rates of the same chaos run (consumed by ``StorageFaults``, e.g. the
+    crash soak's storage_faults hook) so one scenario object describes
+    both the wire and the disk."""
 
     drop_rate: float = 0.0
     truncate_rate: float = 0.0
@@ -84,6 +132,7 @@ class ChaosScenario:
     delay_s: float = 0.02
     duplicate_rate: float = 0.0
     partitioned: bool = False
+    storage: Optional[StorageScenario] = None
 
     def __post_init__(self) -> None:
         for name in ("drop_rate", "truncate_rate", "garble_rate",
@@ -347,6 +396,181 @@ class ChaosProxy:
                     pass
         except OSError:
             pass  # the duplicate is best-effort by design
+
+
+# ---------------------------------------------------------------------------
+# Storage faults — the durability layer's chaos counterpart
+# ---------------------------------------------------------------------------
+
+
+class StorageFaults:
+    """Deterministic file corruptor for WAL segments and checkpoint
+    generations (the crash soak's ``storage_faults`` hook).  Seeded like
+    ``ChaosProxy``: every ``inject`` makes the same fixed number of RNG
+    draws whatever fires, so a scenario's fault stream stays aligned
+    across runs even when rates differ.  The explicit verbs
+    (``torn_write`` / ``bit_flip`` / ``zero_fill``) bypass the rates for
+    tests and guaranteed-corruption placement, mirroring ChaosProxy's
+    script entries.  Only ever point this at files you own — it mutates
+    them in place."""
+
+    def __init__(self, scenario: Optional[StorageScenario] = None,
+                 seed: int = 0):
+        self.scenario = (scenario if scenario is not None
+                         else StorageScenario())
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {
+            "inject_calls": 0, "torn_writes": 0, "bit_flips": 0,
+            "zero_fills": 0, "skipped_empty": 0, "passed": 0,
+        }
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    # -- rate-driven entry point -------------------------------------------
+
+    def inject(self, path: str) -> Optional[str]:
+        """Maybe corrupt ``path`` per the scenario rates; returns the
+        storage verb that fired, or None.  Draw order (constant count):
+        torn, cut-fraction, flip, flip-offset, flip-bit, zero,
+        zero-offset, zero-span."""
+        s = self.scenario
+        with self._lock:
+            self._counters["inject_calls"] += 1
+            r_torn = self._rng.random()
+            f_cut = self._rng.random()
+            r_flip = self._rng.random()
+            f_off = self._rng.random()
+            bit = self._rng.randrange(8)
+            r_zero = self._rng.random()
+            f_zoff = self._rng.random()
+            span = 1 + self._rng.randrange(s.max_zero_span)
+            size = self._file_size(path)
+            if size <= 0:
+                self._counters["skipped_empty"] += 1
+                return None
+            window = min(s.tail_window, size)
+            if r_torn < s.torn_write_rate:
+                cut = 1 + int(f_cut * (window - 1))
+                self._torn_write_locked(path, size, cut)
+                return STORAGE_TORN
+            if r_flip < s.bit_flip_rate:
+                off = size - window + int(f_off * window)
+                self._bit_flip_locked(path, min(off, size - 1), bit)
+                return STORAGE_BITFLIP
+            if r_zero < s.zero_fill_rate:
+                off = size - window + int(f_zoff * window)
+                self._zero_fill_locked(path, min(off, size - 1), span)
+                return STORAGE_ZERO
+            self._counters["passed"] += 1
+            return None
+
+    # -- explicit verbs (scripted placement) --------------------------------
+
+    def torn_write(self, path: str, cut_bytes: Optional[int] = None) -> None:
+        """Cut the last ``cut_bytes`` (default: a seeded draw inside the
+        tail window) off the file — a write that never finished."""
+        with self._lock:
+            size = self._file_size(path)
+            if size <= 0:
+                self._counters["skipped_empty"] += 1
+                return
+            if cut_bytes is None:
+                window = min(self.scenario.tail_window, size)
+                cut_bytes = 1 + self._rng.randrange(window)
+            self._torn_write_locked(path, size, min(cut_bytes, size))
+
+    def bit_flip(self, path: str, offset: Optional[int] = None,
+                 bit: Optional[int] = None) -> None:
+        """Flip one bit (default: seeded position in the tail window)."""
+        with self._lock:
+            size = self._file_size(path)
+            if size <= 0:
+                self._counters["skipped_empty"] += 1
+                return
+            if offset is None:
+                window = min(self.scenario.tail_window, size)
+                offset = size - window + self._rng.randrange(window)
+            if bit is None:
+                bit = self._rng.randrange(8)
+            self._bit_flip_locked(path, min(offset, size - 1), bit)
+
+    def bit_flip_array(self, path: str, member: Optional[str] = None) -> None:
+        """Flip one bit inside the DATA region of an ``.npz`` member
+        (default: the largest non-manifest member, seeded offset within
+        it) — guaranteed-meaningful checkpoint corruption.  A blind
+        tail/middle flip on a small checkpoint often lands in zip or
+        .npy framing bytes that loaders never re-read, silently passing;
+        this verb parses the container so the flip always hits bytes the
+        restore-time digest verification covers."""
+        import zipfile
+
+        with self._lock:
+            try:
+                with zipfile.ZipFile(path) as z:
+                    infos = [i for i in z.infolist()
+                             if (i.filename == member if member is not None
+                                 else "manifest" not in i.filename)]
+            except (OSError, zipfile.BadZipFile):
+                self._counters["skipped_empty"] += 1
+                return
+            if not infos:
+                self._counters["skipped_empty"] += 1
+                return
+            zi = max(infos, key=lambda i: i.file_size)
+            with open(path, "r+b") as f:
+                # local file header: 30 fixed bytes, name, extra field
+                f.seek(zi.header_offset + 26)
+                name_len = int.from_bytes(f.read(2), "little")
+                extra_len = int.from_bytes(f.read(2), "little")
+                data_start = (zi.header_offset + 30 + name_len + extra_len)
+            offset = data_start + self._rng.randrange(max(1, zi.file_size))
+            self._bit_flip_locked(path, offset, self._rng.randrange(8))
+
+    def zero_fill(self, path: str, offset: Optional[int] = None,
+                  span: Optional[int] = None) -> None:
+        """Zero ``span`` bytes (default: seeded tail placement/length)."""
+        with self._lock:
+            size = self._file_size(path)
+            if size <= 0:
+                self._counters["skipped_empty"] += 1
+                return
+            if offset is None:
+                window = min(self.scenario.tail_window, size)
+                offset = size - window + self._rng.randrange(window)
+            if span is None:
+                span = 1 + self._rng.randrange(self.scenario.max_zero_span)
+            self._zero_fill_locked(path, min(offset, size - 1), span)
+
+    # -- primitives (caller holds the lock) ---------------------------------
+
+    @staticmethod
+    def _file_size(path: str) -> int:
+        try:
+            return os.path.getsize(path)
+        except OSError:
+            return -1
+
+    def _torn_write_locked(self, path: str, size: int, cut: int) -> None:
+        with open(path, "r+b") as f:
+            f.truncate(max(0, size - cut))
+        self._counters["torn_writes"] += 1
+
+    def _bit_flip_locked(self, path: str, offset: int, bit: int) -> None:
+        with open(path, "r+b") as f:
+            f.seek(offset)
+            b = f.read(1)
+            f.seek(offset)
+            f.write(bytes([b[0] ^ (1 << bit)]))
+        self._counters["bit_flips"] += 1
+
+    def _zero_fill_locked(self, path: str, offset: int, span: int) -> None:
+        with open(path, "r+b") as f:
+            f.seek(offset)
+            f.write(b"\x00" * span)  # may extend past EOF; still a tear
+        self._counters["zero_fills"] += 1
 
 
 def fleet_proxies(addrs: Sequence[Tuple[str, int]], seed: int = 0,

@@ -53,6 +53,7 @@ failure classes for retry, circuit-breaker, and metric treatment.
 
 from __future__ import annotations
 
+import os
 import socket
 import threading
 from typing import NamedTuple, Optional, Tuple
@@ -132,15 +133,33 @@ class Node:
                  strict_reference_semantics: bool = True,
                  recorder=None, conn_timeout_s: Optional[float] = None,
                  hello_timeout_s: Optional[float] = None,
-                 max_conns: Optional[int] = None):
+                 max_conns: Optional[int] = None, wal=None):
         """recorder: optional obs.Recorder; when given, every exchange
         counts sync.exchanges / sync.bytes_sent / sync.bytes_received /
-        sync.full_payloads on it (served and initiated alike)."""
+        sync.full_payloads on it (served and initiated alike).
+
+        wal: optional utils.wal.DeltaWal.  When attached (here or by
+        plain assignment later), every applied PAYLOAD body and every
+        local mutation's δ is durably logged BEFORE the state mutation
+        is acknowledged, so a kill between checkpoints loses at most the
+        in-flight record (the documented WAL-tail window) — see
+        ``replay_wal`` / ``restore_durable`` for the recovery half."""
         from go_crdt_playground_tpu.models import awset_delta
 
         if not 0 <= actor < num_actors:
             raise ValueError(f"actor {actor} outside actor axis {num_actors}")
         self.recorder = recorder
+        self.wal = wal
+        self.generation = 0  # last durably-restored/saved store generation
+        # regressed-restore healing epoch (see restore_durable): while
+        # pending, the first exchange with each peer advertises a ZERO
+        # vv so the peer ships FULL state — a replayed WAL record whose
+        # src_vv outran a regressed base may have fast-forwarded our vv
+        # past lanes we never received, and delta compression would hide
+        # that hole forever
+        self.full_resync_pending = False
+        self._full_resync_done: set = set()
+        self._resync_flag_path: Optional[str] = None
         self.actor = actor
         self.num_elements = num_elements
         self.num_actors = num_actors
@@ -188,9 +207,13 @@ class Node:
         padded = np.zeros(bucket, np.uint32)
         padded[:k] = element_ids
         with self._lock:
+            pre_vv = (np.asarray(self._state.vv[0]).copy()
+                      if self.wal is not None else None)
             self._state = awset_delta.add_elements(
                 self._state, jnp.uint32(0), jnp.asarray(padded),
                 jnp.uint32(k))
+            if pre_vv is not None:
+                self._log_local_delta(pre_vv)
 
     def delete(self, *element_ids: int) -> None:
         """δ-Del: one clock tick per call, one shared deletion dot for all
@@ -206,8 +229,12 @@ class Node:
                                  f"{self.num_elements}")
             selector[e] = True
         with self._lock:
+            pre_vv = (np.asarray(self._state.vv[0]).copy()
+                      if self.wal is not None else None)
             self._state = awset_delta.del_elements(
                 self._state, jnp.uint32(0), jnp.asarray(selector))
+            if pre_vv is not None:
+                self._log_local_delta(pre_vv)
 
     def members(self) -> np.ndarray:
         """Sorted live element ids (SortedValues, awset.go:61-70, on ids)."""
@@ -266,6 +293,16 @@ class Node:
 
         mode, payload = framing.decode_payload_msg(
             body, self.num_elements, self.num_actors)
+        # write-AHEAD: the decoded-valid body hits the log before the
+        # state mutates, so a crash can only lose the in-flight record,
+        # never log an effect it then fails to persist.  Replay is an
+        # idempotent merge, so an extra logged-but-unapplied record is
+        # harmless.  The record is prefixed with a replay GUARD — our
+        # pre-apply vv, the causal context the delta's compression
+        # assumed — so recovery can refuse records that outrun a
+        # regressed base (see replay_wal).
+        if self.wal is not None:
+            self.wal.append(self._guard_bytes() + body)
         me = jax.tree.map(lambda x: x[0], self._state)
         if mode == MODE_FULL:
             src = AWSetDeltaState(
@@ -286,6 +323,89 @@ class Node:
         self._state = jax.tree.map(
             lambda full, row: full.at[0].set(row), self._state, merged)
         return mode
+
+    def _guard_bytes(self, vv: Optional[np.ndarray] = None) -> bytes:
+        """Encode the replay guard: the vv this record's δ-compression
+        was computed against (default: our current vv).  Caller holds
+        the lock."""
+        from go_crdt_playground_tpu.utils import wire
+
+        if vv is None:
+            vv = np.asarray(self._state.vv[0])
+        return wire._encode_vv_py(np.asarray(vv, np.uint32))
+
+    def _log_local_delta(self, pre_vv: np.ndarray) -> None:
+        """WAL a local mutation as the δ it produced vs the pre-op VV —
+        the same PAYLOAD-body wire form merged deltas are logged in, so
+        one replay path serves both.  The guard is the pre-op vv (the
+        δ contains exactly the changes since it).  Caller holds the
+        lock."""
+        import jax
+        import jax.numpy as jnp
+
+        from go_crdt_playground_tpu.ops import delta as delta_ops
+
+        me = jax.tree.map(lambda x: x[0], self._state)
+        payload = delta_ops.delta_extract(me, jnp.asarray(pre_vv))
+        body = framing.encode_payload_msg(
+            MODE_DELTA, self.actor, np.asarray(me.processed), payload)
+        self.wal.append(self._guard_bytes(pre_vv) + body)
+
+    def replay_wal(self, wal) -> dict:
+        """Apply every intact, CAUSALLY-SAFE WAL record (oldest-first)
+        through the normal payload-apply path — the recovery half of
+        the WAL contract: state = checkpoint ⊔ replay(tail).
+
+        Three stop conditions, one prefix rule (trust nothing after the
+        first bad record):
+
+        * the scan itself stops at the first CRC/framing tear;
+        * an undecodable-but-CRC-clean body (``wal.bad_records``);
+        * a record whose replay GUARD (the vv its δ-compression was
+          computed against) is not covered by the current state
+          (``wal.future_records``) — on a REGRESSED base (checkpoint
+          generation fallback) such a record would fast-forward our vv
+          past lanes delivered only in already-truncated records,
+          punching a hole that δ-compression hides forever and that
+          full-merge reads as an observed REMOVE.  Refusing it keeps
+          the state causally consistent; anti-entropy re-ships the gap.
+
+        Idempotent: records whose effects the checkpoint already
+        contains merge to no-ops.  Counts ``wal.records`` (replayed) on
+        the recorder.  Detaches ``self.wal`` for the duration so replay
+        never re-logs its own records."""
+        from go_crdt_playground_tpu.utils import wire
+
+        replayed = bad = future = 0
+        saved, self.wal = self.wal, None
+        try:
+            for body in wal.records():
+                try:
+                    guard, pos = wire._decode_vv_py(body, 0,
+                                                    self.num_actors)
+                    with self._lock:
+                        if np.any(np.asarray(guard, np.uint32)
+                                  > np.asarray(self._state.vv[0])):
+                            future += 1
+                            break
+                        self._apply_msg(body[pos:])
+                except (ProtocolError, ValueError):
+                    # CRC-clean but semantically unreadable (e.g. a
+                    # dimension change since the log was written): same
+                    # prefix rule as a torn record — trust nothing after
+                    bad += 1
+                    break
+                replayed += 1
+        finally:
+            self.wal = saved
+        if self.recorder is not None:
+            if replayed:
+                self.recorder.count("wal.records", replayed)
+            if bad:
+                self.recorder.count("wal.bad_records", bad)
+            if future:
+                self.recorder.count("wal.future_records", future)
+        return {"replayed": replayed, "bad": bad, "future": future}
 
     # -- server -------------------------------------------------------------
 
@@ -441,6 +561,145 @@ class Node:
         node._state = ck.state
         return node
 
+    def full_resync_done_for(self, addr: Tuple[str, int]) -> bool:
+        return (addr[0], int(addr[1])) in self._full_resync_done
+
+    def clear_full_resync(self) -> None:
+        """End the regressed-restore healing epoch: every peer has served
+        a FULL exchange (the supervisor calls this once its whole peer
+        set is covered), so the durable flag can go."""
+        self.full_resync_pending = False
+        self._full_resync_done.clear()
+        if self._resync_flag_path is not None:
+            try:
+                os.unlink(self._resync_flag_path)
+            except OSError:
+                pass
+
+    def _node_metadata(self, metadata: Optional[dict]) -> dict:
+        meta = dict(metadata or {})
+        meta.update(
+            actor=self.actor,
+            delta_semantics=self.delta_semantics,
+            strict_reference_semantics=self.strict_reference_semantics,
+        )
+        return meta
+
+    def save_durable(self, store, metadata: Optional[dict] = None) -> int:
+        """Checkpoint into a generational ``utils.checkpoint.
+        CheckpointStore`` and retire the WAL records the dump contains.
+
+        Two-phase so the expensive state dump never stalls concurrent
+        exchanges: under the node lock (cheap) the state reference is
+        snapshotted and the WAL is SEALED (rotated — records appended
+        afterwards land in a fresh segment); the dump itself runs
+        outside the lock; the sealed segments are dropped only once the
+        checkpoint is durable.  The dropped records are thus exactly
+        the ones whose effects the snapshot contains.  A crash anywhere
+        in between merely leaves pre-checkpoint segments behind —
+        replay re-merges them idempotently.  Single writer per store
+        (the same assumption the store's generation numbering makes).
+        Returns the new generation number."""
+        meta = self._node_metadata(metadata)
+        with self._lock:
+            state = self._state  # states are immutable pytrees: a
+            sealed = (self.wal.seal()  # reference IS a snapshot
+                      if self.wal is not None else None)
+        gen = store.save(state, metadata=meta)
+        if sealed is not None and self.wal is not None:
+            self.wal.drop_segments(sealed)
+        self.generation = gen
+        return gen
+
+    @classmethod
+    def restore_durable(cls, dirpath: str, *, recorder=None,
+                        min_generation: int = 0, keep: int = 3,
+                        fallback_init=None) -> "Node":
+        """Full crash-recovery path: newest VALID checkpoint generation
+        (fallback past corrupt ones, fenced by ``min_generation``) plus
+        a replay of the WAL tail, with the WAL left attached so the
+        recovered node keeps logging.  ``fallback_init`` (a zero-arg
+        Node factory) covers the died-before-first-checkpoint case —
+        the store is empty but the WAL may still hold the entire
+        history.  The restored node is not serving; call ``serve()``
+        to rejoin."""
+        import os as _os
+
+        from go_crdt_playground_tpu.utils.checkpoint import (
+            CheckpointCorrupt, CheckpointStore)
+        from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+        store = CheckpointStore(dirpath, keep=keep, recorder=recorder)
+        latest_on_disk = store.latest_generation()
+        fell_back = False
+        try:
+            gen, ck = store.restore(min_generation=min_generation)
+        except (FileNotFoundError, CheckpointCorrupt):
+            # empty store, or EVERY generation failed verification: with
+            # a fallback factory, recovery proceeds from a fresh state +
+            # WAL replay + anti-entropy FULL resync instead of aborting
+            # (each skipped generation already counted restore.fallbacks)
+            if fallback_init is None:
+                raise
+            node = fallback_init()
+            if node.recorder is None:
+                # the factory usually omits it; without this the replay
+                # counters (wal.records / wal.future_records) vanish
+                node.recorder = recorder
+            gen = 0
+            fell_back = latest_on_disk > 0
+        else:
+            meta = ck.metadata
+            missing = [k for k in ("actor", "delta_semantics",
+                                   "strict_reference_semantics")
+                       if k not in meta]
+            if missing:
+                raise ValueError(
+                    f"checkpoint store at {dirpath!r} lacks node metadata "
+                    f"{missing}: restore_durable needs checkpoints written "
+                    "by Node.save_durable")
+            node = cls(
+                actor=int(meta["actor"]),
+                num_elements=int(ck.state.present.shape[-1]),
+                num_actors=int(ck.state.vv.shape[-1]),
+                delta_semantics=meta["delta_semantics"],
+                strict_reference_semantics=meta[
+                    "strict_reference_semantics"],
+                recorder=recorder,
+            )
+            node._state = ck.state
+        node.generation = gen
+        wal = DeltaWal(_os.path.join(dirpath, "wal"), recorder=recorder)
+        stats = node.replay_wal(wal)
+        if stats["bad"] or stats["future"]:
+            # the refused suffix can never replay (the base it needs is
+            # gone for good) and new acked records must NOT land behind
+            # it — a second kill would replay, stop at the same refused
+            # record, and silently discard them.  Reset to a clean log;
+            # the armed resync epoch / anti-entropy covers the gap.
+            wal.truncate()
+        node.wal = wal
+        # regressed restore (an older generation than the newest on
+        # disk): WAL records logged against the newer lineage may have
+        # fast-forwarded our vv past lanes delivered only in truncated
+        # records — a hole delta compression can never re-fill.  Persist
+        # a resync-pending flag (it must survive a re-kill before the
+        # heal completes) and enter the forced-FULL healing epoch; the
+        # supervisor clears it once every peer served a FULL exchange.
+        regressed = (fell_back or (0 < gen < latest_on_disk)
+                     or stats["future"] > 0)
+        flag_path = _os.path.join(dirpath, "resync-pending")
+        node._resync_flag_path = flag_path
+        if regressed:
+            with open(flag_path, "w") as f:
+                f.write("regressed restore: full resync pending\n")
+                f.flush()
+                _os.fsync(f.fileno())
+            if recorder is not None:
+                recorder.count("restore.full_resync")
+        node.full_resync_pending = regressed or _os.path.exists(flag_path)
+        return node
+
     def close(self) -> None:
         self._closing = True
         if self._server_sock is not None:
@@ -492,12 +751,21 @@ class Node:
         # its own deadline), else a short dead-peer-detection connect_t
         # would bound a large FULL-state send.
         sock.settimeout(timeout)
+        # regressed-restore healing: advertise a zero vv on the first
+        # exchange with each peer so it ships FULL state (the normal
+        # first-contact branch) — delta compression against our real vv
+        # would skip any lane a regressed replay fast-forwarded us past
+        addr_key = (addr[0], int(addr[1]))
+        forcing_full = (self.full_resync_pending
+                        and addr_key not in self._full_resync_done)
+        adv_vv = (np.zeros(self.num_actors, np.uint32) if forcing_full
+                  else self.vv())
         with sock:
             phase = "hello"
             try:
                 sent = framing.send_frame(
                     sock, MSG_HELLO, framing.encode_hello(
-                        self.actor, self.num_elements, self.vv()))
+                        self.actor, self.num_elements, adv_vv))
                 msg_type, body = framing.recv_frame(sock, timeout=hello_t)
                 if msg_type != MSG_HELLO:
                     raise ProtocolError(f"expected HELLO, got {msg_type}")
@@ -531,6 +799,8 @@ class Node:
             except OSError as e:
                 raise PeerReset(
                     f"{phase} exchange with {addr}: {e}") from e
+        if forcing_full:
+            self._full_resync_done.add(addr_key)
         self._record(mode_sent, bytes_sent=sent, bytes_received=recv)
         return SyncStats(bytes_sent=sent, bytes_received=recv,
                          mode_sent=mode_sent, mode_received=mode_recv)

@@ -25,6 +25,18 @@ class Recorder:
     set_gauge(): last-write-wins point-in-time values (e.g. the per-peer
                  circuit-breaker state the sync supervisor exports:
                  0=closed, 1=open, 2=half_open — net/antientropy.py).
+
+    Durability-layer names (the crash-recovery contract, DESIGN.md §14
+    "Durability ladder"): counters ``wal.appends`` / ``wal.appended_bytes``
+    / ``wal.truncations`` (write path), ``wal.records`` /
+    ``wal.bad_records`` / ``wal.future_records`` (replay; the last is a
+    record refused by the causal replay guard), ``wal.torn_tail`` (tear
+    found and repaired), ``restore.fallbacks`` (a checkpoint generation
+    failed verification and the previous one was used),
+    ``restore.unknown_type`` (restore degraded to a plain array dict),
+    ``restore.full_resync`` / ``sync.full_resync_complete`` (the
+    regressed-restore forced-FULL healing epoch armed / retired); gauge
+    ``restore.generation`` (the generation recovery actually loaded).
     """
 
     def __init__(self) -> None:

@@ -1,4 +1,4 @@
-"""Checkpoint / resume for packed CRDT states.
+"""Checkpoint / resume for packed CRDT states — verified and generational.
 
 The reference has no persistence; its nearest primitives are ``Clone``
 (deep copy used to fork timelines, awset.go:77-85) and the observation
@@ -11,22 +11,38 @@ tests/test_checkpoint.py's resume-equivalence gate).
 
 Format: ONE ``.npz`` file holding the state's arrays plus a
 ``__manifest__`` entry (utf-8 JSON: state type name, field list, step,
-element-dictionary state dict, user metadata).  Saves write a temp file
-in the target directory and ``os.replace`` it into place, which is
-atomic on POSIX — a crash mid-save leaves the previous generation
-untouched and at worst a stray ``.ckpt-tmp-*`` file.
+element-dictionary state dict, user metadata, per-array CRC32 digests,
+optional generation number).  Saves write a temp file in the target
+directory, fsync it, ``os.replace`` it into place (atomic on POSIX),
+and fsync the DIRECTORY so the rename itself survives power loss; stray
+``.ckpt-tmp-*`` files from a crash mid-save are swept on the next save
+or restore in that directory (single-writer-per-directory assumption —
+the same one the atomic-replace scheme already makes).
+
+Integrity: every array's bytes (plus dtype and shape) are CRC32-digested
+into the manifest at save time and re-verified on restore
+(``CheckpointCorrupt`` on mismatch) — a bit-rotted or torn checkpoint is
+REFUSED, never silently loaded.  ``CheckpointStore`` layers generations
+on top: retention of the last K files, newest-valid-wins restore with
+fallback to the previous generation when the newest fails verification,
+and monotonic generation fencing (a rejoining node refuses to regress
+below a generation it knows it reached — ``GenerationRegression``).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import tempfile
-from typing import Any, Dict, NamedTuple, Optional
+import warnings
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
 from go_crdt_playground_tpu.models.awset import AWSetState
+from go_crdt_playground_tpu.models.digest import array_digest
+from go_crdt_playground_tpu.utils.fsutil import fsync_dir
 from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
 from go_crdt_playground_tpu.models.packed import (
     DotPackedAWSetDeltaState,
@@ -46,9 +62,13 @@ from go_crdt_playground_tpu.utils.codec import ElementDict
 
 _MANIFEST_KEY = "__manifest__"
 _FORMAT_VERSION = 2
+_TMP_PREFIX = ".ckpt-tmp-"
 
 # Every packed state type the framework ships.  Restoring an unknown
-# type degrades to a plain dict of arrays (forward compatibility).
+# type degrades to a plain dict of arrays (forward compatibility) — but
+# LOUDLY: a warning plus a ``restore.unknown_type`` counter, because a
+# silently-degraded restore looks healthy right up until gossip feeds a
+# dict to a kernel.
 STATE_TYPES = {
     cls.__name__: cls
     for cls in (
@@ -68,11 +88,58 @@ STATE_TYPES = {
 }
 
 
+class CheckpointCorrupt(ValueError):
+    """A checkpoint failed integrity verification (array digest mismatch,
+    generation spoof, or unreadable container).  The generational store
+    treats this as "fall back to the previous generation", never as a
+    fatal recovery abort."""
+
+
+class GenerationRegression(RuntimeError):
+    """Restore would hand back a generation older than the caller's
+    fence — a rejoining node refusing to silently regress durability it
+    already acknowledged."""
+
+
 class Checkpoint(NamedTuple):
     state: Any
     dictionary: Optional[ElementDict]
     step: Optional[int]
     metadata: Dict[str, Any]
+    generation: Optional[int] = None
+
+
+# the canonical array digest lives in models/digest.py (the crash soak
+# compares cross-process fixed points with the same hash); this alias is
+# the name the manifest writer/verifier below use
+_array_digest = array_digest
+
+
+# shared with utils/wal.py (checkpoint_sharded.py imports it from here)
+_fsync_dir = fsync_dir
+
+
+def sweep_tmp_files(directory: str, keep: Optional[str] = None) -> int:
+    """Remove stray ``.ckpt-tmp-*`` files a crashed save left behind.
+    ``keep`` protects the save-in-progress temp file.  Returns the count
+    swept.  Single-writer-per-directory assumption (documented above)."""
+    swept = 0
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    for name in names:
+        if not name.startswith(_TMP_PREFIX):
+            continue
+        full = os.path.join(directory, name)
+        if keep is not None and os.path.abspath(full) == os.path.abspath(keep):
+            continue
+        try:
+            os.unlink(full)
+            swept += 1
+        except OSError:
+            pass
+    return swept
 
 
 def save_checkpoint(
@@ -81,9 +148,11 @@ def save_checkpoint(
     dictionary: Optional[ElementDict] = None,
     step: Optional[int] = None,
     metadata: Optional[Dict[str, Any]] = None,
+    generation: Optional[int] = None,
 ) -> str:
-    """Atomically write ``state`` (any framework state NamedTuple) to
-    the single-file checkpoint at ``path``.  Returns ``path``."""
+    """Atomically and durably write ``state`` (any framework state
+    NamedTuple) to the single-file checkpoint at ``path``.  Returns
+    ``path``."""
     fields = getattr(state, "_fields", None)
     if fields is None:
         raise TypeError(
@@ -98,16 +167,25 @@ def save_checkpoint(
         "step": step,
         "metadata": metadata or {},
         "dictionary": dictionary.state_dict() if dictionary else None,
+        "digests": {f: _array_digest(a) for f, a in arrays.items()},
+        "generation": generation,
     }
     blob = np.frombuffer(
         json.dumps(manifest, sort_keys=True).encode("utf-8"), np.uint8)
     parent = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(prefix=".ckpt-tmp-", dir=parent)
+    fd, tmp = tempfile.mkstemp(prefix=_TMP_PREFIX, dir=parent)
+    sweep_tmp_files(parent, keep=tmp)
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **{_MANIFEST_KEY: blob}, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)  # atomic on POSIX
+        # fsync the directory so the RENAME is durable too — without it
+        # a crash can resurrect the previous generation after the save
+        # already returned success
+        _fsync_dir(parent)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -115,16 +193,40 @@ def save_checkpoint(
     return path
 
 
-def restore_checkpoint(path: str, to_device: bool = True) -> Checkpoint:
+def restore_checkpoint(path: str, to_device: bool = True, *,
+                       verify: bool = True, recorder=None) -> Checkpoint:
     """Load a checkpoint file.  ``to_device=True`` returns jax arrays
-    (placed by the current default device); False keeps numpy."""
-    with np.load(path) as z:
-        manifest = json.loads(z[_MANIFEST_KEY].tobytes().decode("utf-8"))
-        if manifest["format_version"] > _FORMAT_VERSION:
-            raise ValueError(
-                f"checkpoint format {manifest['format_version']} is newer "
-                f"than this framework understands ({_FORMAT_VERSION})")
-        arrays = {k: z[k] for k in z.files if k != _MANIFEST_KEY}
+    (placed by the current default device); False keeps numpy.
+
+    ``verify=True`` re-computes every array's CRC32 digest against the
+    manifest and raises ``CheckpointCorrupt`` on any mismatch (legacy
+    digestless checkpoints load unverified).  An unreadable container
+    (torn zip, unparseable manifest) also surfaces as
+    ``CheckpointCorrupt`` so the generational store can fall back."""
+    sweep_tmp_files(os.path.dirname(os.path.abspath(path)) or ".")
+    try:
+        with np.load(path) as z:
+            manifest = json.loads(z[_MANIFEST_KEY].tobytes().decode("utf-8"))
+            arrays = {k: z[k] for k in z.files if k != _MANIFEST_KEY}
+    except FileNotFoundError:
+        raise
+    except Exception as e:  # BadZipFile, zlib.error, KeyError, JSON, ...
+        raise CheckpointCorrupt(f"unreadable checkpoint {path!r}: {e}") from e
+    if manifest["format_version"] > _FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format {manifest['format_version']} is newer "
+            f"than this framework understands ({_FORMAT_VERSION})")
+    digests = manifest.get("digests")
+    if verify and digests is not None:
+        for name, expect in digests.items():
+            if name not in arrays:
+                raise CheckpointCorrupt(
+                    f"checkpoint {path!r}: digested array {name!r} missing")
+            got = _array_digest(arrays[name])
+            if got != expect:
+                raise CheckpointCorrupt(
+                    f"checkpoint {path!r}: array {name!r} digest mismatch "
+                    f"(manifest {expect}, recomputed {got})")
     if to_device:
         import jax.numpy as jnp
 
@@ -133,6 +235,13 @@ def restore_checkpoint(path: str, to_device: bool = True) -> Checkpoint:
     if cls is not None:
         state = cls(**{f: arrays[f] for f in manifest["fields"]})
     else:  # forward-compat: unknown state type, hand back the arrays
+        warnings.warn(
+            f"checkpoint {path!r} holds state type "
+            f"{manifest['state_type']!r} unknown to this build; restoring "
+            "a plain array dict (typed ops will not accept it)",
+            RuntimeWarning, stacklevel=2)
+        if recorder is not None:
+            recorder.count("restore.unknown_type")
         state = arrays
     dictionary = None
     if manifest["dictionary"] is not None:
@@ -142,4 +251,113 @@ def restore_checkpoint(path: str, to_device: bool = True) -> Checkpoint:
         dictionary=dictionary,
         step=manifest["step"],
         metadata=manifest["metadata"],
+        generation=manifest.get("generation"),
     )
+
+
+# ---------------------------------------------------------------------------
+# Generational store
+# ---------------------------------------------------------------------------
+
+_GEN_RE = re.compile(r"^gen-(\d{12})\.ckpt$")
+
+
+class CheckpointStore:
+    """A directory of verified checkpoint generations.
+
+    Files are ``gen-<n>.ckpt`` (12-digit, zero-padded); ``save`` writes
+    generation ``latest+1`` and prunes beyond the newest ``keep``;
+    ``restore`` walks newest→oldest, skipping any generation that fails
+    verification (each skip counts ``restore.fallbacks``), and refuses
+    to hand back a generation below ``min_generation``
+    (``GenerationRegression`` — the rejoin fence).  A generation number
+    is trusted only when the file name and the manifest AGREE, so a
+    stale file renamed to a newer slot cannot spoof its way forward.
+    The WAL (utils/wal.py) conventionally lives in a ``wal/`` subdir of
+    the same directory; this store only touches ``gen-*.ckpt`` files.
+    """
+
+    def __init__(self, path: str, *, keep: int = 3, recorder=None):
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        self.path = os.path.abspath(path)
+        self.keep = keep
+        self.recorder = recorder
+        os.makedirs(self.path, exist_ok=True)
+        sweep_tmp_files(self.path)
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.recorder is not None:
+            self.recorder.count(name, n)
+
+    def path_for(self, generation: int) -> str:
+        return os.path.join(self.path, f"gen-{generation:012d}.ckpt")
+
+    def generations(self) -> List[int]:
+        """Existing generation numbers, ascending (unverified)."""
+        out = []
+        for name in os.listdir(self.path):
+            m = _GEN_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_generation(self) -> int:
+        gens = self.generations()
+        return gens[-1] if gens else 0
+
+    def save(self, state, *, dictionary=None, step: Optional[int] = None,
+             metadata: Optional[Dict[str, Any]] = None) -> int:
+        """Write the next generation and prune old ones; returns the new
+        generation number (monotonic even past corrupt/pruned files —
+        numbering keys off file names, never off readability)."""
+        gen = self.latest_generation() + 1
+        save_checkpoint(self.path_for(gen), state, dictionary=dictionary,
+                        step=step, metadata=metadata, generation=gen)
+        for old in self.generations()[:-self.keep]:
+            try:
+                os.unlink(self.path_for(old))
+            except OSError:
+                pass
+        _fsync_dir(self.path)
+        return gen
+
+    def restore(self, *, min_generation: int = 0, to_device: bool = True
+                ) -> Tuple[int, Checkpoint]:
+        """Newest-valid-wins restore with fallback.  Returns
+        ``(generation, Checkpoint)``.  Raises ``FileNotFoundError`` when
+        the store is empty, ``CheckpointCorrupt`` when every generation
+        fails verification, ``GenerationRegression`` when the best valid
+        generation sits below ``min_generation``."""
+        sweep_tmp_files(self.path)
+        gens = self.generations()
+        if not gens:
+            raise FileNotFoundError(f"no checkpoint generations in "
+                                    f"{self.path!r}")
+        last_err: Optional[Exception] = None
+        for gen in reversed(gens):
+            try:
+                ck = restore_checkpoint(self.path_for(gen),
+                                        to_device=to_device, verify=True,
+                                        recorder=self.recorder)
+                if ck.generation is not None and ck.generation != gen:
+                    raise CheckpointCorrupt(
+                        f"generation spoof: file gen-{gen} carries manifest "
+                        f"generation {ck.generation}")
+            except Exception as e:  # noqa: BLE001 — ANY unreadable
+                # generation must fall back, not abort recovery; the
+                # skip is counted so the degradation is observable
+                last_err = e
+                self._count("restore.fallbacks")
+                continue
+            if gen < min_generation:
+                raise GenerationRegression(
+                    f"best valid generation {gen} in {self.path!r} is older "
+                    f"than the fence ({min_generation}); refusing to regress")
+            if self.recorder is not None and hasattr(self.recorder,
+                                                     "set_gauge"):
+                self.recorder.set_gauge("restore.generation", gen)
+            return gen, ck
+        raise CheckpointCorrupt(
+            f"every generation in {self.path!r} failed verification "
+            f"(last error: {last_err})")

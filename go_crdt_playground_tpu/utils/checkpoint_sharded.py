@@ -12,8 +12,13 @@ mesh from ``jax.eval_shape``-style abstract targets.
 
 Directory layout: ``<path>/state`` (orbax PyTree checkpoint) +
 ``<path>/manifest.json`` (state type, field list, step, element
-dictionary, metadata — same manifest contents as the single-file
-format).
+dictionary, metadata, optional generation — same manifest contents as
+the single-file format).  Array-level integrity is orbax's job (it
+checksums its own shard files); this layer adds the durability-ladder
+pieces the single-file path also grew: stray ``.manifest-tmp`` sweep,
+directory fsync after the manifest rename, and generation fencing on
+restore (``GenerationRegression`` when the manifest's generation sits
+below the caller's ``min_generation`` fence).
 """
 
 from __future__ import annotations
@@ -24,11 +29,14 @@ from typing import Any, Dict, Optional
 
 import jax
 
-from go_crdt_playground_tpu.utils.checkpoint import (STATE_TYPES,
-                                                     Checkpoint)
+from go_crdt_playground_tpu.utils.checkpoint import (GenerationRegression,
+                                                     STATE_TYPES,
+                                                     Checkpoint,
+                                                     _fsync_dir)
 from go_crdt_playground_tpu.utils.codec import ElementDict
 
 _FORMAT_VERSION = 1
+_MANIFEST_TMP = ".manifest-tmp"
 
 
 def _checkpointer():
@@ -43,6 +51,7 @@ def save_checkpoint_sharded(
     dictionary: Optional[ElementDict] = None,
     step: Optional[int] = None,
     metadata: Optional[Dict[str, Any]] = None,
+    generation: Optional[int] = None,
 ) -> str:
     """Write ``state`` under directory ``path`` with its sharding
     preserved (each device's shards stream out in parallel)."""
@@ -68,11 +77,15 @@ def save_checkpoint_sharded(
             "step": step,
             "metadata": metadata or {},
             "dictionary": dictionary.state_dict() if dictionary else None,
+            "generation": generation,
         }
-        tmp = os.path.join(path, ".manifest-tmp")
+        tmp = os.path.join(path, _MANIFEST_TMP)
         with open(tmp, "w") as f:
             json.dump(manifest, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, os.path.join(path, "manifest.json"))
+        _fsync_dir(path)  # the rename itself must be durable
     if jax.process_count() > 1:
         # no host may return (and e.g. signal "checkpoint done" or start
         # a restore) before process 0's manifest is on shared storage
@@ -82,7 +95,8 @@ def save_checkpoint_sharded(
     return path
 
 
-def restore_checkpoint_sharded(path: str, target=None) -> Checkpoint:
+def restore_checkpoint_sharded(path: str, target=None, *,
+                               min_generation: int = 0) -> Checkpoint:
     """Restore a sharded checkpoint.
 
     target: optional state pytree (or pytree of jax.ShapeDtypeStruct
@@ -90,14 +104,29 @@ def restore_checkpoint_sharded(path: str, target=None) -> Checkpoint:
     e.g. ``mesh.shard_state(cfg.init_awset_delta(), m)`` restores
     straight onto the mesh.  None restores with orbax's default
     placement.
+
+    min_generation: the rejoin fence — a manifest carrying a generation
+    below it raises ``GenerationRegression`` (manifests written before
+    generations existed carry None and pass any fence of 0).
     """
     path = os.path.abspath(path)
+    tmp = os.path.join(path, _MANIFEST_TMP)
+    if os.path.exists(tmp):  # crash mid-save left a stray half-manifest
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
     with open(os.path.join(path, "manifest.json")) as f:
         manifest = json.load(f)
     if manifest["format_version"] > _FORMAT_VERSION:
         raise ValueError(
             f"sharded checkpoint format {manifest['format_version']} is "
             f"newer than this framework understands ({_FORMAT_VERSION})")
+    gen = manifest.get("generation")
+    if gen is not None and gen < min_generation:
+        raise GenerationRegression(
+            f"sharded checkpoint at {path!r} is generation {gen}, older "
+            f"than the fence ({min_generation}); refusing to regress")
     restore_target = None
     if target is not None:
         restore_target = {
@@ -117,4 +146,5 @@ def restore_checkpoint_sharded(path: str, target=None) -> Checkpoint:
         dictionary=dictionary,
         step=manifest["step"],
         metadata=manifest["metadata"],
+        generation=gen,
     )

@@ -1,0 +1,22 @@
+"""Tiny shared filesystem-durability helpers (jax-free on purpose:
+imported by host-only recovery paths before any device init)."""
+
+from __future__ import annotations
+
+import os
+
+
+def fsync_dir(path: str) -> None:
+    """Make a directory-entry change (create/rename/unlink) durable.
+    Best-effort: some filesystems refuse directory fsync; the data-file
+    fsyncs still hold."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)

@@ -1,0 +1,300 @@
+"""Append-only δ write-ahead log: crash durability between checkpoints.
+
+A replica that dies between checkpoints silently loses every delta since
+its last ``Node.save`` — anti-entropy re-heals the gap eventually, but
+only by re-shipping state the node had already acknowledged.  Delta-state
+CRDTs make the classic WAL fix unusually cheap (arXiv:1410.2803: the
+δ-groups ARE small), so the durability contract becomes: a record is on
+disk (fsync'd) before the mutation it describes is acknowledged, and
+recovery is ``checkpoint ⊔ replay(WAL tail)`` — a pure idempotent merge,
+so double-replay after a messy crash is harmless by construction.
+
+Record framing (length-prefixed, CRC32-framed; varints are the shared
+``utils/wire.py`` codec, so the only new byte format here is 6 bytes of
+armor around an existing wire body):
+
+    MAGIC(2) | varint body_len | body | crc32(body, 4 bytes LE)
+
+Bodies are OPAQUE to the log; in practice (net/peer.Node) each is a
+replay GUARD — the varint-encoded vv the record's δ-compression was
+computed against — followed by exactly a PAYLOAD frame body of
+``net/framing.py`` (mode | src_actor | processed | δ payload), so the
+WAL, the socket, and the checkpoint all speak one wire dialect and
+recovery can refuse records that causally outrun a regressed base
+(``Node.replay_wal``).
+
+Segments: ``wal-<seq>.log`` files under one directory, rotated at
+``segment_bytes``; sequence numbers only ever grow (even across
+``truncate()``), so a stale segment can never be mistaken for a newer
+one.  The recovery scan walks segments in order and STOPS at the first
+torn or corrupt record (bad magic, truncated length/body, CRC mismatch)
+— the prefix property: everything before the tear is trusted, everything
+after is discarded.  Opening a log repairs that tear in place (truncates
+the segment to its valid prefix, drops any later segments) so appends
+land on a clean tail.
+
+Metrics (optional ``recorder``): ``wal.appends`` / ``wal.appended_bytes``
+on the write path, ``wal.torn_tail`` when an open-time repair found a
+tear, ``wal.truncations`` on checkpoint-driven resets; the replay-side
+``wal.records`` counter is owned by ``net.peer.Node.replay_wal``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+from typing import Iterator, List, Optional, Tuple
+
+from go_crdt_playground_tpu.utils import wire
+from go_crdt_playground_tpu.utils.fsutil import fsync_dir as _fsync_dir
+
+MAGIC = b"\xc7\xd2"  # sibling of net/framing's frame magic \xc7\xd1
+
+_CRC_LEN = 4
+_MAX_RECORD = 1 << 30
+
+
+def encode_record(body: bytes) -> bytes:
+    """One framed WAL record for ``body`` (see module docstring)."""
+    if len(body) > _MAX_RECORD:
+        raise ValueError(f"WAL record body too large ({len(body)} bytes)")
+    out = bytearray(MAGIC)
+    wire._put_varint(out, len(body))
+    out += body
+    out += zlib.crc32(body).to_bytes(_CRC_LEN, "little")
+    return bytes(out)
+
+
+def scan_records(data: bytes) -> Tuple[List[bytes], int, bool]:
+    """Scan one segment's bytes.  Returns ``(bodies, valid_end, torn)``
+    where ``valid_end`` is the byte offset just past the last intact
+    record — the truncation point an open-time repair uses.  Never
+    raises: a tear is a RESULT, not an error (the crash the log exists
+    to survive produces one every time)."""
+    bodies: List[bytes] = []
+    pos = 0
+    while pos < len(data):
+        if data[pos:pos + len(MAGIC)] != MAGIC:
+            return bodies, pos, True
+        try:
+            n, body_start = wire._get_varint(data, pos + len(MAGIC))
+        except ValueError:
+            return bodies, pos, True
+        end = body_start + n
+        if n > _MAX_RECORD or end + _CRC_LEN > len(data):
+            return bodies, pos, True
+        body = data[body_start:end]
+        crc = int.from_bytes(data[end:end + _CRC_LEN], "little")
+        if zlib.crc32(body) != crc:
+            return bodies, pos, True
+        bodies.append(body)
+        pos = end + _CRC_LEN
+    return bodies, pos, False
+
+
+class DeltaWal:
+    """One replica's delta write-ahead log (single-writer directory).
+
+    ``append`` is durable-on-return (write + flush + fsync, unless
+    ``fsync=False`` for tests/benchmarks); ``records()`` is the recovery
+    scan; ``truncate()`` resets the log after a successful checkpoint
+    (the checkpoint now owns everything the log described).  Thread-safe,
+    though in the Node wiring every call already arrives serialized
+    under the node lock.
+    """
+
+    def __init__(self, path: str, *, segment_bytes: int = 4 << 20,
+                 fsync: bool = True, recorder=None):
+        if segment_bytes < 64:
+            raise ValueError("segment_bytes must be >= 64")
+        self.path = os.path.abspath(path)
+        self.segment_bytes = segment_bytes
+        self.fsync = fsync
+        self.recorder = recorder
+        self._lock = threading.Lock()
+        self._file = None
+        self._file_size = 0
+        # (seq, valid_end) of tears already counted by records() — a
+        # re-scan of the same physical tear must not re-count it
+        self._post_open_tears: set = set()
+        os.makedirs(self.path, exist_ok=True)
+        self.torn_tail_repaired = False
+        segs = self._segments()
+        if segs:
+            self._repair(segs)
+            segs = self._segments()
+        self._seq = segs[-1] if segs else self._next_seq()
+        self._open_segment(self._seq, fresh=not segs)
+
+    # -- segment bookkeeping -----------------------------------------------
+
+    def _seg_path(self, seq: int) -> str:
+        return os.path.join(self.path, f"wal-{seq:012d}.log")
+
+    def _segments(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.path):
+            if name.startswith("wal-") and name.endswith(".log"):
+                try:
+                    out.append(int(name[4:-4]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def _next_seq(self) -> int:
+        segs = self._segments()
+        return (segs[-1] + 1) if segs else 1
+
+    def _open_segment(self, seq: int, fresh: bool) -> None:
+        self._file = open(self._seg_path(seq), "ab")
+        self._file_size = self._file.tell()
+        if fresh:
+            _fsync_dir(self.path)
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.recorder is not None:
+            self.recorder.count(name, n)
+
+    # -- recovery-time repair ----------------------------------------------
+
+    def _repair(self, segs: List[int]) -> None:
+        """Truncate the first torn segment to its valid prefix and drop
+        every segment after it — the prefix property made physical, so
+        later appends can never land beyond a tear."""
+        for i, seq in enumerate(segs):
+            p = self._seg_path(seq)
+            with open(p, "rb") as f:
+                data = f.read()
+            _, valid_end, torn = scan_records(data)
+            if not torn:
+                continue
+            self.torn_tail_repaired = True
+            self._count("wal.torn_tail")
+            with open(p, "r+b") as f:
+                f.truncate(valid_end)
+                f.flush()
+                os.fsync(f.fileno())
+            for later in segs[i + 1:]:
+                try:
+                    os.unlink(self._seg_path(later))
+                except OSError:
+                    pass
+            _fsync_dir(self.path)
+            return
+
+    # -- write path ---------------------------------------------------------
+
+    def append(self, body: bytes) -> None:
+        """Durably append one record (see the fsync contract above)."""
+        rec = encode_record(body)
+        with self._lock:
+            if self._file is None:
+                raise ValueError("WAL is closed")
+            if self._file_size > 0 and \
+                    self._file_size + len(rec) > self.segment_bytes:
+                self._rotate_locked()
+            self._file.write(rec)
+            self._file.flush()
+            if self.fsync:
+                os.fsync(self._file.fileno())
+            self._file_size += len(rec)
+        self._count("wal.appends")
+        self._count("wal.appended_bytes", len(rec))
+
+    def _rotate_locked(self) -> None:
+        self._file.flush()
+        if self.fsync:
+            os.fsync(self._file.fileno())
+        self._file.close()
+        self._seq += 1
+        self._open_segment(self._seq, fresh=True)
+
+    def truncate(self) -> None:
+        """Drop every record: a successful checkpoint now owns them.
+        The fresh segment continues the sequence (never reuses a seq)."""
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            for seq in self._segments():
+                try:
+                    os.unlink(self._seg_path(seq))
+                except OSError:
+                    pass
+            self._seq += 1
+            self._open_segment(self._seq, fresh=True)
+            self._post_open_tears.clear()
+            _fsync_dir(self.path)
+        self._count("wal.truncations")
+
+    def seal(self) -> List[int]:
+        """Rotate to a fresh segment and return the seqs of every sealed
+        (pre-rotation) segment — the two-phase truncation used by
+        ``Node.save_durable``: seal under the node lock (cheap), write
+        the checkpoint OUTSIDE it, then ``drop_segments(sealed)`` once
+        the checkpoint is durable.  Records appended after the seal land
+        in the fresh segment and are never dropped.  A crash between
+        seal and drop merely leaves pre-checkpoint segments behind;
+        replay re-merges them idempotently."""
+        with self._lock:
+            sealed = self._segments()
+            if self._file is not None:
+                self._rotate_locked()
+            return sealed
+
+    def drop_segments(self, seqs: List[int]) -> None:
+        """Unlink previously-sealed segments (their records are owned by
+        a now-durable checkpoint).  Never touches the live segment."""
+        with self._lock:
+            for seq in seqs:
+                if seq == self._seq:
+                    continue
+                try:
+                    os.unlink(self._seg_path(seq))
+                except OSError:
+                    pass
+            _fsync_dir(self.path)
+        self._count("wal.truncations")
+
+    # -- recovery scan ------------------------------------------------------
+
+    def records(self) -> Iterator[bytes]:
+        """Yield record bodies oldest-first, stopping at the first torn
+        or corrupt record (counts ``wal.torn_tail`` when that happens —
+        post-open corruption, e.g. injected by the crash soak's storage
+        faults, surfaces here rather than at construction)."""
+        for seq in self._segments():
+            with open(self._seg_path(seq), "rb") as f:
+                data = f.read()
+            bodies, valid_end, torn = scan_records(data)
+            yield from bodies
+            if torn:
+                key = (seq, valid_end)
+                with self._lock:
+                    fresh = key not in self._post_open_tears
+                    self._post_open_tears.add(key)
+                if fresh:  # one physical tear counts once, not per scan
+                    self._count("wal.torn_tail")
+                return
+
+    def record_count(self) -> int:
+        return sum(1 for _ in self.records())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+                if self.fsync:
+                    try:
+                        os.fsync(self._file.fileno())
+                    except OSError:
+                        pass
+                self._file.close()
+                self._file = None
+
+    def __enter__(self) -> "DeltaWal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,45 @@
+"""CI wrapper for the process-kill crash soak (tools/crash_soak.py).
+
+Mirrors tests/test_chaos.py::test_chaos_soak_quick_mode: the --quick
+sweep must complete, converge at every kill rate, actually kill and
+corrupt (a green crash test with zero kills is a broken test), and
+write a well-formed CRASH_CURVE.json.  slow-marked: it spawns real
+node processes and SIGKILLs them, so tier-1 runtime never pays for it.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+
+@pytest.mark.slow
+def test_crash_soak_quick_mode(tmp_path):
+    import crash_soak
+
+    out = str(tmp_path / "CRASH_CURVE.json")
+    rc = crash_soak.main(["--quick", "--out", out])
+    assert rc == 0, "crash soak failed (non-convergence, delta loss, or " \
+                    "missing fallback exercise)"
+    with open(out) as f:
+        artifact = json.load(f)
+    curve = artifact["curve"]
+    assert any(e["kill_rate"] >= 0.2 for e in curve), \
+        "quick sweep must include the >=0.2 SIGKILL acceptance rate"
+    for e in curve:
+        assert e["converged_runs"] == e["seeds"]
+        assert e["delta_loss_violations"] == 0
+    faulted = [e for e in curve if e["kill_rate"] > 0]
+    assert all(e["kills"] > 0 for e in faulted), \
+        "a crash soak that never killed anything proved nothing"
+    assert any(sum(e["storage_faults"].get(k, 0)
+                   for k in ("torn_writes", "bit_flips", "zero_fills")) > 0
+               for e in faulted), "no storage faults were injected"
+    assert any(e["corruption_injected"]
+               and e["restore_counters"].get("restore.fallbacks", 0) > 0
+               for e in faulted), \
+        "the corrupt-newest-checkpoint fallback path was never exercised"

@@ -1,0 +1,646 @@
+"""Durability ladder: verified generational checkpoints, WAL-backed
+crash recovery, storage-fault tolerance (DESIGN.md §14).
+
+Covers the recovery invariants the crash soak exercises end-to-end, at
+unit scale: digests refuse bit rot, the generational store falls back
+past a corrupt newest generation (never aborts), generation fencing
+refuses regression, kill → restore_durable replays the WAL tail, and a
+restored supervisor catches up with the fleet over the FULL-state
+first-contact branch.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from go_crdt_playground_tpu.models import awset_delta
+from go_crdt_playground_tpu.models.digest import array_digest, state_digest
+from go_crdt_playground_tpu.obs import Recorder
+from go_crdt_playground_tpu.utils import checkpoint as ckpt
+from go_crdt_playground_tpu.utils.checkpoint import (CheckpointCorrupt,
+                                                     CheckpointStore,
+                                                     GenerationRegression)
+
+
+def _state():
+    return awset_delta.init(1, 16, 3, actors=np.asarray([0], np.uint32))
+
+
+def _flip_bit(path, offset=None):
+    size = os.path.getsize(path)
+    offset = size // 2 if offset is None else offset
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 1]))
+
+
+# -- digests -----------------------------------------------------------------
+
+
+def test_array_digest_covers_dtype_and_shape():
+    a = np.arange(8, dtype=np.uint32)
+    assert array_digest(a) != array_digest(a.astype(np.int32))
+    assert array_digest(a) != array_digest(a.reshape(2, 4))
+    assert array_digest(a) == array_digest(a.copy())
+
+
+def test_state_digest_stable_and_field_sensitive():
+    st = _state()
+    assert state_digest(st) == state_digest(_state())
+    st2 = st._replace(vv=st.vv + 1)
+    assert state_digest(st) != state_digest(st2)
+    with pytest.raises(TypeError):
+        state_digest({"not": "a state"})
+
+
+# -- verify-on-restore -------------------------------------------------------
+
+
+def test_bit_flip_refused_on_restore(tmp_path):
+    p = str(tmp_path / "ck")
+    ckpt.save_checkpoint(p, _state())
+    assert ckpt.restore_checkpoint(p) is not None  # intact loads
+    _flip_bit(p)  # default offset lands inside the array data region
+    with pytest.raises(CheckpointCorrupt):
+        ckpt.restore_checkpoint(p)
+
+
+def test_bit_flip_anywhere_never_loads_silently_wrong(tmp_path):
+    """The full integrity invariant: a one-bit flip at ANY offset either
+    raises CheckpointCorrupt (data or manifest hit) or restores a state
+    bitwise equal to the original (zip-metadata hit) — silent wrong data
+    is never an outcome."""
+    p = str(tmp_path / "ck")
+    orig = _state()
+    ckpt.save_checkpoint(p, orig)
+    size = os.path.getsize(p)
+    with open(p, "rb") as f:
+        pristine = f.read()
+    for offset in range(7, size, max(1, size // 23)):
+        with open(p, "wb") as f:
+            f.write(pristine)
+        _flip_bit(p, offset=offset)
+        try:
+            got = ckpt.restore_checkpoint(p, to_device=False)
+        except (CheckpointCorrupt, ValueError):
+            continue
+        for name in orig._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(got.state, name)),
+                np.asarray(getattr(orig, name)),
+                err_msg=f"silent corruption at offset {offset}: {name}")
+
+
+def test_truncated_container_is_checkpoint_corrupt(tmp_path):
+    p = str(tmp_path / "ck")
+    ckpt.save_checkpoint(p, _state())
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+    with pytest.raises(CheckpointCorrupt):
+        ckpt.restore_checkpoint(p)
+
+
+def test_tmp_files_swept_on_save_and_restore(tmp_path):
+    stray = tmp_path / ".ckpt-tmp-stray"
+    stray.write_bytes(b"crash leftover")
+    p = str(tmp_path / "ck")
+    ckpt.save_checkpoint(p, _state())
+    assert not stray.exists(), "save must sweep stale tmp files"
+    stray.write_bytes(b"again")
+    ckpt.restore_checkpoint(p)
+    assert not stray.exists(), "restore must sweep stale tmp files"
+
+
+def test_unknown_state_type_warns_and_counts(tmp_path):
+    p = str(tmp_path / "ck")
+    ckpt.save_checkpoint(p, _state())
+    # rewrite the manifest's state type to something this build lacks
+    import json
+
+    with np.load(p) as z:
+        manifest = json.loads(z["__manifest__"].tobytes().decode())
+        arrays = {k: z[k] for k in z.files if k != "__manifest__"}
+    manifest["state_type"] = "FutureState"
+    blob = np.frombuffer(json.dumps(manifest).encode(), np.uint8)
+    np.savez(p, __manifest__=blob, **arrays)
+    os.replace(p + ".npz" if os.path.exists(p + ".npz") else p, p)
+    rec = Recorder()
+    with pytest.warns(RuntimeWarning, match="unknown"):
+        got = ckpt.restore_checkpoint(p, verify=False, recorder=rec)
+    assert isinstance(got.state, dict)
+    assert rec.snapshot()["counters"]["restore.unknown_type"] == 1
+
+
+# -- generational store ------------------------------------------------------
+
+
+def test_store_generations_monotonic_and_pruned(tmp_path):
+    store = CheckpointStore(str(tmp_path / "store"), keep=2)
+    gens = [store.save(_state()) for _ in range(5)]
+    assert gens == [1, 2, 3, 4, 5]
+    assert store.generations() == [4, 5]  # keep=2 pruned the rest
+    gen, ck = store.restore()
+    assert gen == 5
+    assert ck.generation == 5
+
+
+def test_store_falls_back_past_corrupt_newest(tmp_path):
+    rec = Recorder()
+    store = CheckpointStore(str(tmp_path / "store"), keep=3, recorder=rec)
+    for _ in range(3):
+        store.save(_state())
+    _flip_bit(store.path_for(3))
+    gen, _ = store.restore()
+    assert gen == 2, "corrupt newest must fall back to K-1"
+    snap = rec.snapshot()
+    assert snap["counters"]["restore.fallbacks"] == 1
+    assert snap["gauges"]["restore.generation"] == 2
+
+
+def test_store_all_corrupt_raises_checkpoint_corrupt(tmp_path):
+    store = CheckpointStore(str(tmp_path / "store"), keep=3)
+    store.save(_state())
+    store.save(_state())
+    _flip_bit(store.path_for(1))
+    _flip_bit(store.path_for(2))
+    with pytest.raises(CheckpointCorrupt):
+        store.restore()
+
+
+def test_store_generation_fence(tmp_path):
+    store = CheckpointStore(str(tmp_path / "store"), keep=3)
+    store.save(_state())
+    with pytest.raises(GenerationRegression):
+        store.restore(min_generation=2)
+    # and a corrupt newest that forces fallback BELOW the fence refuses
+    store.save(_state())
+    _flip_bit(store.path_for(2))
+    with pytest.raises(GenerationRegression):
+        store.restore(min_generation=2)
+
+
+def test_store_rejects_generation_spoof(tmp_path):
+    store = CheckpointStore(str(tmp_path / "store"), keep=5)
+    store.save(_state())
+    store.save(_state())
+    # rename the OLD generation over the newest slot: file name and
+    # manifest now disagree, so restore must skip it (spoof), landing on
+    # nothing valid above gen-1... the renamed file is gone from slot 1
+    os.replace(store.path_for(1), store.path_for(7))
+    gen, _ = store.restore()
+    assert gen == 2, "a stale file renamed forward must not win"
+
+
+def test_store_empty_raises_file_not_found(tmp_path):
+    store = CheckpointStore(str(tmp_path / "store"))
+    with pytest.raises(FileNotFoundError):
+        store.restore()
+
+
+def test_sharded_checkpoint_generation_fence(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from go_crdt_playground_tpu.utils.checkpoint_sharded import (
+        restore_checkpoint_sharded, save_checkpoint_sharded)
+
+    p = str(tmp_path / "sharded")
+    save_checkpoint_sharded(p, _state(), generation=3)
+    got = restore_checkpoint_sharded(p, min_generation=3)
+    assert got.generation == 3
+    with pytest.raises(GenerationRegression):
+        restore_checkpoint_sharded(p, min_generation=4)
+    # a crash mid-save leaves a half-manifest: restore sweeps it
+    stray = os.path.join(p, ".manifest-tmp")
+    with open(stray, "w") as f:
+        f.write("{")
+    restore_checkpoint_sharded(p, min_generation=0)
+    assert not os.path.exists(stray)
+
+
+# -- storage fault vocabulary ------------------------------------------------
+
+
+def test_storage_faults_deterministic_and_counted(tmp_path):
+    from go_crdt_playground_tpu.net.faults import (StorageFaults,
+                                                   StorageScenario)
+
+    def run(seed):
+        p = str(tmp_path / f"blob-{seed}")
+        with open(p, "wb") as f:
+            f.write(bytes(range(256)) * 4)
+        sf = StorageFaults(StorageScenario(
+            torn_write_rate=0.3, bit_flip_rate=0.3, zero_fill_rate=0.3),
+            seed=seed)
+        verbs = [sf.inject(p) for _ in range(12)]
+        with open(p, "rb") as f:
+            return verbs, f.read(), sf.counters()
+
+    v1, d1, c1 = run(7)
+    os.unlink(str(tmp_path / "blob-7"))
+    v2, d2, _ = run(7)
+    v3, d3, _ = run(8)
+    assert v1 == v2 and d1 == d2, "same seed must replay the same faults"
+    assert (v1, d1) != (v3, d3)
+    assert c1["inject_calls"] == 12
+    fired = sum(1 for v in v1 if v is not None)
+    assert fired == c1["torn_writes"] + c1["bit_flips"] + c1["zero_fills"]
+    assert fired > 0, "a 0.9 total rate that never fires is a broken test"
+
+
+def test_storage_faults_explicit_verbs(tmp_path):
+    from go_crdt_playground_tpu.net.faults import StorageFaults
+
+    p = str(tmp_path / "blob")
+    payload = bytes(range(200))
+    with open(p, "wb") as f:
+        f.write(payload)
+    sf = StorageFaults(seed=1)
+    sf.torn_write(p, cut_bytes=10)
+    assert os.path.getsize(p) == 190
+    sf.bit_flip(p, offset=0, bit=0)
+    with open(p, "rb") as f:
+        assert f.read(1)[0] == payload[0] ^ 1
+    sf.zero_fill(p, offset=5, span=3)
+    with open(p, "rb") as f:
+        assert f.read()[5:8] == b"\x00\x00\x00"
+    c = sf.counters()
+    assert (c["torn_writes"], c["bit_flips"], c["zero_fills"]) == (1, 1, 1)
+
+
+def test_bit_flip_array_always_defeats_restore(tmp_path):
+    """The checkpoint-aware corruption verb must produce a flip the
+    restore-time verification CATCHES, at every seed — that is its whole
+    reason to exist over the blind tail flip."""
+    from go_crdt_playground_tpu.net.faults import StorageFaults
+
+    for seed in range(8):
+        p = str(tmp_path / f"ck-{seed}")
+        ckpt.save_checkpoint(p, _state())
+        StorageFaults(seed=seed).bit_flip_array(p)
+        with pytest.raises((CheckpointCorrupt, ValueError)):
+            ckpt.restore_checkpoint(p)
+
+
+def test_chaos_scenario_carries_storage_namespace():
+    from go_crdt_playground_tpu.net.faults import (ChaosScenario,
+                                                   StorageScenario)
+
+    s = ChaosScenario(drop_rate=0.1,
+                      storage=StorageScenario(torn_write_rate=0.2))
+    assert s.storage.torn_write_rate == 0.2
+    with pytest.raises(ValueError):
+        StorageScenario(bit_flip_rate=1.5)
+
+
+# -- kill -> restore -> catch-up ---------------------------------------------
+
+
+def test_node_kill_restore_replays_wal_tail(tmp_path):
+    from go_crdt_playground_tpu.net import Node
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    d = str(tmp_path / "durable")
+    rec = Recorder()
+    node = Node(0, 32, 2, recorder=rec,
+                wal=DeltaWal(os.path.join(d, "wal"), recorder=rec))
+    store = CheckpointStore(d, recorder=rec)
+    node.add(1, 2, 3)
+    gen = node.save_durable(store)
+    assert gen == 1
+    assert node.wal.record_count() == 0, "checkpoint truncates the WAL"
+    node.add(4)
+    node.delete(2)
+    node.wal.close()  # SIGKILL analogue: no checkpoint of the tail ops
+
+    rec2 = Recorder()
+    back = Node.restore_durable(d, recorder=rec2)
+    assert set(int(e) for e in back.members()) == {1, 3, 4}
+    assert back.generation == 1
+    assert rec2.snapshot()["counters"]["wal.records"] >= 1
+    back.wal.close()
+
+
+def test_supervisor_restore_durable_full_catch_up_under_chaos(tmp_path):
+    """kill -> restore -> FULL-state catch-up converges, behind a lossy
+    proxy, with a corrupted newest checkpoint forcing the K-1 fallback
+    on the way (the ISSUE's chaos-restore acceptance test)."""
+    from go_crdt_playground_tpu.net import (ChaosProxy, ChaosScenario, Node,
+                                            StorageFaults, SyncSupervisor)
+    from go_crdt_playground_tpu.utils.backoff import BackoffPolicy
+
+    d = str(tmp_path / "durable")
+    rec = Recorder()
+    peer = Node(1, 32, 2, recorder=Recorder(),
+                conn_timeout_s=5.0, hello_timeout_s=0.5)
+    peer_addr = peer.serve()
+    peer.add(20, 21, 22)
+    proxy = ChaosProxy(peer_addr, seed=5,
+                       scenario=ChaosScenario(drop_rate=0.3))
+    lossy_addr = ("127.0.0.1", proxy.port)
+    try:
+        node = Node(0, 32, 2, recorder=rec, conn_timeout_s=5.0,
+                    hello_timeout_s=0.5)
+        sup = SyncSupervisor(
+            node, [lossy_addr],
+            policy=BackoffPolicy(base_s=0.005, cap_s=0.05, max_retries=3),
+            sync_timeout_s=2.0, breaker_threshold=5,
+            breaker_cooldown_s=0.05, interval_s=0.0,
+            durable_dir=d, checkpoint_every=1, recorder=rec, seed=9)
+        node.add(1, 2)
+        sup.run(max_rounds=6)       # several checkpoint generations land
+        node.add(3)                 # WAL-tail only
+        node.wal.close()
+        node.close()                # SIGKILL analogue
+
+        # corrupt the NEWEST generation: recovery must fall back, not
+        # die.  bit_flip_array pins the flip inside a member's data
+        # region (a blind flip can land in benign zip framing)
+        store = CheckpointStore(d)
+        newest = store.path_for(store.latest_generation())
+        StorageFaults(seed=2).bit_flip_array(newest)
+
+        rec2 = Recorder()
+        sup2 = SyncSupervisor.restore_durable(
+            d, [lossy_addr], recorder=rec2,
+            policy=BackoffPolicy(base_s=0.005, cap_s=0.05, max_retries=3),
+            sync_timeout_s=2.0, breaker_threshold=5,
+            breaker_cooldown_s=0.05, interval_s=0.0,
+            checkpoint_every=2, seed=10)
+        snap = rec2.snapshot()
+        assert snap["counters"]["restore.fallbacks"] >= 1
+        assert snap["gauges"]["restore.generation"] < \
+            store.latest_generation()
+        # local adds survived (checkpoint K-1 + WAL replay covers them:
+        # the WAL is only truncated on a SUCCESSFUL newer checkpoint)
+        got = set(int(e) for e in sup2.node.members())
+        assert {1, 2}.issubset(got)
+
+        expect = {1, 2, 3, 20, 21, 22}
+        sup2.run(max_rounds=60, until=lambda: set(
+            int(e) for e in sup2.node.members()) == expect)
+        assert set(int(e) for e in sup2.node.members()) == expect
+        # and the peer learned the restored node's elements back
+        for _ in range(60):
+            if {1, 2}.issubset(set(int(e) for e in peer.members())):
+                break
+            sup2.sync_round()
+        assert {1, 2}.issubset(set(int(e) for e in peer.members()))
+        sup2.node.wal.close()
+        sup2.node.close()
+    finally:
+        proxy.close()
+        peer.close()
+
+
+def test_regressed_restore_forces_full_resync_and_heals_vv_hole(tmp_path):
+    """Pins the replay-context wedge: a WAL record logged against a
+    NEWER generation carries a src_vv that fast-forwards a regressed
+    base past lanes only delivered in already-truncated records.  Delta
+    compression then hides the hole forever (the peer compresses
+    against our covering vv).  The regressed restore must enter the
+    forced-FULL healing epoch — persisted in ``resync-pending`` so a
+    re-kill before the heal cannot bake the hole into a checkpoint —
+    and one supervisor pass over the peer set must heal and retire it."""
+    from go_crdt_playground_tpu.net import (Node, StorageFaults,
+                                            SyncSupervisor)
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    d = str(tmp_path / "durable")
+    peer = Node(1, 32, 2, recorder=Recorder(), conn_timeout_s=5.0,
+                hello_timeout_s=0.5)
+    peer_addr = peer.serve()
+    peer.add(20, 21, 22)
+    try:
+        rec = Recorder()
+        node = Node(0, 32, 2, recorder=rec,
+                    wal=DeltaWal(os.path.join(d, "wal"), recorder=rec))
+        store = CheckpointStore(d, recorder=rec)
+        node.save_durable(store)            # gen1: knows nothing of peer
+        node.sync_with(peer_addr)           # learns 20-22 (WAL record A)
+        node.save_durable(store)            # gen2 bakes them in; WAL cut
+        peer.add(23)
+        node.sync_with(peer_addr)           # δ{23}, src_vv[1]=4 (record B)
+        assert set(int(e) for e in node.members()) == {20, 21, 22, 23}
+        node.wal.close()                    # SIGKILL analogue
+
+        StorageFaults(seed=3).bit_flip_array(store.path_for(2))
+
+        rec2 = Recorder()
+        back = Node.restore_durable(d, recorder=rec2)
+        # the replay GUARD must refuse record B on the regressed gen1
+        # base (its δ-compression assumed vv[1]=3): without the guard,
+        # replay would fast-forward vv[1] to 4 while delivering only
+        # element 23 — a hole no later delta OR full merge can fill
+        # (full merge reads covered-but-absent as an observed remove)
+        assert back.generation == 1
+        assert int(back.vv()[1]) == 0, "guard must refuse the future record"
+        assert list(back.members()) == []
+        snap2 = rec2.snapshot()["counters"]
+        assert snap2["wal.future_records"] == 1
+        assert snap2["restore.fallbacks"] >= 1
+        # regressed restore arms the belt-and-braces healing epoch too
+        assert back.full_resync_pending
+        assert os.path.exists(os.path.join(d, "resync-pending"))
+        assert snap2["restore.full_resync"] == 1
+
+        sup = SyncSupervisor(back, [peer_addr], interval_s=0.0,
+                             sync_timeout_s=2.0, recorder=rec2, seed=1,
+                             durable_dir=d)
+        sup.sync_round()
+        assert set(int(e) for e in back.members()) == {20, 21, 22, 23}
+        assert int(back.vv()[1]) == 4
+        assert not back.full_resync_pending
+        assert not os.path.exists(os.path.join(d, "resync-pending"))
+        back.wal.close()
+        back.close()
+    finally:
+        peer.close()
+
+
+def test_resync_pending_flag_survives_rekill(tmp_path):
+    """A second kill BEFORE the heal completes must resume the healing
+    epoch from the persisted flag, even though the second restore itself
+    did not regress."""
+    from go_crdt_playground_tpu.net import Node, StorageFaults
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    d = str(tmp_path / "durable")
+    rec = Recorder()
+    node = Node(0, 16, 2, recorder=rec,
+                wal=DeltaWal(os.path.join(d, "wal"), recorder=rec))
+    store = CheckpointStore(d, recorder=rec)
+    node.add(1)
+    node.save_durable(store)
+    node.add(2)
+    node.save_durable(store)
+    node.wal.close()
+    StorageFaults(seed=4).bit_flip_array(store.path_for(2))
+
+    back = Node.restore_durable(d, recorder=Recorder())
+    assert back.full_resync_pending      # regressed: gen1 < gen2 on disk
+    back.wal.close()                     # re-kill before any heal
+
+    again = Node.restore_durable(d, recorder=Recorder())
+    # this restore also falls back (gen2 is still corrupt), but even on
+    # a non-regressed restore the persisted flag must keep the epoch on
+    assert again.full_resync_pending
+    again.clear_full_resync()
+    assert not os.path.exists(os.path.join(d, "resync-pending"))
+    again.wal.close()
+
+    third = Node.restore_durable(d, recorder=Recorder())
+    # flag cleared and gen2 still corrupt -> still regressed -> re-armed
+    assert third.full_resync_pending
+    third.wal.close()
+
+
+def test_restore_durable_all_corrupt_uses_fallback_init(tmp_path):
+    from go_crdt_playground_tpu.net import Node
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    d = str(tmp_path / "durable")
+    rec = Recorder()
+    node = Node(0, 16, 2, recorder=rec,
+                wal=DeltaWal(os.path.join(d, "wal"), recorder=rec))
+    store = CheckpointStore(d, recorder=rec)
+    node.add(1)
+    node.save_durable(store)
+    node.add(2)                     # survives in the WAL tail
+    node.wal.close()
+    _flip_bit(store.path_for(1))    # the ONLY generation is corrupt
+
+    with pytest.raises(CheckpointCorrupt):
+        Node.restore_durable(d, recorder=Recorder())
+    rec2 = Recorder()
+    back = Node.restore_durable(
+        d, recorder=rec2,
+        fallback_init=lambda: Node(0, 16, 2))
+    # every generation is gone and the WAL tail was compressed against
+    # the destroyed context, so the replay guard refuses it (applying
+    # it would poison the fresh vv); recovery proceeds empty with the
+    # forced-FULL healing epoch armed — anti-entropy re-ships history
+    assert list(back.members()) == []
+    snap = rec2.snapshot()["counters"]
+    assert snap["wal.future_records"] >= 1
+    assert back.full_resync_pending
+    back.wal.close()
+
+
+def test_partial_replay_resets_wal_so_second_kill_keeps_new_acks(tmp_path):
+    """After a guard-refused replay the WAL must be reset: otherwise
+    post-restore acked records land BEHIND the permanently-refused
+    suffix and a second kill silently discards them (review finding)."""
+    from go_crdt_playground_tpu.net import Node, StorageFaults
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    d = str(tmp_path / "durable")
+    peer = Node(1, 32, 2, recorder=Recorder(), conn_timeout_s=5.0,
+                hello_timeout_s=0.5)
+    peer_addr = peer.serve()
+    peer.add(20)
+    try:
+        rec = Recorder()
+        node = Node(0, 32, 2, recorder=rec,
+                    wal=DeltaWal(os.path.join(d, "wal"), recorder=rec))
+        store = CheckpointStore(d, recorder=rec)
+        node.save_durable(store)        # gen1
+        node.sync_with(peer_addr)       # record A
+        node.save_durable(store)        # gen2; WAL reset
+        peer.add(21)
+        node.sync_with(peer_addr)       # record B (context: gen2)
+        node.wal.close()
+        StorageFaults(seed=5).bit_flip_array(store.path_for(2))
+
+        back = Node.restore_durable(d, recorder=Recorder())
+        # replay refused record B on the gen1 base and RESET the log
+        assert back.wal.record_count() == 0
+        back.add(7)                     # acked post-restore, WAL'd
+        back.wal.close()                # second kill, still no checkpoint
+
+        rec3 = Recorder()
+        again = Node.restore_durable(d, recorder=rec3)
+        assert 7 in set(int(e) for e in again.members()), \
+            "second kill must not lose the post-restore acked add"
+        assert rec3.snapshot()["counters"]["wal.records"] >= 1
+        again.wal.close()
+    finally:
+        peer.close()
+
+
+def test_save_durable_seals_then_drops_only_covered_records(tmp_path):
+    """save_durable's two-phase truncation: records appended AFTER the
+    snapshot/seal survive the checkpoint's segment drop."""
+    from go_crdt_playground_tpu.net import Node
+    from go_crdt_playground_tpu.utils.checkpoint import save_checkpoint
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    d = str(tmp_path / "durable")
+    rec = Recorder()
+    node = Node(0, 16, 2, recorder=rec,
+                wal=DeltaWal(os.path.join(d, "wal"), recorder=rec))
+    node.add(1)
+
+    class SlowStore(CheckpointStore):
+        # a mutation racing the (out-of-lock) dump: it must land in the
+        # fresh post-seal segment and survive the drop
+        def save(self, state, **kw):
+            node.add(2)
+            return super().save(state, **kw)
+
+    store = SlowStore(d, recorder=rec)
+    gen = node.save_durable(store)
+    assert gen == 1
+    assert node.wal.record_count() == 1, \
+        "the racing add's record must survive the checkpoint truncation"
+    node.wal.close()
+
+    back = Node.restore_durable(d, recorder=Recorder())
+    assert set(int(e) for e in back.members()) == {1, 2}
+    back.wal.close()
+
+
+def test_records_scan_counts_one_tear_once(tmp_path):
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    p = str(tmp_path / "wal")
+    rec = Recorder()
+    with DeltaWal(p, recorder=rec) as w:
+        for i in range(4):
+            w.append(b"x" * 20)
+        seg = sorted(os.listdir(p))[-1]
+        with open(os.path.join(p, seg), "r+b") as f:
+            f.truncate(os.path.getsize(os.path.join(p, seg)) - 3)
+        w.record_count()
+        list(w.records())
+        list(w.records())
+    assert rec.snapshot()["counters"]["wal.torn_tail"] == 1, \
+        "one physical tear must count once, not once per scan"
+
+
+def test_wal_alone_recovers_pre_first_checkpoint_history(tmp_path):
+    """Died-before-first-checkpoint: the store is empty but the WAL
+    holds the entire history from birth, whose guards chain from zero —
+    replay alone reconstructs the state."""
+    from go_crdt_playground_tpu.net import Node
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    d = str(tmp_path / "durable")
+    rec = Recorder()
+    node = Node(0, 16, 2, recorder=rec,
+                wal=DeltaWal(os.path.join(d, "wal"), recorder=rec))
+    node.add(1, 2)
+    node.delete(1)
+    node.add(3)
+    node.wal.close()                    # killed before any save_durable
+
+    rec2 = Recorder()
+    back = Node.restore_durable(
+        d, recorder=rec2, fallback_init=lambda: Node(0, 16, 2))
+    assert set(int(e) for e in back.members()) == {2, 3}
+    snap = rec2.snapshot()["counters"]
+    assert snap["wal.records"] == 3
+    assert "wal.future_records" not in snap
+    assert not back.full_resync_pending  # nothing regressed
+    back.wal.close()
