@@ -1,0 +1,198 @@
+"""The analysis gate CLI (DESIGN.md §15).
+
+    python -m go_crdt_playground_tpu.analysis            # full gate
+    python -m go_crdt_playground_tpu.analysis --fast     # tier-1 budget
+    python -m go_crdt_playground_tpu.analysis --out P    # report path
+
+Runs all four passes and writes ``ANALYSIS_REPORT.json``:
+
+1. lock-discipline lint (``# guarded-by:`` + lock-order cycles) over
+   the threaded runtime files;
+2. a short in-process lockset race-detector exercise (instrumented
+   Node + DeltaWal driven from racing threads) so the runtime pass is
+   covered on every gate run, not only under the opt-in soaks;
+3. durability-ordering lint over the WAL/checkpoint modules and the
+   JAX-purity lint over ``ops/``;
+4. lattice-law property checks of every registered join.
+
+Exit status: 0 iff no ERROR finding.  ``--fast`` trims the lattice
+seeds and the lockset exercise, not the pass list — every pass runs in
+every mode (tier-1 wires ``--fast`` in as a non-slow test).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+from typing import List, Optional
+
+# the lattice/lockset passes touch jax; the gate is defined as a CPU
+# tool (seeded, accelerator-independent), so pin the platform before
+# any jax import unless the caller already chose one
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# pass targets, package-relative (DESIGN.md §15 pass catalog)
+LOCK_TARGETS = ["net/peer.py", "net/antientropy.py", "utils/wal.py"]
+# extra files that participate in the lock-ORDER graph (their locks can
+# nest under the runtime's)
+LOCK_ORDER_EXTRA = ["utils/checkpoint.py", "obs/metrics.py"]
+DURABILITY_TARGETS = ["utils/wal.py", "utils/checkpoint.py",
+                      "utils/checkpoint_sharded.py", "utils/fsutil.py"]
+PURITY_TARGETS = ["ops/merge.py", "ops/delta.py", "ops/lattices.py",
+                  "ops/vv.py", "ops/compact.py", "ops/pallas_merge.py",
+                  "ops/pallas_delta.py"]
+# attribute-name -> class hints for cross-class lock-order edges
+ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
+                "recorder": "Recorder", "_store": "CheckpointStore",
+                "breaker": "CircuitBreaker"}
+
+
+def _paths(rel: List[str], root: str) -> List[str]:
+    return [os.path.join(root, p) for p in rel]
+
+
+def run_lockset_exercise(report, *, rounds: int = 200) -> None:
+    """A small deliberately-contended workload under the instrumented
+    classes: two threads mutate one Node (adds/deletes vs members/vv
+    reads) while two more hammer one DeltaWal.  Everything shared is
+    lock-guarded in the current tree, so a clean run reports zero races
+    — and the pass is exercised end-to-end on every gate run."""
+    import tempfile
+
+    from go_crdt_playground_tpu.analysis.locksets import RaceDetector
+    from go_crdt_playground_tpu.net.peer import Node
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    det = RaceDetector()
+    with tempfile.TemporaryDirectory(prefix="analysis-locksets-") as d:
+        node = Node(0, 32, 4)
+        wal = DeltaWal(os.path.join(d, "wal"), fsync=False)
+        det.instrument(node, label="Node#gate")
+        det.instrument(wal, label="DeltaWal#gate")
+        try:
+            stop = threading.Event()
+
+            def mutate() -> None:
+                i = 0
+                while not stop.is_set():
+                    node.add(i % 32)
+                    if i % 3 == 0:
+                        node.delete((i + 1) % 32)
+                    i += 1
+
+            def observe() -> None:
+                while not stop.is_set():
+                    node.members()
+                    node.vv()
+
+            def log(tag: bytes) -> None:
+                i = 0
+                while not stop.is_set():
+                    wal.append(tag + str(i).encode())
+                    i += 1
+
+            threads = [threading.Thread(target=t, args=a, daemon=True)
+                       for t, a in ((mutate, ()), (observe, ()),
+                                    (log, (b"a",)), (log, (b"b",)))]
+            for t in threads:
+                t.start()
+            # bound by work, not wall time: wait until the WAL saw
+            # enough appends (or a short timeout on pathologic hosts)
+            deadline = rounds
+            import time as _time
+
+            t0 = _time.monotonic()
+            while _time.monotonic() - t0 < 10.0:
+                if wal.record_count() >= deadline:
+                    break
+                _time.sleep(0.01)
+            stop.set()
+            for t in threads:
+                t.join(timeout=5.0)
+        finally:
+            stats = det.stats()
+            det.uninstall(node)
+            det.uninstall(wal)
+            wal.close()
+    report.extend(det.findings)
+    report.add_stats("locksets", mode="gate-exercise", **stats)
+
+
+def build_report(fast: bool, root: str = PKG_ROOT,
+                 skip_runtime: bool = False):
+    from go_crdt_playground_tpu.analysis import (durability, lattice_laws,
+                                                 lockdiscipline, purity)
+    from go_crdt_playground_tpu.analysis.report import Report
+
+    report = Report()
+
+    findings, stats = lockdiscipline.analyze_files(
+        _paths(LOCK_TARGETS + LOCK_ORDER_EXTRA, root),
+        attr_classes=ATTR_CLASSES)
+    # the extra files join the lock-order graph only; their guarded-by
+    # coverage is (deliberately) not yet swept, so restrict L001/L003 to
+    # the ISSUE-targeted runtime files
+    targeted = {os.path.abspath(p) for p in _paths(LOCK_TARGETS, root)}
+    findings = [f for f in findings
+                if f.code == "L002" or f.path is None
+                or os.path.abspath(f.path) in targeted]
+    report.extend(findings)
+    report.add_stats("lockdiscipline", **stats)
+
+    f2, s2 = durability.analyze_files(_paths(DURABILITY_TARGETS, root))
+    report.extend(f2)
+    report.add_stats("durability", **s2)
+
+    f3, s3 = purity.analyze_files(_paths(PURITY_TARGETS, root))
+    report.extend(f3)
+    report.add_stats("purity", **s3)
+
+    seeds = (11,) if fast else (11, 12, 13)
+    n_ops = 24 if fast else 40
+    f4, s4 = lattice_laws.check_registry(seeds, n_ops=n_ops)
+    report.extend(f4)
+    report.add_stats("lattice_laws", **s4)
+
+    if skip_runtime:
+        report.add_stats("locksets", mode="skipped")
+    else:
+        run_lockset_exercise(report, rounds=60 if fast else 200)
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m go_crdt_playground_tpu.analysis",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 budget: fewer lattice seeds, shorter "
+                         "lockset exercise (every pass still runs)")
+    ap.add_argument("--out", default="ANALYSIS_REPORT.json",
+                    help="report path (default: ./ANALYSIS_REPORT.json)")
+    ap.add_argument("--root", default=PKG_ROOT,
+                    help="package root to analyze (default: the "
+                         "installed go_crdt_playground_tpu)")
+    ap.add_argument("--skip-runtime", action="store_true",
+                    help="skip the in-process lockset exercise (pass is "
+                         "reported as skipped, not covered)")
+    args = ap.parse_args(argv)
+
+    report = build_report(args.fast, root=args.root,
+                          skip_runtime=args.skip_runtime)
+    report.write_json(args.out)
+    for f in report.findings:
+        print(f.render())
+    n_err = len(report.errors())
+    print(f"wrote {args.out}: {len(report.findings)} findings, "
+          f"{n_err} errors, passes: "
+          + ", ".join(sorted(report.stats)))
+    return 0 if report.ok() else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

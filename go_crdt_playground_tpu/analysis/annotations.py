@@ -1,0 +1,137 @@
+"""The annotation grammar shared by every analyzer pass.
+
+Annotations are structured comments — the code already carried the
+contracts in prose ("Caller holds the lock."); this makes them machine-
+checkable without changing any runtime behavior.  Grammar (DESIGN.md
+§15; one annotation per line, attached to the statement that spans the
+comment's line):
+
+    # guarded-by: <lock>        field annotation, on the ``self.f = ...``
+                                line (usually in ``__init__``): every
+                                later access to ``f`` must hold ``<lock>``
+                                (a ``with self.<lock>:`` block or a
+                                ``requires-lock`` method).
+    # requires-lock: <lock>     method annotation, on the ``def`` line:
+                                the CALLER must hold ``<lock>``; the body
+                                may then touch guarded fields freely, and
+                                every call site of the method is checked
+                                instead.
+    # race-ok: <reason>         field annotation: excluded from both the
+                                static lint and the runtime lockset
+                                detector, with the reason on record
+                                (benign flags, owner-thread-only fields).
+    # durable-on-return         function annotation: the durability lint
+                                requires an fsync to dominate the end of
+                                this function (its return IS the ack).
+
+``<lock>`` names an attribute of the same object (``_lock``,
+``_conn_slots``).  Parsing is tokenize-based so annotations survive any
+formatting; attachment is by line coverage of the enclosing statement
+(multi-line statements carry the annotation on any of their lines,
+conventionally the first).  A STANDALONE annotation comment (its own
+line) attaches to the next STATEMENT line — continuation comment lines
+and blank lines below it are skipped — so long reasons can wrap without
+fighting the line-length limit.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_ANNOT_RE = re.compile(
+    r"#\s*(guarded-by|requires-lock|race-ok|durable-on-return)\s*"
+    r"(?::\s*(?P<arg>\S[^#]*?))?\s*$")
+
+KIND_GUARDED_BY = "guarded-by"
+KIND_REQUIRES_LOCK = "requires-lock"
+KIND_RACE_OK = "race-ok"
+KIND_DURABLE_ON_RETURN = "durable-on-return"
+
+_ARG_REQUIRED = {KIND_GUARDED_BY, KIND_REQUIRES_LOCK, KIND_RACE_OK}
+
+
+@dataclass
+class Annotation:
+    kind: str
+    arg: Optional[str]   # lock name / reason; None for durable-on-return
+    line: int
+
+
+@dataclass
+class AnnotationSet:
+    """All annotations of one source file, indexed by line."""
+
+    by_line: Dict[int, Annotation] = field(default_factory=dict)
+    malformed: List[str] = field(default_factory=list)
+
+    def on_lines(self, first: int, last: int,
+                 kind: Optional[str] = None) -> Optional[Annotation]:
+        """The annotation attached to a statement spanning [first, last]
+        (first match wins; statements conventionally annotate their
+        first line)."""
+        for ln in range(first, last + 1):
+            a = self.by_line.get(ln)
+            if a is not None and (kind is None or a.kind == kind):
+                return a
+        return None
+
+
+def parse_annotations(source: str, path: str = "<string>") -> AnnotationSet:
+    """Extract every analyzer annotation from ``source``.  Unknown
+    comment shapes are ignored (they are just comments); a RECOGNIZED
+    keyword with a missing required argument is recorded as malformed so
+    the lint can surface the typo instead of silently skipping the
+    contract."""
+    out = AnnotationSet()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [(t.start[0], t.start[1], t.line, t.string)
+                    for t in tokens if t.type == tokenize.COMMENT]
+    except tokenize.TokenError:
+        return out
+    standalone_lines = {ln for ln, col, srcline, _ in comments
+                        if srcline[:col].strip() == ""}
+    src_lines = source.splitlines()
+
+    def _skippable(ln: int) -> bool:
+        # comment-only continuation lines and blank separator lines sit
+        # between a standalone annotation and the statement it means
+        return (ln in standalone_lines
+                or (ln - 1 < len(src_lines)
+                    and not src_lines[ln - 1].strip()))
+
+    for line, col, srcline, text in comments:
+        # a standalone comment line annotates the statement BELOW it —
+        # skipping further comment-only and blank lines first, so an
+        # annotation whose reason wraps (or that sits a blank line
+        # above its statement) still lands on the statement
+        if srcline[:col].strip() == "":
+            line += 1
+            while line <= len(src_lines) and _skippable(line):
+                line += 1
+        m = _ANNOT_RE.search(text)
+        if not m:
+            # a comment that STARTS with an annotation keyword but fails
+            # the strict grammar (missing colon, empty argument) is a
+            # typo'd contract — silent skip would un-check the very
+            # invariant the author tried to state.  Prose merely
+            # mentioning a keyword mid-comment is left alone.
+            if re.match(r"#\s*(guarded-by|requires-lock|race-ok)\b",
+                        text):
+                out.malformed.append(
+                    f"{path}:{line}: malformed annotation {text.strip()!r}"
+                    " (expected '# <kind>: <arg>')")
+            continue
+        kind = m.group(1)
+        arg = m.group("arg")
+        arg = arg.strip() if arg else None
+        if kind in _ARG_REQUIRED and not arg:
+            out.malformed.append(
+                f"{path}:{line}: annotation '# {kind}:' needs an argument")
+            continue
+        out.by_line[line] = Annotation(kind=kind, arg=arg, line=line)
+    return out

@@ -1,0 +1,207 @@
+"""JAX-purity lint: jit/Pallas-reachable code must be side-effect free.
+
+A function traced by ``jax.jit`` (or compiled into a Pallas kernel) runs
+its Python body ONCE, at trace time; any host side effect — wall-clock
+reads, RNG draws, printing, file or socket I/O, global mutation — bakes
+a single stale value into the compiled program or fires at compile time
+instead of run time.  The merge kernels are the paper's hot path; a
+``time.time()`` smuggled into one is a silent semantics bug, not a perf
+nit.  This pass (P001):
+
+* finds jit ROOTS in each ``ops/`` module: ``@jax.jit``-decorated
+  functions, ``functools.partial(jax.jit, ...)`` decorations,
+  ``x = jax.jit(f)`` module-level wrappings, and any function that
+  calls ``pl.pallas_call`` (its kernel closures trace on device);
+* walks the same-module call graph from those roots (imported helpers
+  are out of scope — they are linted when their module is scanned);
+* flags calls to banned host APIs and ``global``/``nonlocal``
+  declarations inside reachable functions.
+
+Allowed by design: ``jax.debug.print`` / ``jax.debug.callback`` (the
+sanctioned effect escape hatches) and trace-time ``import`` statements
+(cached, idempotent).  ``numpy`` host math on STATIC values is legal at
+trace time and not flagged — only the named effectful APIs are banned,
+because distinguishing static-time numpy from traced-value numpy needs
+type inference a lint does not have.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from go_crdt_playground_tpu.analysis.report import (PURITY_VIOLATION,
+                                                    SEVERITY_ERROR, Finding)
+
+# dotted-call prefixes that are host effects inside traced code
+_BANNED_PREFIXES = (
+    "time.", "datetime.", "random.", "np.random.", "numpy.random.",
+    "os.", "sys.", "socket.", "subprocess.", "threading.",
+)
+_BANNED_NAMES = {"print", "open", "input", "exec", "eval"}
+# sanctioned escape hatches
+_ALLOWED_DOTTED = {"jax.debug.print", "jax.debug.callback",
+                   "jax.debug.breakpoint"}
+
+
+def _dotted(node: ast.expr) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    """``@jax.jit`` / ``@jit`` / ``@functools.partial(jax.jit, ...)`` /
+    ``@partial(jax.jit, ...)``."""
+    d = _dotted(dec)
+    if d in ("jax.jit", "jit"):
+        return True
+    if isinstance(dec, ast.Call):
+        f = _dotted(dec.func)
+        if f in ("functools.partial", "partial") and dec.args:
+            return _dotted(dec.args[0]) in ("jax.jit", "jit")
+        if f in ("jax.jit", "jit"):
+            return True
+    return False
+
+
+def _calls_pallas(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d is not None and d.endswith("pallas_call"):
+                return True
+    return False
+
+
+def _module_functions(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    """Top-level functions (the call-graph nodes).  Methods are included
+    under ``Class.name`` AND bare name for same-module resolution."""
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            out[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for m in node.body:
+                if isinstance(m, ast.FunctionDef):
+                    out.setdefault(m.name, m)
+    return out
+
+
+def _jit_roots(tree: ast.Module,
+               fns: Dict[str, ast.FunctionDef]) -> Set[str]:
+    roots: Set[str] = set()
+    for name, fn in fns.items():
+        if any(_is_jit_decorator(d) for d in fn.decorator_list):
+            roots.add(name)
+        if _calls_pallas(fn):
+            roots.add(name)
+    # module-level ``x = jax.jit(f, ...)`` wrappings
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            f = _dotted(node.value.func)
+            if f in ("jax.jit", "jit") and node.value.args:
+                target = node.value.args[0]
+                if isinstance(target, ast.Name) and target.id in fns:
+                    roots.add(target.id)
+                elif isinstance(target, ast.Lambda):
+                    pass  # lambdas scanned via their enclosing function
+    return roots
+
+
+def _local_calls(fn: ast.FunctionDef,
+                 fns: Dict[str, ast.FunctionDef]) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d is not None and d in fns:
+                out.add(d)
+        elif isinstance(node, ast.Name) and node.id in fns:
+            # bare function references (vmap(f), partial(f, ...))
+            out.add(node.id)
+    return out
+
+
+def _check_function(fn: ast.FunctionDef, qual: str, path: str
+                    ) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            findings.append(Finding(
+                analyzer="purity", code=PURITY_VIOLATION,
+                severity=SEVERITY_ERROR, path=path, line=node.lineno,
+                symbol=qual,
+                message=(f"{type(node).__name__.lower()} declaration in "
+                         "jit/Pallas-reachable code: host mutation bakes "
+                         "trace-time state into the compiled program")))
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if d is None:
+            continue
+        if d in _ALLOWED_DOTTED:
+            continue
+        if d in _BANNED_NAMES:
+            findings.append(Finding(
+                analyzer="purity", code=PURITY_VIOLATION,
+                severity=SEVERITY_ERROR, path=path, line=node.lineno,
+                symbol=qual,
+                message=(f"call to {d}() in jit/Pallas-reachable code: "
+                         "host I/O fires at trace time, not run time")))
+            continue
+        for prefix in _BANNED_PREFIXES:
+            if d.startswith(prefix):
+                findings.append(Finding(
+                    analyzer="purity", code=PURITY_VIOLATION,
+                    severity=SEVERITY_ERROR, path=path, line=node.lineno,
+                    symbol=qual,
+                    message=(f"call to {d} in jit/Pallas-reachable code: "
+                             "wall-clock/RNG/OS state is frozen at trace "
+                             "time (hoist it to the host caller)")))
+                break
+    return findings
+
+
+def analyze_file(path: str, source: Optional[str] = None
+                 ) -> Tuple[List[Finding], Dict]:
+    if source is None:
+        with open(path) as f:
+            source = f.read()
+    tree = ast.parse(source, filename=path)
+    fns = _module_functions(tree)
+    roots = _jit_roots(tree, fns)
+    # reachability over the same-module call graph
+    reachable: Set[str] = set()
+    frontier = list(roots)
+    while frontier:
+        name = frontier.pop()
+        if name in reachable:
+            continue
+        reachable.add(name)
+        for callee in _local_calls(fns[name], fns):
+            if callee not in reachable:
+                frontier.append(callee)
+    findings: List[Finding] = []
+    for name in sorted(reachable):
+        findings.extend(_check_function(fns[name], name, path))
+    stats = {"jit_roots": sorted(roots),
+             "reachable_checked": len(reachable)}
+    return findings, stats
+
+
+def analyze_files(paths: List[str]) -> Tuple[List[Finding], Dict]:
+    findings: List[Finding] = []
+    stats: Dict = {"files": len(paths), "jit_roots": 0,
+                   "reachable_checked": 0}
+    for p in paths:
+        f, s = analyze_file(p)
+        findings.extend(f)
+        stats["jit_roots"] += len(s["jit_roots"])
+        stats["reachable_checked"] += s["reachable_checked"]
+    return findings, stats

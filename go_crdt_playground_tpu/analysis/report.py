@@ -1,0 +1,94 @@
+"""Finding/report plumbing shared by every analyzer pass.
+
+A ``Finding`` is one diagnostic: pass name, severity, location, message,
+and an optional stable ``code`` (the grep-able contract — tests pin
+codes, not message prose).  ``Report`` aggregates per-pass findings plus
+pass-level stats into the ``ANALYSIS_REPORT.json`` shape documented in
+DESIGN.md §15.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+SEVERITY_ERROR = "error"      # gate-failing: the invariant is violated
+SEVERITY_WARNING = "warning"  # suspicious but not gate-failing
+
+# Stable finding codes (tests and DESIGN.md §15 pin these):
+UNGUARDED_ACCESS = "L001"       # guarded field touched without its lock
+LOCK_ORDER_CYCLE = "L002"       # lock acquisition graph has a cycle
+UNANNOTATED_SHARED = "L003"     # field locked sometimes, annotated never
+RACE_EMPTY_LOCKSET = "R001"     # runtime: shared write, empty lockset
+FSYNC_MISSING = "D001"          # ack/rename not dominated by fsync
+PURITY_VIOLATION = "P001"       # jit/Pallas-reachable host side effect
+LAW_COMMUTATIVITY = "J001"
+LAW_ASSOCIATIVITY = "J002"
+LAW_IDEMPOTENCE = "J003"
+
+
+@dataclass
+class Finding:
+    analyzer: str                 # "lockdiscipline" | "locksets" | ...
+    code: str
+    severity: str
+    message: str
+    path: Optional[str] = None
+    line: Optional[int] = None
+    symbol: Optional[str] = None  # class.field / function / join name
+
+    def location(self) -> str:
+        loc = self.path or "<runtime>"
+        if self.line is not None:
+            loc += f":{self.line}"
+        return loc
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return (f"{self.severity.upper()} {self.code} "
+                f"{self.location()}{sym}: {self.message}")
+
+
+@dataclass
+class Report:
+    """One gate run: per-pass findings + stats, JSON-serializable."""
+
+    findings: List[Finding] = field(default_factory=list)
+    stats: Dict[str, Dict] = field(default_factory=dict)
+
+    def extend(self, findings: List[Finding]) -> None:
+        self.findings.extend(findings)
+
+    def add_stats(self, analyzer: str, **stats) -> None:
+        self.stats.setdefault(analyzer, {}).update(stats)
+
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == SEVERITY_ERROR]
+
+    def ok(self) -> bool:
+        return not self.errors()
+
+    def to_dict(self) -> Dict:
+        by_pass: Dict[str, List[Dict]] = {}
+        for f in self.findings:
+            by_pass.setdefault(f.analyzer, []).append(asdict(f))
+        return {
+            "ok": self.ok(),
+            "n_findings": len(self.findings),
+            "n_errors": len(self.errors()),
+            "passes": {
+                name: {
+                    "stats": self.stats.get(name, {}),
+                    "findings": by_pass.get(name, []),
+                }
+                # every pass appears even when clean — "covered and
+                # found nothing" must be distinguishable from "not run"
+                for name in sorted(set(self.stats) | set(by_pass))
+            },
+        }
+
+    def write_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")

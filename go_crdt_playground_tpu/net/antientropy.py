@@ -148,10 +148,10 @@ class CircuitBreaker:
         self._clock = clock
         self._on_transition = on_transition
         self._lock = threading.Lock()
-        self._state = CLOSED
-        self._consecutive = 0
-        self._opened_at = 0.0
-        self._probe_granted_at = 0.0
+        self._state = CLOSED  # guarded-by: _lock
+        self._consecutive = 0  # guarded-by: _lock
+        self._opened_at = 0.0  # guarded-by: _lock
+        self._probe_granted_at = 0.0  # guarded-by: _lock
 
     @property
     def state(self) -> str:
@@ -163,6 +163,7 @@ class CircuitBreaker:
         with self._lock:
             return self._consecutive
 
+    # requires-lock: _lock
     def _set_state(self, new: str) -> None:
         """Caller holds the lock.  Fires the transition hook OUTSIDE any
         state mutation ordering concern (hook runs under the lock; keep
@@ -300,22 +301,29 @@ class SyncSupervisor:
 
             self._store = CheckpointStore(
                 durable_dir, keep=keep_generations, recorder=self.recorder)
-            if node.wal is None:
-                # attach the log so every delta the supervisor's rounds
-                # merge (and every local mutation) is durable between
-                # the periodic checkpoints
-                node.wal = DeltaWal(_os.path.join(durable_dir, "wal"),
-                                    fsync=wal_fsync, recorder=self.recorder)
+            with node._lock:
+                if node.wal is None:
+                    # attach the log so every delta the supervisor's
+                    # rounds merge (and every local mutation) is durable
+                    # between the periodic checkpoints
+                    node.wal = DeltaWal(
+                        _os.path.join(durable_dir, "wal"),
+                        fsync=wal_fsync, recorder=self.recorder)
         self.seed = seed
         self._sleep = sleep
         self._clock = clock
+        # race-ok: single-driver contract — rounds run from one thread
+        # at a time (run()/sync_round() caller XOR the start() loop)
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
-        self._peers: List[Addr] = []
-        self._breakers: Dict[Addr, CircuitBreaker] = {}
-        self._rounds_done = 0
+        self._peers: List[Addr] = []  # guarded-by: _lock
+        self._breakers: Dict[Addr, CircuitBreaker] = {}  # guarded-by: _lock
+        self._rounds_done = 0  # guarded-by: _lock
         self._stop = threading.Event()
+        # race-ok: start()/stop() owner thread only
         self._thread: Optional[threading.Thread] = None
+        # race-ok: post-mortem breadcrumb (loop thread writes, a
+        # post-stop reader inspects); no control flow depends on it
         self.last_error: Optional[BaseException] = None
         for p in peers:
             self.add_peer(p)
@@ -386,7 +394,7 @@ class SyncSupervisor:
                 continue
             ok = self._sync_peer(addr, breaker)
             summary["succeeded" if ok else "failed"] += 1
-        if self.node.full_resync_pending:
+        if self.node.full_resync_is_pending():
             # regressed-restore healing epoch: once every registered
             # peer has served a forced-FULL exchange, the durable
             # resync-pending flag can be retired
@@ -528,7 +536,8 @@ class SyncSupervisor:
         lock hold (the truncated records are exactly the ones the dump
         contains); without it, the legacy single-file ``Node.save``.
         Returns the written path."""
-        meta = {"supervisor_rounds": self._rounds_done}
+        with self._lock:
+            meta = {"supervisor_rounds": self._rounds_done}
         if self._store is not None:
             gen = self.node.save_durable(self._store, metadata=meta)
             self._count("sync.checkpoints")

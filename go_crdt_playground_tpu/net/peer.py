@@ -149,29 +149,32 @@ class Node:
         if not 0 <= actor < num_actors:
             raise ValueError(f"actor {actor} outside actor axis {num_actors}")
         self.recorder = recorder
-        self.wal = wal
-        self.generation = 0  # last durably-restored/saved store generation
+        self.wal = wal  # guarded-by: _lock
+        # last durably-restored/saved store generation
+        self.generation = 0  # guarded-by: _lock
         # regressed-restore healing epoch (see restore_durable): while
         # pending, the first exchange with each peer advertises a ZERO
         # vv so the peer ships FULL state — a replayed WAL record whose
         # src_vv outran a regressed base may have fast-forwarded our vv
         # past lanes we never received, and delta compression would hide
         # that hole forever
-        self.full_resync_pending = False
-        self._full_resync_done: set = set()
-        self._resync_flag_path: Optional[str] = None
+        self.full_resync_pending = False  # guarded-by: _lock
+        self._full_resync_done: set = set()  # guarded-by: _lock
+        self._resync_flag_path: Optional[str] = None  # guarded-by: _lock
         self.actor = actor
         self.num_elements = num_elements
         self.num_actors = num_actors
         self.delta_semantics = delta_semantics
         self.strict_reference_semantics = strict_reference_semantics
         self._lock = threading.Lock()
-        self._state = awset_delta.init(
+        self._state = awset_delta.init(  # guarded-by: _lock
             1, num_elements, num_actors,
             actors=np.asarray([actor], np.uint32))
+        # race-ok: serve()/close() owner thread; _accept_loop snapshots
         self._server_sock: Optional[socket.socket] = None
+        # race-ok: serve()/close() owner thread only
         self._server_thread: Optional[threading.Thread] = None
-        self._closing = False
+        self._closing = False  # race-ok: benign monotonic stop flag
         self.conn_timeout_s = (self.CONN_TIMEOUT_S if conn_timeout_s is None
                                else conn_timeout_s)
         # tunable for slow-but-legitimate WAN dialers; still clamped by
@@ -254,6 +257,7 @@ class Node:
 
     # -- payload plumbing ---------------------------------------------------
 
+    # requires-lock: _lock
     def _extract_msg(self, peer_vv: np.ndarray) -> Tuple[int, bytes]:
         """Build the PAYLOAD frame body for a peer that advertised peer_vv.
         Caller holds the lock."""
@@ -284,6 +288,7 @@ class Node:
             mode, self.actor, np.asarray(me.processed), payload)
         return mode, body
 
+    # requires-lock: _lock
     def _apply_msg(self, body: bytes) -> int:
         """Decode + apply a PAYLOAD frame body.  Caller holds the lock."""
         import jax
@@ -324,6 +329,7 @@ class Node:
             lambda full, row: full.at[0].set(row), self._state, merged)
         return mode
 
+    # requires-lock: _lock
     def _guard_bytes(self, vv: Optional[np.ndarray] = None) -> bytes:
         """Encode the replay guard: the vv this record's δ-compression
         was computed against (default: our current vv).  Caller holds
@@ -334,6 +340,7 @@ class Node:
             vv = np.asarray(self._state.vv[0])
         return wire._encode_vv_py(np.asarray(vv, np.uint32))
 
+    # requires-lock: _lock
     def _log_local_delta(self, pre_vv: np.ndarray) -> None:
         """WAL a local mutation as the δ it produced vs the pre-op VV —
         the same PAYLOAD-body wire form merged deltas are logged in, so
@@ -377,7 +384,8 @@ class Node:
         from go_crdt_playground_tpu.utils import wire
 
         replayed = bad = future = 0
-        saved, self.wal = self.wal, None
+        with self._lock:
+            saved, self.wal = self.wal, None
         try:
             for body in wal.records():
                 try:
@@ -397,7 +405,8 @@ class Node:
                     break
                 replayed += 1
         finally:
-            self.wal = saved
+            with self._lock:
+                self.wal = saved
         if self.recorder is not None:
             if replayed:
                 self.recorder.count("wal.records", replayed)
@@ -435,15 +444,22 @@ class Node:
                 conn.close()  # at capacity: shed load instead of queueing
                 continue
             # daemonic and unretained: connection threads die with their
-            # socket, so a long-lived node doesn't accumulate objects
+            # socket, so a long-lived node doesn't accumulate objects.
+            # The slot handoff is finally-shaped: ANY failure to start
+            # the handler (thread exhaustion, interpreter shutdown —
+            # not just RuntimeError) must shed the dial AND return the
+            # slot, else capacity decays one leak at a time.
+            handed_off = False
             try:
                 threading.Thread(target=self._handle, args=(conn,),
                                  daemon=True).start()
+                handed_off = True
             except RuntimeError:
-                # OS thread exhaustion: shed this dial and keep serving —
-                # without the release the slot leaks and capacity decays
-                conn.close()
-                self._conn_slots.release()
+                pass  # OS thread exhaustion: shed the dial, keep serving
+            finally:
+                if not handed_off:
+                    conn.close()
+                    self._conn_slots.release()
 
     def _handle(self, conn: socket.socket) -> None:
         try:
@@ -558,21 +574,33 @@ class Node:
             strict_reference_semantics=meta["strict_reference_semantics"],
             recorder=recorder,
         )
-        node._state = ck.state
+        with node._lock:
+            node._state = ck.state
         return node
 
+    def full_resync_is_pending(self) -> bool:
+        """Locked read of the healing-epoch flag (the supervisor polls
+        this once per round; a stale read would only delay retirement by
+        a round, but the lockset detector rightly refuses to bless
+        "mostly harmless" bare reads of a mutated field)."""
+        with self._lock:
+            return self.full_resync_pending
+
     def full_resync_done_for(self, addr: Tuple[str, int]) -> bool:
-        return (addr[0], int(addr[1])) in self._full_resync_done
+        with self._lock:
+            return (addr[0], int(addr[1])) in self._full_resync_done
 
     def clear_full_resync(self) -> None:
         """End the regressed-restore healing epoch: every peer has served
         a FULL exchange (the supervisor calls this once its whole peer
         set is covered), so the durable flag can go."""
-        self.full_resync_pending = False
-        self._full_resync_done.clear()
-        if self._resync_flag_path is not None:
+        with self._lock:
+            self.full_resync_pending = False
+            self._full_resync_done.clear()
+            flag_path = self._resync_flag_path
+        if flag_path is not None:
             try:
-                os.unlink(self._resync_flag_path)
+                os.unlink(flag_path)
             except OSError:
                 pass
 
@@ -603,12 +631,13 @@ class Node:
         meta = self._node_metadata(metadata)
         with self._lock:
             state = self._state  # states are immutable pytrees: a
-            sealed = (self.wal.seal()  # reference IS a snapshot
-                      if self.wal is not None else None)
+            wal = self.wal       # reference IS a snapshot
+            sealed = wal.seal() if wal is not None else None
         gen = store.save(state, metadata=meta)
-        if sealed is not None and self.wal is not None:
-            self.wal.drop_segments(sealed)
-        self.generation = gen
+        if sealed is not None and wal is not None:
+            wal.drop_segments(sealed)
+        with self._lock:
+            self.generation = gen
         return gen
 
     @classmethod
@@ -667,8 +696,10 @@ class Node:
                     "strict_reference_semantics"],
                 recorder=recorder,
             )
-            node._state = ck.state
-        node.generation = gen
+            with node._lock:
+                node._state = ck.state
+        with node._lock:
+            node.generation = gen
         wal = DeltaWal(_os.path.join(dirpath, "wal"), recorder=recorder)
         stats = node.replay_wal(wal)
         if stats["bad"] or stats["future"]:
@@ -678,7 +709,8 @@ class Node:
             # record, and silently discard them.  Reset to a clean log;
             # the armed resync epoch / anti-entropy covers the gap.
             wal.truncate()
-        node.wal = wal
+        with node._lock:
+            node.wal = wal
         # regressed restore (an older generation than the newest on
         # disk): WAL records logged against the newer lineage may have
         # fast-forwarded our vv past lanes delivered only in truncated
@@ -689,7 +721,8 @@ class Node:
         regressed = (fell_back or (0 < gen < latest_on_disk)
                      or stats["future"] > 0)
         flag_path = _os.path.join(dirpath, "resync-pending")
-        node._resync_flag_path = flag_path
+        with node._lock:
+            node._resync_flag_path = flag_path
         if regressed:
             with open(flag_path, "w") as f:
                 f.write("regressed restore: full resync pending\n")
@@ -697,7 +730,9 @@ class Node:
                 _os.fsync(f.fileno())
             if recorder is not None:
                 recorder.count("restore.full_resync")
-        node.full_resync_pending = regressed or _os.path.exists(flag_path)
+        pending = regressed or _os.path.exists(flag_path)
+        with node._lock:
+            node.full_resync_pending = pending
         return node
 
     def close(self) -> None:
@@ -756,10 +791,11 @@ class Node:
         # first-contact branch) — delta compression against our real vv
         # would skip any lane a regressed replay fast-forwarded us past
         addr_key = (addr[0], int(addr[1]))
-        forcing_full = (self.full_resync_pending
-                        and addr_key not in self._full_resync_done)
-        adv_vv = (np.zeros(self.num_actors, np.uint32) if forcing_full
-                  else self.vv())
+        with self._lock:
+            forcing_full = (self.full_resync_pending
+                            and addr_key not in self._full_resync_done)
+            adv_vv = (np.zeros(self.num_actors, np.uint32) if forcing_full
+                      else np.asarray(self._state.vv[0]).copy())
         with sock:
             phase = "hello"
             try:
@@ -800,7 +836,8 @@ class Node:
                 raise PeerReset(
                     f"{phase} exchange with {addr}: {e}") from e
         if forcing_full:
-            self._full_resync_done.add(addr_key)
+            with self._lock:
+                self._full_resync_done.add(addr_key)
         self._record(mode_sent, bytes_sent=sent, bytes_received=recv)
         return SyncStats(bytes_sent=sent, bytes_received=recv,
                          mode_sent=mode_sent, mode_received=mode_recv)

@@ -14,10 +14,11 @@ config 2 measures it at 1K replicas.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Any, Callable, Dict, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 # ---------------------------------------------------------------------------
@@ -373,3 +374,174 @@ def join_pairwise(join_fn, dst, src):
 def gossip_round(join_fn, state, perm):
     src = jax.tree.map(lambda x: x[perm], state)
     return join_pairwise(join_fn, state, src)
+
+
+# ---------------------------------------------------------------------------
+# Join registry (consumed by analysis/lattice_laws.py)
+# ---------------------------------------------------------------------------
+
+
+class JoinSpec(NamedTuple):
+    """One registered join, packaged for property checking.
+
+    ``sample(rng, n_rows, n_ops)`` returns a batched state of reachable
+    rows — built by replaying seeded random ops of the family plus
+    gossip mixing through the join itself, because the lattice laws are
+    only promised over REACHABLE states (an arbitrary bit pattern can
+    encode causal nonsense no replica could ever hold).  ``project``
+    maps a state to the dict of observable arrays the laws are checked
+    on; families whose non-observable metadata is order-sensitive by
+    documented design (the AWSet stale-dot-overwrite quirk, merge.py)
+    exclude it here, exactly as the crash soak's convergence digest
+    does."""
+
+    name: str
+    sample: Callable[[np.random.Generator, int, int], Any]
+    join: Callable[[Any, Any], Any]
+    project: Callable[[Any], Dict[str, np.ndarray]]
+
+
+JOIN_REGISTRY: Dict[str, JoinSpec] = {}
+
+
+def register_join(spec: JoinSpec) -> JoinSpec:
+    """Idempotent by name (re-import safe); the analysis gate enumerates
+    this registry, so a new family is law-checked the moment it
+    registers."""
+    JOIN_REGISTRY[spec.name] = spec
+    return spec
+
+
+def mix_rows(join_fn, state, rng: np.random.Generator, p: float = 0.5):
+    """One gossip-style mixing step of the reachable-state samplers:
+    each row joins a permuted partner row with probability ``p``."""
+    n = int(state[0].shape[0])
+    perm = jnp.asarray(rng.permutation(n))
+    src = jax.tree.map(lambda x: x[perm], state)
+    merged = join_fn(state, src)
+    mask = rng.random(n) < p
+
+    def sel(m, o):
+        mm = jnp.asarray(mask.reshape((n,) + (1,) * (m.ndim - 1)))
+        return jnp.where(mm, m, o)
+
+    return jax.tree.map(sel, merged, state)
+
+
+_SAMPLE_ELEMS = 8  # element universe of the set/map family samplers
+
+
+def _sample_gcounter(rng: np.random.Generator, n: int, n_ops: int):
+    state = gcounter_init(n, n)
+    for _ in range(n_ops):
+        if rng.random() < 0.6:
+            state = gcounter_inc(state, jnp.uint32(rng.integers(n)),
+                                 jnp.uint32(rng.integers(1, 5)))
+        else:
+            state = mix_rows(gcounter_join, state, rng)
+    return state
+
+
+def _sample_pncounter(rng: np.random.Generator, n: int, n_ops: int):
+    state = pncounter_init(n, n)
+    for _ in range(n_ops):
+        if rng.random() < 0.6:
+            state = pncounter_add(state, jnp.uint32(rng.integers(n)),
+                                  jnp.int32(rng.integers(-4, 5)))
+        else:
+            state = mix_rows(pncounter_join, state, rng)
+    return state
+
+
+def _sample_twopset(rng: np.random.Generator, n: int, n_ops: int):
+    state = twopset_init(n, _SAMPLE_ELEMS)
+    for _ in range(n_ops):
+        roll = rng.random()
+        r = jnp.uint32(rng.integers(n))
+        e = jnp.uint32(rng.integers(_SAMPLE_ELEMS))
+        if roll < 0.4:
+            state = twopset_add(state, r, e)
+        elif roll < 0.6:
+            state = twopset_del(state, r, e)
+        else:
+            state = mix_rows(twopset_join, state, rng)
+    return state
+
+
+def _sample_lwwmap(rng: np.random.Generator, n: int, n_ops: int):
+    state = lwwmap_init(n, _SAMPLE_ELEMS)
+    ts = 0
+    for _ in range(n_ops):
+        if rng.random() < 0.6:
+            ts += 1  # globally unique stamps: the documented caller
+            #          contract (ties on (ts, actor) are out of model)
+            state = lwwmap_put(
+                state, jnp.uint32(rng.integers(n)),
+                jnp.uint32(rng.integers(_SAMPLE_ELEMS)),
+                jnp.uint32(rng.integers(1000)), jnp.uint32(ts),
+                jnp.bool_(bool(rng.random() < 0.8)))
+        else:
+            state = mix_rows(lwwmap_join, state, rng)
+    return state
+
+
+def _sample_mvregister(rng: np.random.Generator, n: int, n_ops: int):
+    state = mvregister_init(n, n)
+    val = 0
+    for _ in range(n_ops):
+        if rng.random() < 0.6:
+            val += 1
+            state = mvregister_write(state, jnp.uint32(rng.integers(n)),
+                                     jnp.uint32(val))
+        else:
+            state = mix_rows(mvregister_join, state, rng)
+    return state
+
+
+def _sample_ormap(rng: np.random.Generator, n: int, n_ops: int):
+    state = ormap_init(n, _SAMPLE_ELEMS, n)
+    # single-put-per-element ownership: re-adding a live element
+    # exercises the documented stale-dot-overwrite order sensitivity of
+    # the underlying AWSet merge (merge.py docstring) — in scope for the
+    # soaks' convergence story, out of model for the lattice laws
+    unput = list(range(_SAMPLE_ELEMS))
+    ts = 0
+    for _ in range(n_ops):
+        roll = rng.random()
+        if roll < 0.35 and unput:
+            e = unput.pop(int(rng.integers(len(unput))))
+            ts += 1
+            state = ormap_put(state, jnp.uint32(e % n), jnp.uint32(e),
+                              jnp.uint32(rng.integers(1000)),
+                              jnp.uint32(ts))
+        elif roll < 0.55:
+            state = ormap_delete(state, jnp.uint32(rng.integers(n)),
+                                 jnp.uint32(rng.integers(_SAMPLE_ELEMS)))
+        else:
+            state = mix_rows(ormap_join, state, rng)
+    return state
+
+
+def _np_fields(state, names) -> Dict[str, np.ndarray]:
+    return {f: np.asarray(getattr(state, f)) for f in names}
+
+
+register_join(JoinSpec(
+    "gcounter", _sample_gcounter, gcounter_join,
+    lambda s: _np_fields(s, ("counts",))))
+register_join(JoinSpec(
+    "pncounter", _sample_pncounter, pncounter_join,
+    lambda s: _np_fields(s, ("p", "n"))))
+register_join(JoinSpec(
+    "twopset", _sample_twopset, twopset_join,
+    lambda s: _np_fields(s, ("added", "removed"))))
+register_join(JoinSpec(
+    "lwwmap", _sample_lwwmap, lwwmap_join,
+    lambda s: _np_fields(s, ("ts", "wr_actor", "val", "live"))))
+register_join(JoinSpec(
+    "mvregister", _sample_mvregister, mvregister_join,
+    lambda s: _np_fields(s, ("ctx", "live", "cnt", "val"))))
+register_join(JoinSpec(
+    "ormap", _sample_ormap, ormap_join,
+    # membership + cells; dot metadata excluded (AWSet overwrite quirk)
+    lambda s: _np_fields(s, ("vv", "present", "ts", "wr_actor", "val"))))

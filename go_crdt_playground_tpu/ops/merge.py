@@ -136,6 +136,56 @@ def merge_pairwise(dst: AWSetState, src: AWSetState,
 merge_pairwise_jit = jax.jit(merge_pairwise, static_argnames=("with_trace",))
 
 
+def _sample_awset(rng, n: int, n_ops: int) -> AWSetState:
+    """Reachable AWSet rows for the lattice-law gate: seeded random
+    adds/deletes plus gossip mixing through the merge itself.
+
+    Single-add-per-element ownership: a RE-add while a stale copy of the
+    element's earlier dot is still circulating exercises the reference's
+    unconditional stale-dot overwrite (awset.go:142, pinned in
+    tests/test_spec_conformance.py), which is order-sensitive by
+    documented design — the laws are promised over the single-dot
+    regime, the same one every soak workload (disjoint per-node element
+    ranges) runs in."""
+    from go_crdt_playground_tpu.models import awset
+    from go_crdt_playground_tpu.ops import lattices
+
+    n_elems = 8
+    state = awset.init(n, n_elems, n)
+    join = lambda d, s: merge_pairwise(d, s)[0]  # noqa: E731
+    unadded = list(range(n_elems))
+    for _ in range(n_ops):
+        roll = rng.random()
+        if roll < 0.35 and unadded:
+            e = unadded.pop(int(rng.integers(len(unadded))))
+            state = awset.add_element(state, jnp.uint32(e % n),
+                                     jnp.uint32(e))
+        elif roll < 0.55:
+            state = awset.del_element(state, jnp.uint32(rng.integers(n)),
+                                      jnp.uint32(rng.integers(n_elems)))
+        else:
+            state = lattices.mix_rows(join, state, rng)
+    return state
+
+
+def _register_awset_join() -> None:
+    import numpy as np
+
+    from go_crdt_playground_tpu.ops import lattices
+
+    lattices.register_join(lattices.JoinSpec(
+        "awset_merge", _sample_awset,
+        lambda d, s: merge_pairwise(d, s)[0],
+        # observable projection only: dot metadata is order-sensitive by
+        # documented design (stale-dot overwrite) — the same exclusion
+        # the crash soak's convergence digest makes
+        lambda s: {"vv": np.asarray(s.vv),
+                   "present": np.asarray(s.present)}))
+
+
+_register_awset_join()
+
+
 def merge_one_into(dst: AWSetState, r_dst, src: AWSetState, r_src,
                    with_trace: bool = False):
     """Scenario-style single merge: replica ``r_dst`` of ``dst`` absorbs

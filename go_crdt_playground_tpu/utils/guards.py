@@ -1,4 +1,6 @@
-"""uint32 counter overflow guards (SURVEY §5.2, §7.5.5).
+"""Runtime guards: uint32 overflow detection + single-install registry.
+
+Overflow guards (SURVEY §5.2, §7.5.5):
 
 The reference's clocks are Go ``uint`` — 64-bit (crdt-misc.go:9, 23) — so
 it can tick forever.  The packed tensors use uint32 (the north-star
@@ -11,9 +13,18 @@ they make clock exhaustion loud before it becomes wrong answers.
 ``overflow_risk`` is jit-safe (returns a device scalar) so long-running
 gossip loops can fold it into their per-round convergence fetch;
 ``check_headroom`` is the host-side wrapper that raises.
+
+Install guards: ``InstallGuard`` / the process-wide ``SHIM_GUARD`` make
+monkeypatch-style shims (the analysis race-detector's traced classes and
+wrapped locks, ``analysis/locksets.py``) loudly refuse double
+installation — two stacked shims silently corrupt each other's view, so
+the second ``install`` must raise, not wedge.
 """
 
 from __future__ import annotations
+
+import threading
+from typing import Dict, Hashable
 
 import jax.numpy as jnp
 
@@ -53,3 +64,52 @@ def check_headroom(state, margin: int = DEFAULT_MARGIN):
             "does not); repack with a wider dtype or retire the actor id."
         )
     return state
+
+
+# ---------------------------------------------------------------------------
+# shim install guard
+# ---------------------------------------------------------------------------
+
+
+class AlreadyInstalledError(RuntimeError):
+    """A shim was installed twice under the same key.  Stacked shims
+    (e.g. a race-detector tracing class wrapping another tracing class)
+    silently corrupt each other; the second install must fail fast."""
+
+
+class InstallGuard:
+    """Thread-safe once-only registry for monkeypatch-style shims.
+
+    ``install(key)`` claims the key or raises ``AlreadyInstalledError``;
+    ``uninstall(key)`` releases it (KeyError on a key never installed —
+    an unbalanced uninstall is a bug worth hearing about).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._installed: Dict[Hashable, str] = {}
+
+    def install(self, key: Hashable, owner: str = "") -> None:
+        with self._lock:
+            if key in self._installed:
+                prev = self._installed[key]
+                raise AlreadyInstalledError(
+                    f"shim {key!r} is already installed"
+                    + (f" (by {prev})" if prev else "")
+                    + "; uninstall the first shim before stacking another")
+            self._installed[key] = owner
+
+    def uninstall(self, key: Hashable) -> None:
+        with self._lock:
+            if key not in self._installed:
+                raise KeyError(
+                    f"shim {key!r} is not installed (unbalanced uninstall)")
+            del self._installed[key]
+
+    def installed(self, key: Hashable) -> bool:
+        with self._lock:
+            return key in self._installed
+
+
+# the process-wide registry the race detector uses
+SHIM_GUARD = InstallGuard()

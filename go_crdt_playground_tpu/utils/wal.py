@@ -113,18 +113,19 @@ class DeltaWal:
         self.fsync = fsync
         self.recorder = recorder
         self._lock = threading.Lock()
-        self._file = None
-        self._file_size = 0
+        self._file = None  # guarded-by: _lock
+        self._file_size = 0  # guarded-by: _lock
         # (seq, valid_end) of tears already counted by records() — a
         # re-scan of the same physical tear must not re-count it
-        self._post_open_tears: set = set()
+        self._post_open_tears: set = set()  # guarded-by: _lock
         os.makedirs(self.path, exist_ok=True)
+        # race-ok: written only by construction-time repair, then frozen
         self.torn_tail_repaired = False
         segs = self._segments()
         if segs:
             self._repair(segs)
             segs = self._segments()
-        self._seq = segs[-1] if segs else self._next_seq()
+        self._seq = segs[-1] if segs else self._next_seq()  # guarded-by: _lock
         self._open_segment(self._seq, fresh=not segs)
 
     # -- segment bookkeeping -----------------------------------------------
@@ -146,6 +147,7 @@ class DeltaWal:
         segs = self._segments()
         return (segs[-1] + 1) if segs else 1
 
+    # requires-lock: _lock
     def _open_segment(self, seq: int, fresh: bool) -> None:
         self._file = open(self._seg_path(seq), "ab")
         self._file_size = self._file.tell()
@@ -185,6 +187,7 @@ class DeltaWal:
 
     # -- write path ---------------------------------------------------------
 
+    # durable-on-return
     def append(self, body: bytes) -> None:
         """Durably append one record (see the fsync contract above)."""
         rec = encode_record(body)
@@ -202,6 +205,7 @@ class DeltaWal:
         self._count("wal.appends")
         self._count("wal.appended_bytes", len(rec))
 
+    # requires-lock: _lock
     def _rotate_locked(self) -> None:
         self._file.flush()
         if self.fsync:

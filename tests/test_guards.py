@@ -1,4 +1,7 @@
-"""uint32 overflow guards (SURVEY §5.2): clock-exhaustion detection."""
+"""utils/guards.py: uint32 overflow guards (SURVEY §5.2) + the shim
+single-install registry the race detector builds on."""
+
+import threading
 
 import pytest
 
@@ -38,3 +41,99 @@ def test_margin_boundary_exact():
     # headroom == margin: not yet at risk
     assert not bool(guards.overflow_risk(vv))
     assert bool(guards.overflow_risk(vv + jnp.uint32(1)))
+
+
+# -- error paths / misuse --------------------------------------------------
+
+
+def test_check_headroom_message_names_the_numbers():
+    state = awset.init(1, 4, 2)
+    vv = state.vv.at[0, 0].set(guards.UINT32_MAX - 3)
+    with pytest.raises(OverflowError) as ei:
+        guards.check_headroom(state._replace(vv=vv), margin=10)
+    msg = str(ei.value)
+    assert "3" in msg and "10" in msg, \
+        "the operator needs headroom and margin, not just 'overflow'"
+
+
+def test_check_headroom_zero_margin_never_raises():
+    state = awset.init(1, 4, 2)
+    vv = state.vv.at[0, 0].set(jnp.uint32(guards.UINT32_MAX))
+    # margin 0: even a saturated clock passes (headroom 0 >= 0) — the
+    # guard is strictly-less-than, so 0 disables it rather than making
+    # every state fatal
+    out = guards.check_headroom(state._replace(vv=vv), margin=0)
+    assert int(out.vv[0, 0]) == guards.UINT32_MAX
+
+
+def test_check_headroom_requires_vv_shaped_state():
+    with pytest.raises(AttributeError):
+        guards.check_headroom(object())
+
+
+# -- shim install guard ----------------------------------------------------
+
+
+def test_install_guard_claims_and_releases():
+    g = guards.InstallGuard()
+    g.install("k", owner="test")
+    assert g.installed("k")
+    g.uninstall("k")
+    assert not g.installed("k")
+    g.install("k")   # reinstall after release is legal
+    g.uninstall("k")
+
+
+def test_install_guard_double_install_raises_with_owner():
+    g = guards.InstallGuard()
+    g.install(("shim", 1), owner="first-owner")
+    with pytest.raises(guards.AlreadyInstalledError) as ei:
+        g.install(("shim", 1), owner="second")
+    assert "first-owner" in str(ei.value)
+
+
+def test_install_guard_unbalanced_uninstall_raises():
+    g = guards.InstallGuard()
+    with pytest.raises(KeyError):
+        g.uninstall("never-installed")
+
+
+def test_install_guard_is_thread_safe():
+    g = guards.InstallGuard()
+    wins, losses = [], []
+
+    def claim():
+        try:
+            g.install("contended")
+            wins.append(1)
+        except guards.AlreadyInstalledError:
+            losses.append(1)
+
+    ts = [threading.Thread(target=claim) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(wins) == 1 and len(losses) == 7, (wins, losses)
+
+
+def test_race_detector_shim_install_twice_raises_cleanly():
+    """The satellite contract: installing the race-detector shim twice
+    on one object must raise (AlreadyInstalledError), and the failed
+    second install must leave the first installation working."""
+    from go_crdt_playground_tpu.analysis.locksets import RaceDetector
+
+    class Obj:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.x = 0
+
+    det = RaceDetector()
+    obj = det.instrument(Obj())
+    try:
+        with pytest.raises(guards.AlreadyInstalledError):
+            det.instrument(obj)
+        obj.x = 1   # first shim still traces without blowing up
+        assert det.stats()["objects_traced"] == 1
+    finally:
+        det.uninstall(obj)

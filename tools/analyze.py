@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""Repo-root wrapper for the analysis gate (DESIGN.md §15).
+
+    python tools/analyze.py [--fast] [--out ANALYSIS_REPORT.json]
+
+Identical to ``python -m go_crdt_playground_tpu.analysis`` — this
+wrapper only adds the repo root to ``sys.path`` (the pattern the soak
+tools use) and defaults the report next to the other curve artifacts.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if __name__ == "__main__":
+    from go_crdt_playground_tpu.analysis.__main__ import main
+
+    argv = sys.argv[1:]
+    if not any(a.startswith("--out") for a in argv):
+        argv += ["--out", os.path.join(REPO, "ANALYSIS_REPORT.json")]
+    sys.exit(main(argv))

@@ -40,13 +40,18 @@ sys.path.insert(0, REPO)
 def run_scenario(n_nodes: int, n_elements: int, drop_rate: float,
                  truncate_rate: float, duplicate_rate: float, seed: int,
                  max_rounds: int,
-                 partition_rounds: Optional[Tuple[int, int]] = None
-                 ) -> Dict[str, object]:
+                 partition_rounds: Optional[Tuple[int, int]] = None,
+                 detect_races: bool = False) -> Dict[str, object]:
     """One seeded fleet run; returns rounds-to-convergence + fault census.
 
     ``partition_rounds=(a, b)`` asymmetrically partitions node 0 (its
     proxy refuses all inbound; it still dials out) from round a until
     round b, then heals.
+
+    ``detect_races=True`` runs the fleet under the Eraser-style lockset
+    detector (analysis/locksets.py): every Node and SyncSupervisor is
+    instrumented, and any shared write with an empty candidate lockset
+    lands in the returned ``races`` list (and fails the sweep).
     """
     from go_crdt_playground_tpu.net import Node, SyncSupervisor
     from go_crdt_playground_tpu.net.faults import ChaosScenario, fleet_proxies
@@ -57,6 +62,13 @@ def run_scenario(n_nodes: int, n_elements: int, drop_rate: float,
     nodes = [Node(i, n_elements, n_nodes, recorder=recorders[i],
                   conn_timeout_s=10.0, hello_timeout_s=0.5)
              for i in range(n_nodes)]
+    detector = None
+    if detect_races:
+        from go_crdt_playground_tpu.analysis.locksets import RaceDetector
+
+        detector = RaceDetector()
+        for i, n in enumerate(nodes):
+            detector.instrument(n, label=f"Node#{i}")
     supervisors: List[SyncSupervisor] = []
     proxies = []
     per_node = n_elements // n_nodes
@@ -75,12 +87,15 @@ def run_scenario(n_nodes: int, n_elements: int, drop_rate: float,
             # fanout 1: one partner per node per round — the socket
             # analogue of the tensor curve's one-partner-per-round
             # pairing, which is what makes the x-axes comparable
-            supervisors.append(SyncSupervisor(
+            sup = SyncSupervisor(
                 nodes[i], peer_addrs, policy=policy,
                 sync_timeout_s=1.0, hello_timeout_s=0.4,
                 breaker_threshold=2, breaker_cooldown_s=0.1,
                 fanout=1, interval_s=0.0,
-                recorder=recorders[i], seed=seed * 100 + i))
+                recorder=recorders[i], seed=seed * 100 + i)
+            if detector is not None:
+                detector.instrument(sup, label=f"SyncSupervisor#{i}")
+            supervisors.append(sup)
 
         expected = set(range(per_node * n_nodes))
 
@@ -122,8 +137,13 @@ def run_scenario(n_nodes: int, n_elements: int, drop_rate: float,
                     breaker[k] = breaker.get(k, 0) + v
                 elif k.startswith("sync.retries."):
                     retries += v
+        races = ([] if detector is None
+                 else [f.render() for f in detector.findings])
         return {"rounds": rounds, "converged": rounds is not None,
-                "faults": faults, "breaker": breaker, "retries": retries}
+                "faults": faults, "breaker": breaker, "retries": retries,
+                "races": races,
+                "race_detector": (None if detector is None
+                                  else detector.stats())}
     finally:
         for sup in supervisors:
             sup.stop(timeout=1.0)
@@ -131,6 +151,12 @@ def run_scenario(n_nodes: int, n_elements: int, drop_rate: float,
             p.close()
         for n in nodes:
             n.close()
+        if detector is not None:
+            for obj in supervisors + nodes:
+                try:
+                    detector.uninstall(obj)
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -141,6 +167,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--elements", type=int, default=None)
     ap.add_argument("--seeds", type=int, default=None)
     ap.add_argument("--max-rounds", type=int, default=60)
+    ap.add_argument("--detect-races", action="store_true",
+                    help="run the fleet under the lockset race detector "
+                         "(analysis/locksets.py); findings land in the "
+                         "curve artifact and fail the sweep")
     ap.add_argument("--out", default=os.path.join(REPO, "CHAOS_CURVE.json"))
     args = ap.parse_args(argv)
 
@@ -169,7 +199,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 drop_rate=sev, truncate_rate=sev / 2,
                 duplicate_rate=0.1 if sev > 0 else 0.0,
                 seed=11 + s, max_rounds=args.max_rounds,
-                partition_rounds=(0, 2) if sev > 0 else None))
+                partition_rounds=(0, 2) if sev > 0 else None,
+                detect_races=args.detect_races))
         rounds = [r["rounds"] for r in runs if r["converged"]]
         faults: Dict[str, int] = {}
         breaker: Dict[str, int] = {}
@@ -191,6 +222,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             "breaker_transitions": breaker,
             "retries": sum(r["retries"] for r in runs),
         }
+        if args.detect_races:
+            entry["races"] = sorted({race for r in runs
+                                     for race in r["races"]})
         curve.append(entry)
         print(json.dumps({"severity": sev, **{
             k: entry[k] for k in ("rounds_median", "converged_runs",
@@ -209,13 +243,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         "elapsed_s": round(time.time() - t0, 1),
         "platform": "cpu",
     }
+    if args.detect_races:
+        artifact["race_detection"] = {
+            "enabled": True,
+            "races": sorted({race for e in curve
+                             for race in e.get("races", [])}),
+        }
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=2)
         f.write("\n")
     print(f"wrote {args.out}")
-    # honest exit: a sweep where any severity failed to converge is a
-    # failure, not a curve
-    return 0 if all(e["converged_runs"] == e["seeds"] for e in curve) else 1
+    # honest exit: a sweep where any severity failed to converge — or,
+    # with detection on, any lockset race — is a failure, not a curve
+    ok = all(e["converged_runs"] == e["seeds"] for e in curve)
+    if args.detect_races:
+        ok = ok and not artifact["race_detection"]["races"]
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

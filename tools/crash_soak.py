@@ -18,10 +18,14 @@ The durability contract this soak adjudicates, per restarted process:
 * an ACKNOWLEDGED local add (recorded in ``progress.txt`` only AFTER the
   add's WAL append fsync'd) must survive restart — unless that
   incarnation's restore reports a torn WAL tail (the prefix rule: the
-  whole suffix at/after the first tear is discarded) or a
+  whole suffix at/after the first tear is discarded), a
   checkpoint-generation fallback (the documented regression window,
-  healed by anti-entropy).  Loss with NEITHER window open is delta loss
-  and fails the run.
+  healed by anti-entropy), or a causally-refused replay suffix
+  (``wal.future_records``: the guard-vv check discards records whose
+  base is gone and arms the forced-FULL resync epoch).  Loss with NO
+  window open is delta loss and fails the run — and the violating
+  incarnation's full status + directory listing is preserved in the
+  artifact (``violation_reports``) for the post-mortem.
 * a corrupt newest checkpoint must NEVER abort recovery: restore falls
   back to generation K-1 (counted in ``restore.fallbacks``) and the run
   must still converge.
@@ -107,7 +111,7 @@ def _rewrite_progress(path: str, acked: set) -> None:
 
 
 def _write_status(dirpath: str, node, rec, rounds: int,
-                  lost_acks: int) -> None:
+                  lost_acks: int, detector=None) -> None:
     from go_crdt_playground_tpu.models.digest import array_digest
 
     state = node.state_slice()
@@ -127,6 +131,8 @@ def _write_status(dirpath: str, node, rec, rounds: int,
         "generation": node.generation,
         "counters": {k: v for k, v in snap["counters"].items()
                      if k.startswith(_COUNTER_PREFIXES)},
+        "races": ([] if detector is None
+                  else [f.render() for f in detector.findings]),
     }
     tmp = os.path.join(dirpath, ".status-tmp")
     with open(tmp, "w") as f:
@@ -149,6 +155,17 @@ def worker_main(args: argparse.Namespace) -> int:
         fallback_init=lambda: Node(
             args.actor, args.elements, args.nodes, recorder=rec,
             conn_timeout_s=10.0, hello_timeout_s=0.5))
+    detector = None
+    if args.detect_races:
+        # Eraser-style lockset tracking on this incarnation's Node
+        # (instrumented BEFORE serve() so the accept-loop and handler
+        # threads are traced from their first access); the WAL is
+        # instrumented after the supervisor attaches it, below —
+        # fallback_init incarnations have none until then
+        from go_crdt_playground_tpu.analysis.locksets import RaceDetector
+
+        detector = RaceDetector()
+        detector.instrument(node, label=f"Node#{args.actor}")
     node.serve("127.0.0.1", args.port)
     peers = [("127.0.0.1", int(p))
              for p in args.peer_ports.split(",") if p]
@@ -160,6 +177,14 @@ def worker_main(args: argparse.Namespace) -> int:
         fanout=1, interval_s=0.0,
         durable_dir=d, checkpoint_every=args.checkpoint_every,
         recorder=rec, seed=args.seed)
+    if detector is not None:
+        detector.instrument(sup, label=f"SyncSupervisor#{args.actor}")
+        # by now the WAL exists on EVERY path: restore_durable attached
+        # one, or SyncSupervisor(durable_dir=...) just did (the
+        # fallback_init case — exactly the post-crash incarnations this
+        # soak stresses, which must not lose WAL race coverage)
+        if node.wal is not None:
+            detector.instrument(node.wal, label=f"DeltaWal#{args.actor}")
 
     # the zero-delta-loss ledger: an element is recorded here only AFTER
     # node.add returned, i.e. after its δ hit the WAL's fsync
@@ -180,7 +205,7 @@ def worker_main(args: argparse.Namespace) -> int:
     # first status goes out BEFORE any round so the restore counters
     # (wal.records / wal.torn_tail / restore.fallbacks) and lost_acks of
     # this incarnation are published even if it is killed immediately
-    _write_status(d, node, rec, rounds, len(lost))
+    _write_status(d, node, rec, rounds, len(lost), detector)
 
     stopping = []
     signal.signal(signal.SIGTERM, lambda *_: stopping.append(True))
@@ -194,7 +219,7 @@ def worker_main(args: argparse.Namespace) -> int:
             _append_progress(progress, e)
         sup.sync_round()
         rounds += 1
-        _write_status(d, node, rec, rounds, len(lost))
+        _write_status(d, node, rec, rounds, len(lost), detector)
         time.sleep(args.tick_s)
     node.close()
     return 0
@@ -218,13 +243,15 @@ class _Fleet:
     """Spawns, kills, corrupts, restarts, and reads the worker fleet."""
 
     def __init__(self, n_nodes: int, n_elements: int, root: str,
-                 seed: int, checkpoint_every: int, worker_tick_s: float):
+                 seed: int, checkpoint_every: int, worker_tick_s: float,
+                 detect_races: bool = False):
         self.n = n_nodes
         self.elements = n_elements
         self.root = root
         self.seed = seed
         self.checkpoint_every = checkpoint_every
         self.worker_tick_s = worker_tick_s
+        self.detect_races = detect_races
         self.dirs = [os.path.join(root, f"node-{i}") for i in range(n_nodes)]
         self.ports = [_free_port() for _ in range(n_nodes)]
         self.procs: List[Optional[subprocess.Popen]] = [None] * n_nodes
@@ -245,6 +272,8 @@ class _Fleet:
                "--checkpoint-every", str(self.checkpoint_every),
                "--seed", str(self.seed * 100 + i),
                "--tick-s", str(self.worker_tick_s)]
+        if self.detect_races:
+            cmd.append("--detect-races")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         log = open(os.path.join(self.dirs[i], "worker.log"), "ab")
         self.logs.append(log)
@@ -321,6 +350,7 @@ def run_scenario(n_nodes: int, n_elements: int, kill_rate: float,
                  worker_tick_s: float = 0.05,
                  torn_writes: bool = True,
                  corrupt_checkpoint: bool = True,
+                 detect_races: bool = False,
                  root_dir: Optional[str] = None) -> Dict[str, object]:
     """One seeded crash-soak run; returns convergence + census.
 
@@ -340,12 +370,14 @@ def run_scenario(n_nodes: int, n_elements: int, kill_rate: float,
                         zero_fill_rate=0.15 if kill_rate > 0 else 0.0),
         seed=seed)
     fleet = _Fleet(n_nodes, n_elements, root, seed, checkpoint_every,
-                   worker_tick_s)
+                   worker_tick_s, detect_races=detect_races)
     per = n_elements // n_nodes
     expected = list(range(per * n_nodes))
     kills = 0
     corruption_injected = False
     delta_loss_violations = 0
+    violation_reports: List[Dict] = []   # full status of each violator
+    races: set = set()   # lockset-detector findings across incarnations
     adjudicated: set = set()   # (actor, pid) incarnations already judged
     counters_by_inc: Dict = {}  # (actor, pid) -> latest counters snapshot
     converged_tick = None
@@ -361,6 +393,7 @@ def run_scenario(n_nodes: int, n_elements: int, kill_rate: float,
                 continue
             inc = (st["actor"], st["pid"])
             counters_by_inc[inc] = st["counters"]
+            races.update(st.get("races") or [])
             if inc not in adjudicated:
                 adjudicated.add(inc)
                 c = st["counters"]
@@ -370,11 +403,24 @@ def run_scenario(n_nodes: int, n_elements: int, kill_rate: float,
                 bad = c.get("wal.bad_records", 0)
                 # the zero-delta-loss contract: acknowledged adds
                 # survive restart except inside the documented windows —
-                # the discarded suffix after a WAL tear, or a checkpoint
-                # generation fallback.  Loss with neither window open is
-                # a violation.
-                if lost > 0 and fallbacks == 0 and torn == 0 and bad == 0:
+                # the discarded suffix after a WAL tear, a checkpoint
+                # generation fallback, or a causally-refused replay
+                # suffix (wal.future_records; restore resets the log and
+                # arms the forced-FULL resync epoch).  Loss with no
+                # window open is a violation — and the violator's whole
+                # status plus its directory listing is preserved in the
+                # artifact, because a one-line counter is useless for
+                # the post-mortem of a once-in-many-sweeps event.
+                future = c.get("wal.future_records", 0)
+                if lost > 0 and fallbacks == 0 and torn == 0 \
+                        and bad == 0 and future == 0:
                     delta_loss_violations += 1
+                    try:
+                        listing = sorted(os.listdir(fleet.dirs[i]))
+                    except OSError:
+                        listing = []
+                    violation_reports.append(
+                        {"status": st, "dir": listing})
         return out
 
     def corrupt_victim(i: int) -> None:
@@ -470,8 +516,11 @@ def run_scenario(n_nodes: int, n_elements: int, kill_rate: float,
         "unexpected_exits": fleet.unexpected_exits,
         "storage_faults": faults.counters(),
         "counters": totals,
+        "races": sorted(races),
         "elapsed_s": round(time.time() - t0, 1),
     }
+    if violation_reports:
+        result["violation_reports"] = violation_reports
     if final_statuses is not None:
         result["final_statuses"] = final_statuses
     if owns_root:
@@ -494,6 +543,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--elements", type=int, default=None)
     ap.add_argument("--seeds", type=int, default=None)
     ap.add_argument("--max-ticks", type=int, default=None)
+    ap.add_argument("--detect-races", dest="detect_races",
+                    action="store_true",
+                    help="run every worker under the lockset race "
+                         "detector (analysis/locksets.py); findings land "
+                         "in CRASH_CURVE.json and fail the sweep")
     ap.add_argument("--out", default=os.path.join(REPO, "CRASH_CURVE.json"))
     # worker-mode flags (the parent spawns `crash_soak.py --worker ...`)
     ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
@@ -533,7 +587,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             r = run_scenario(
                 n_nodes, n_elements, rate, seed=23 + s,
                 kill_ticks=kill_ticks if rate > 0 else 0,
-                max_ticks=max_ticks)
+                max_ticks=max_ticks, detect_races=args.detect_races)
             runs.append(r)
             print(json.dumps({"kill_rate": rate, "seed": 23 + s, **{
                 k: r[k] for k in ("converged", "recovery_rounds", "kills",
@@ -563,6 +617,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             "storage_faults": storage,
             "restore_counters": {k: v for k, v in counters.items()
                                  if k.startswith(("restore.", "wal."))},
+            **({"races": sorted({x for r in runs for x in r["races"]})}
+               if args.detect_races else {}),
+            **({"violation_reports": [v for r in runs for v in
+                                      r.get("violation_reports", [])]}
+               if any(r.get("violation_reports") for r in runs) else {}),
         })
 
     artifact = {
@@ -579,16 +638,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         "elapsed_s": round(time.time() - t0, 1),
         "platform": "cpu",
     }
+    if args.detect_races:
+        artifact["race_detection"] = {
+            "enabled": True,
+            "races": sorted({x for e in curve
+                             for x in e.get("races", [])}),
+        }
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=2)
         f.write("\n")
     print(f"wrote {args.out}")
 
     # honest exit: every run converged, zero delta loss beyond the
-    # documented windows, and the faulted runs actually exercised the
-    # fallback path (corrupt newest checkpoint -> generation K-1)
+    # documented windows, the faulted runs actually exercised the
+    # fallback path (corrupt newest checkpoint -> generation K-1), and —
+    # with detection on — the lockset detector stayed silent
     ok = all(e["converged_runs"] == e["seeds"] for e in curve)
     ok = ok and all(e["delta_loss_violations"] == 0 for e in curve)
+    if args.detect_races:
+        ok = ok and not artifact["race_detection"]["races"]
     faulted = [e for e in curve if e["kill_rate"] > 0]
     ok = ok and all(e["kills"] > 0 for e in faulted)
     ok = ok and any(
