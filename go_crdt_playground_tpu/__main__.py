@@ -12,6 +12,12 @@ a fleet-scale taste:
                                               # rounds + digest printed
   python -m go_crdt_playground_tpu serve      # Merger bridge service on
                                               # a TCP port (ctrl-C stops)
+  python -m go_crdt_playground_tpu serve --ingest --durable-dir D
+                                              # op-ingest frontend: micro-
+                                              # batched client add/del ops,
+                                              # durable acks, SLO metrics
+                                              # (DESIGN.md §16; SIGTERM/
+                                              # ctrl-C drains gracefully)
 """
 
 from __future__ import annotations
@@ -103,6 +109,42 @@ def _cmd_serve(port: int) -> int:
         return 0
 
 
+def _cmd_serve_ingest(args) -> int:
+    """The op-ingest frontend as a process: serve client ops until
+    SIGTERM/SIGINT, then DRAIN (stop accepting, flush+ack the admitted
+    ops, final durable checkpoint) — the graceful half of the serving
+    ladder; the crash half is the serve soak's SIGKILL."""
+    import signal
+    import threading
+
+    from go_crdt_playground_tpu.serve import ServeFrontend
+
+    fe = ServeFrontend(
+        args.elements, args.actors, actor=args.actor,
+        durable_dir=args.durable_dir, peers=args.peer,
+        queue_depth=args.queue_depth, max_batch=args.max_batch,
+        flush_ms=args.flush_ms, checkpoint_every=args.checkpoint_every)
+    host, bound = fe.serve(port=args.port, peer_port=args.peer_port)
+    print(f"Op-ingest frontend listening on {host}:{bound} "
+          f"(E={args.elements} A={args.actors} actor={args.actor} "
+          f"batch<={args.max_batch} flush={args.flush_ms}ms "
+          f"queue={args.queue_depth} "
+          f"durable={'yes' if args.durable_dir else 'NO'})", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    fe.close()
+    snap = fe.recorder.snapshot()
+    acked = snap["counters"].get("serve.ops.acked", 0)
+    lat = snap["observations"].get("serve.ingest_latency_s")
+    p99 = f"{lat['p99'] * 1e3:.2f}ms" if lat else "n/a"
+    print(f"drained: {acked} ops acked, ingest p99 {p99}", flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="go_crdt_playground_tpu")
     p.add_argument("--platform", default="auto",
@@ -136,6 +178,45 @@ def main(argv=None) -> int:
                    help="anti-entropy pairing schedule per round")
     s = sub.add_parser("serve")
     s.add_argument("--port", type=int, default=0)
+    s.add_argument("--ingest", action="store_true",
+                   help="run the op-ingest frontend (serve/, DESIGN.md "
+                        "§16) instead of the Merger bridge")
+    s.add_argument("--elements", type=int, default=1024,
+                   help="element universe E of the served replica")
+    s.add_argument("--actors", type=int, default=16,
+                   help="actor axis A of the served replica")
+    s.add_argument("--actor", type=int, default=0,
+                   help="this replica's actor id")
+    s.add_argument("--durable-dir", dest="durable_dir", default=None,
+                   help="checkpoint+WAL directory: acks become durable "
+                        "(fsync-before-ack); omitted = NON-durable "
+                        "(benchmarks only)")
+    def _peer_addr(text: str):
+        host, _, port = text.rpartition(":")
+        if not host or not port.isdigit():
+            raise argparse.ArgumentTypeError(
+                f"peer must be HOST:PORT, got {text!r}")
+        return host, int(port)
+
+    s.add_argument("--peer", action="append", default=[], type=_peer_addr,
+                   metavar="HOST:PORT",
+                   help="anti-entropy peer to disseminate merged state "
+                        "to (repeatable)")
+    s.add_argument("--peer-port", dest="peer_port", type=int, default=None,
+                   help="also serve anti-entropy exchanges on this port")
+    s.add_argument("--max-batch", dest="max_batch", type=int, default=32,
+                   help="micro-batch size watermark (ops per packed "
+                        "apply)")
+    s.add_argument("--flush-ms", dest="flush_ms", type=float, default=2.0,
+                   help="micro-batch time watermark")
+    s.add_argument("--queue-depth", dest="queue_depth", type=int,
+                   default=256,
+                   help="admission limit: beyond it ops shed with a "
+                        "typed Overloaded reply")
+    s.add_argument("--checkpoint-every", dest="checkpoint_every", type=int,
+                   default=50,
+                   help="durable checkpoint cadence in supervisor rounds "
+                        "(0 = only the final drain checkpoint)")
     args = p.parse_args(argv)
     if args.platform != "auto":
         import jax
@@ -153,6 +234,8 @@ def main(argv=None) -> int:
                            drop_rate=args.drop_rate, seed=args.seed,
                            schedule=args.schedule)
     if args.cmd == "serve":
+        if args.ingest:
+            return _cmd_serve_ingest(args)
         return _cmd_serve(args.port)
     return 2
 

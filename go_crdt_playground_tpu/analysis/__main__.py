@@ -35,20 +35,28 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# pass targets, package-relative (DESIGN.md §15 pass catalog)
-LOCK_TARGETS = ["net/peer.py", "net/antientropy.py", "utils/wal.py"]
+# pass targets, package-relative (DESIGN.md §15 pass catalog; the
+# serve/ files are the PR-5 serving frontend — its admission queue,
+# session writers, batcher, and client are all multi-threaded shared
+# state, so the guarded-by sweep covers them like the sync runtime)
+LOCK_TARGETS = ["net/peer.py", "net/antientropy.py", "utils/wal.py",
+                "serve/admission.py", "serve/session.py",
+                "serve/batcher.py", "serve/frontend.py",
+                "serve/client.py", "obs/metrics.py"]
 # extra files that participate in the lock-ORDER graph (their locks can
 # nest under the runtime's)
-LOCK_ORDER_EXTRA = ["utils/checkpoint.py", "obs/metrics.py"]
+LOCK_ORDER_EXTRA = ["utils/checkpoint.py"]
 DURABILITY_TARGETS = ["utils/wal.py", "utils/checkpoint.py",
                       "utils/checkpoint_sharded.py", "utils/fsutil.py"]
 PURITY_TARGETS = ["ops/merge.py", "ops/delta.py", "ops/lattices.py",
                   "ops/vv.py", "ops/compact.py", "ops/pallas_merge.py",
-                  "ops/pallas_delta.py"]
+                  "ops/pallas_delta.py", "ops/ingest.py"]
 # attribute-name -> class hints for cross-class lock-order edges
 ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "recorder": "Recorder", "_store": "CheckpointStore",
-                "breaker": "CircuitBreaker"}
+                "breaker": "CircuitBreaker", "queue": "AdmissionQueue",
+                "session": "Session", "batcher": "MicroBatcher",
+                "supervisor": "SyncSupervisor"}
 
 
 def _paths(rel: List[str], root: str) -> List[str]:

@@ -457,6 +457,7 @@ class LockLint:
     def stats(self) -> Dict:
         return {
             "classes": len(self.models),
+            "classes_by_name": sorted(self.models),
             "locks": sorted(self._lock_names),
             "guarded_fields": sum(len(m.guarded)
                                   for m in self.models.values()),

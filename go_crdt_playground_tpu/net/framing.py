@@ -120,29 +120,32 @@ def send_frame(sock: socket.socket, msg_type: int, body: bytes) -> int:
     return len(data)
 
 
-def recv_frame(sock: socket.socket,
-               timeout: Optional[float] = None) -> Tuple[int, bytes]:
+def recv_frame(sock: socket.socket, timeout: Optional[float] = None,
+               max_body: int = _MAX_BODY) -> Tuple[int, bytes]:
     """Receive one frame.  ``timeout`` bounds the WHOLE frame (absolute
     deadline semantics), not each recv, and the socket's own timeout
     configuration is restored afterwards; on None it applies per recv
-    as usual."""
+    as usual.  ``max_body`` caps the declared body size BEFORE any body
+    byte is buffered — the default fits peer FULL-state payloads;
+    dialects facing untrusted clients (serve/) pass a far smaller cap
+    so a hostile length header cannot balloon per-connection memory."""
     if timeout is None:
-        return _recv_frame(sock, None)
+        return _recv_frame(sock, None, max_body)
     saved = sock.gettimeout()
     try:
-        return _recv_frame(sock, time.monotonic() + timeout)
+        return _recv_frame(sock, time.monotonic() + timeout, max_body)
     finally:
         sock.settimeout(saved)
 
 
-def _recv_frame(sock: socket.socket,
-                deadline: Optional[float]) -> Tuple[int, bytes]:
+def _recv_frame(sock: socket.socket, deadline: Optional[float],
+                max_body: int = _MAX_BODY) -> Tuple[int, bytes]:
     magic = _recv_exact(sock, 2, deadline)
     if magic != MAGIC:
         raise ProtocolError(f"bad magic {magic!r}")
     msg_type = _recv_exact(sock, 1, deadline)[0]
     n = _recv_varint(sock, deadline)
-    if n > _MAX_BODY:
+    if n > min(max_body, _MAX_BODY):
         raise ProtocolError(f"oversized frame ({n} bytes)")
     body = _recv_exact(sock, n, deadline)
     if msg_type == MSG_ERROR:

@@ -239,6 +239,45 @@ class Node:
             if pre_vv is not None:
                 self._log_local_delta(pre_vv)
 
+    def ingest_batch(self, add_rows: np.ndarray, del_rows: np.ndarray,
+                     live: Optional[np.ndarray] = None) -> None:
+        """Apply one packed ``(B, E)`` micro-batch of client op-rows in a
+        single compiled dispatch (ops/ingest.ingest_rows: row b's add
+        selector is one Add(k...) call, its del selector one Del(k...)
+        call, ``live`` masks padding rows), WAL-logging the batch's
+        resulting δ BEFORE returning — the group-commit durability point
+        the serve frontend acks against: one fsync covers the whole
+        batch (DESIGN.md §16)."""
+        import jax
+        import jax.numpy as jnp
+
+        from go_crdt_playground_tpu.ops import ingest as ingest_ops
+
+        add_rows = np.asarray(add_rows, bool)
+        del_rows = np.asarray(del_rows, bool)
+        if add_rows.shape != del_rows.shape or add_rows.ndim != 2 \
+                or add_rows.shape[1] != self.num_elements:
+            raise ValueError(
+                f"op-batch shape {add_rows.shape}/{del_rows.shape} does "
+                f"not match (B, {self.num_elements})")
+        if live is None:
+            live = np.ones(add_rows.shape[0], bool)
+        live = np.asarray(live, bool)
+        if live.shape != (add_rows.shape[0],):
+            raise ValueError(f"live mask shape {live.shape} does not "
+                             f"match batch axis {add_rows.shape[0]}")
+        with self._lock:
+            pre_vv = (np.asarray(self._state.vv[0]).copy()
+                      if self.wal is not None else None)
+            row = jax.tree.map(lambda x: x[0], self._state)
+            merged = ingest_ops.ingest_rows(
+                row, jnp.asarray(add_rows), jnp.asarray(del_rows),
+                jnp.asarray(live))
+            self._state = jax.tree.map(
+                lambda full, r: full.at[0].set(r), self._state, merged)
+            if pre_vv is not None:
+                self._log_local_delta(pre_vv)
+
     def members(self) -> np.ndarray:
         """Sorted live element ids (SortedValues, awset.go:61-70, on ids)."""
         with self._lock:
@@ -516,7 +555,11 @@ class Node:
                         # compression vs the client's advertised VV
                         # filters what it has.
                         reply_mode, reply = self._extract_msg(peer_vv)
-                except ProtocolError as e:
+                except (ProtocolError, ValueError) as e:
+                    # ValueError: apply hit a closed/refusing WAL (a
+                    # teardown race) — the peer gets a clean error frame
+                    # and retries next round, not a torn connection from
+                    # a dead handler thread
                     framing.send_frame(conn, framing.MSG_ERROR,
                                        str(e).encode())
                     return

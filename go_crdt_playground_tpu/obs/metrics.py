@@ -10,21 +10,51 @@ payload-size helpers for δ payloads.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict
+from typing import Dict, List
+
+# Bounded log-spaced histogram backing observe()/percentile().  Bucket i
+# covers (BASE·G^(i-1), BASE·G^i]; index 0 is the underflow bucket
+# (values <= BASE, incl. zero/negatives) and the last bucket absorbs
+# overflow.  With BASE=1µs and G=√2, 64 buckets span ~1e-6..4.3e3 —
+# microsecond kernel dispatches through hour-long soaks — at a worst-case
+# relative quantile error of √2, and the whole histogram is one fixed
+# 64-int list per stream (bounded memory however long the stream runs).
+_HIST_BASE = 1e-6
+_HIST_GROWTH = math.sqrt(2.0)
+_HIST_BUCKETS = 64
+_LOG_GROWTH = math.log(_HIST_GROWTH)
+
+
+def _bucket_index(value: float) -> int:
+    if value <= _HIST_BASE:
+        return 0
+    i = 1 + int(math.floor(math.log(value / _HIST_BASE) / _LOG_GROWTH))
+    return min(i, _HIST_BUCKETS - 1)
+
+
+def _bucket_upper(index: int) -> float:
+    return _HIST_BASE * (_HIST_GROWTH ** index)
 
 
 class Recorder:
     """Thread-safe counters, value observations, and wall-clock timers.
 
-    count():     monotonically increasing totals (merges, rounds, bytes).
-    observe():   value streams summarized as n/sum/min/max.
-    time():      context manager feeding observe() with elapsed seconds.
-    set_gauge(): last-write-wins point-in-time values (e.g. the per-peer
-                 circuit-breaker state the sync supervisor exports:
-                 0=closed, 1=open, 2=half_open — net/antientropy.py).
+    count():      monotonically increasing totals (merges, rounds, bytes).
+    observe():    value streams summarized as n/sum/min/max PLUS a bounded
+                  log-spaced histogram (fixed buckets, so memory never
+                  grows with the stream).
+    percentile(): quantile estimate from the histogram (worst-case √2
+                  relative error, clamped to the exact observed min/max);
+                  snapshot() reports p50/p95/p99 per stream — the serve
+                  frontend's SLO numbers (DESIGN.md §16) ride these.
+    time():       context manager feeding observe() with elapsed seconds.
+    set_gauge():  last-write-wins point-in-time values (e.g. the per-peer
+                  circuit-breaker state the sync supervisor exports:
+                  0=closed, 1=open, 2=half_open — net/antientropy.py).
 
     Durability-layer names (the crash-recovery contract, DESIGN.md §14
     "Durability ladder"): counters ``wal.appends`` / ``wal.appended_bytes``
@@ -43,6 +73,7 @@ class Recorder:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._observations: Dict[str, Dict[str, float]] = {}
+        self._histograms: Dict[str, List[int]] = {}  # guarded-by: _lock
         self._gauges: Dict[str, float] = {}
 
     def count(self, name: str, n: int = 1) -> None:
@@ -64,11 +95,42 @@ class Recorder:
                     "n": 1, "sum": float(value),
                     "min": float(value), "max": float(value),
                 }
+                self._histograms[name] = [0] * _HIST_BUCKETS
             else:
                 o["n"] += 1
                 o["sum"] += float(value)
                 o["min"] = min(o["min"], float(value))
                 o["max"] = max(o["max"], float(value))
+            self._histograms[name][_bucket_index(float(value))] += 1
+
+    # requires-lock: _lock
+    def _percentile_locked(self, name: str, q: float) -> float:
+        """Caller holds the lock.  Smallest bucket upper bound covering
+        the q-quantile rank, clamped to the exact observed [min, max] —
+        a stream of identical values reports that value exactly, and no
+        estimate can leave the observed range."""
+        o = self._observations[name]
+        hist = self._histograms[name]
+        rank = max(1, math.ceil(q * o["n"]))
+        cum = 0
+        for i, c in enumerate(hist):
+            cum += c
+            if cum >= rank:
+                if i == _HIST_BUCKETS - 1:
+                    return o["max"]  # overflow bucket: nominal upper lies
+                return min(max(_bucket_upper(i), o["min"]), o["max"])
+        return o["max"]  # unreachable: buckets always sum to n
+
+    def percentile(self, name: str, q: float) -> float:
+        """Estimate the q-quantile (q in [0, 1]) of an observed stream
+        from its bounded histogram.  Raises KeyError for a stream never
+        observed — "no data" must not read as "zero latency"."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            if name not in self._observations:
+                raise KeyError(f"no observations for {name!r}")
+            return self._percentile_locked(name, q)
 
     @contextmanager
     def time(self, name: str):
@@ -86,10 +148,14 @@ class Recorder:
 
     def snapshot(self) -> Dict[str, object]:
         """Point-in-time copy: {"counters": {...}, "observations": {...},
-        "gauges": {...}} with per-stream mean added."""
+        "gauges": {...}} with per-stream mean and histogram-derived
+        p50/p95/p99 added."""
         with self._lock:
             obs = {
-                name: {**o, "mean": o["sum"] / o["n"]}
+                name: {**o, "mean": o["sum"] / o["n"],
+                       "p50": self._percentile_locked(name, 0.50),
+                       "p95": self._percentile_locked(name, 0.95),
+                       "p99": self._percentile_locked(name, 0.99)}
                 for name, o in self._observations.items()
             }
             return {"counters": dict(self._counters), "observations": obs,

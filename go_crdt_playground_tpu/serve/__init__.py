@@ -1,0 +1,22 @@
+"""Op-ingest serving frontend (DESIGN.md §16 "Serving ladder").
+
+The client→replica hot path: a TCP frontend accepts add/del ops against
+a keyed AWSet replica, micro-batches them into packed ``(B, E)`` tensor
+applies through the merge kernels, WAL-fsyncs the batch δ before acking
+(group commit), and hands the merged state to the existing anti-entropy
+runtime for dissemination.  Admission is bounded and sheds with typed
+``Overloaded`` replies; shutdown is a graceful drain; SLO numbers
+(p50/p95/p99 ingest latency, batch occupancy, queue depth) flow through
+``obs.Recorder``.
+"""
+
+from go_crdt_playground_tpu.serve.admission import (AdmissionQueue,  # noqa: F401
+                                                    OpRequest)
+from go_crdt_playground_tpu.serve.batcher import MicroBatcher  # noqa: F401
+from go_crdt_playground_tpu.serve.client import (PendingOp,  # noqa: F401
+                                                 ServeClient)
+from go_crdt_playground_tpu.serve.frontend import ServeFrontend  # noqa: F401
+from go_crdt_playground_tpu.serve.protocol import (DeadlineExceeded,  # noqa: F401
+                                                   Draining, InvalidOp,
+                                                   Overloaded, ServeError)
+from go_crdt_playground_tpu.serve.session import Session  # noqa: F401

@@ -1,0 +1,219 @@
+"""Continuous micro-batcher: admission queue → one packed apply → acks.
+
+The serving core (inference-serving shape): a single batcher thread
+drains the admission queue on time/size watermarks
+(``AdmissionQueue.take_batch``), coalesces the drained ops into one
+packed ``(B, E)`` tensor pair, applies them with ONE compiled dispatch
++ ONE WAL fsync (``Node.ingest_batch`` — the group commit), and only
+then acks each op.  Under load the fsync and dispatch costs amortize
+over whole batches; idle, a lone op pays at most the flush watermark.
+
+Deadline propagation happens at BUILD time: an op whose absolute
+deadline passed while queued is shed with a typed ``REJECT_EXPIRED``
+and never applied — late effects are worse than honest rejection for a
+client that already timed out (it will retry idempotently).
+
+SLO accounting (obs.Recorder; names are the DESIGN.md §16 contract):
+counters ``serve.ops.acked`` / ``serve.shed.expired`` /
+``serve.batches`` / ``serve.ack_send_failures``; observations
+``serve.ingest_latency_s`` (admission→ack, histogram-backed p50/p95/
+p99), ``serve.batch.occupancy`` (live ops per applied batch) and
+``serve.batch.apply_s``; gauge ``serve.queue.depth``.
+
+Crash-window test hook: ``CRDT_SERVE_CRASH_AFTER_BATCHES=<n>`` SIGKILLs
+the PROCESS right after the n-th batch's WAL fsync returns and BEFORE
+any of its acks are sent — the exact between-append-and-ack window the
+serve soak's crash leg adjudicates (acked ops must survive restart;
+ops caught in the window were never acked, so the client re-submits
+idempotently).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from go_crdt_playground_tpu.serve import protocol
+from go_crdt_playground_tpu.serve.admission import AdmissionQueue, OpRequest
+
+_CRASH_ENV = "CRDT_SERVE_CRASH_AFTER_BATCHES"
+
+
+class MicroBatcher:
+    """One thread turning queued ops into packed durable batches."""
+
+    def __init__(self, node, queue: AdmissionQueue, *,
+                 max_batch: int = 32, flush_s: float = 0.002,
+                 idle_wait_s: float = 0.05, recorder=None,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.node = node
+        self.queue = queue
+        self.max_batch = max_batch
+        self.flush_s = flush_s
+        self.idle_wait_s = idle_wait_s
+        self.recorder = recorder
+        self._clock = clock
+        self._stop = threading.Event()
+        # race-ok: start()/stop() owner thread only
+        self._thread: Optional[threading.Thread] = None
+        # race-ok: post-mortem breadcrumb (loop thread writes, a
+        # post-stop reader inspects); no control flow depends on it
+        self.last_error: Optional[BaseException] = None
+        # race-ok: loop-thread-only batch counter driving the SIGKILL
+        # test hook (None = hook disabled)
+        self._crash_after: Optional[int] = None
+        raw = os.environ.get(_CRASH_ENV)
+        if raw:
+            try:
+                n = int(raw)
+            except ValueError:
+                n = 0  # malformed value: hook stays off, never aborts
+            if n > 0:  # "0" means disabled, like an unset var
+                self._crash_after = n
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("batcher already running")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"serve-batcher-{self.node.actor}",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop WITHOUT draining (crash-shaped teardown for tests);
+        graceful shutdown is ``drain()``."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Graceful flush: close the queue to new offers, let the loop
+        apply+ack everything already admitted, then stop the thread.
+        Every admitted op is either acked or (deadline passed while
+        draining) typed-rejected by the time this returns."""
+        self.queue.close()
+        t = self._thread
+        if t is None or not t.is_alive():
+            self._flush_remaining()
+            return
+        deadline = self._clock() + timeout
+        while self.queue.depth() > 0 and self._clock() < deadline:
+            time.sleep(0.005)
+        self._stop.set()
+        t.join(timeout=max(0.1, deadline - self._clock()))
+        self._flush_remaining()
+
+    def _flush_remaining(self) -> None:
+        """Post-stop sweep: anything still queued (loop died, or drain
+        raced the stop flag) is applied inline so no admitted op is ever
+        silently dropped."""
+        while True:
+            batch = self.queue.take_batch(self.max_batch, 0.0, 0.0)
+            if not batch:
+                return
+            self._apply(batch)
+
+    # -- the loop -----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self.queue.take_batch(
+                self.max_batch, self.idle_wait_s, self.flush_s)
+            if self.recorder is not None:
+                self.recorder.set_gauge("serve.queue.depth",
+                                        self.queue.depth())
+            if not batch:
+                if self.queue.closed and self.queue.depth() == 0:
+                    return  # drained
+                continue
+            try:
+                self._apply(batch)
+            except Exception as e:  # noqa: BLE001 — last resort: the
+                # apply path has its own poison-batch handling inside
+                # _apply; anything escaping here is a reply-path bug,
+                # and the serving loop must still survive it
+                self.last_error = e
+                self._count("serve.batch_errors")
+
+    def _apply(self, batch: List[OpRequest]) -> None:
+        now = self._clock()
+        live: List[OpRequest] = []
+        for r in batch:
+            if r.deadline is not None and now > r.deadline:
+                self._count("serve.shed.expired")
+                r.session.send(
+                    protocol.MSG_REJECT,
+                    protocol.encode_reject(
+                        r.req_id, protocol.REJECT_EXPIRED,
+                        "deadline passed before apply"))
+            else:
+                live.append(r)
+        if not live:
+            return
+        # one packed (B, E) pair, B static = max_batch so every
+        # occupancy reuses one compiled program (ops/ingest.ingest_rows)
+        E = self.node.num_elements
+        add_rows = np.zeros((self.max_batch, E), bool)
+        del_rows = np.zeros((self.max_batch, E), bool)
+        live_mask = np.zeros(self.max_batch, bool)
+        for b, r in enumerate(live):
+            rows = add_rows if r.kind == protocol.OP_ADD else del_rows
+            rows[b, r.elements] = True
+            live_mask[b] = True
+        t0 = self._clock()
+        try:
+            # durable on return: state applied + batch δ WAL-fsync'd
+            self.node.ingest_batch(add_rows, del_rows, live_mask)
+        except Exception as e:  # noqa: BLE001 — poison batch: reject
+            # its (not-yet-replied) ops as RETRYABLE — an apply failure
+            # is transient server trouble (disk error, kernel fault),
+            # not the permanent invalid-op verdict — and keep serving.
+            # Runs here, not in the loop, so the drain-time flush gets
+            # the same protection (an ENOSPC mid-drain must not abort
+            # close() half-way with ops silently dropped).
+            self.last_error = e
+            self._count("serve.batch_errors")
+            for r in live:
+                r.session.send(
+                    protocol.MSG_REJECT,
+                    protocol.encode_reject(
+                        r.req_id, protocol.REJECT_OVERLOADED,
+                        f"batch apply failed (retry): {e}"))
+            return
+        if self._crash_after is not None:
+            self._crash_after -= 1
+            if self._crash_after <= 0:
+                # the test window: durably applied, NOT yet acked
+                os.kill(os.getpid(), signal.SIGKILL)
+        apply_s = self._clock() - t0
+        acked = 0
+        for r in live:
+            if r.session.send(protocol.MSG_ACK,
+                              protocol.encode_ack(r.req_id)):
+                acked += 1
+            else:
+                self._count("serve.ack_send_failures")
+        ack_t = self._clock()
+        if self.recorder is not None:
+            self.recorder.count_many({"serve.ops.acked": acked,
+                                      "serve.batches": 1})
+            self.recorder.observe("serve.batch.occupancy", len(live))
+            self.recorder.observe("serve.batch.apply_s", apply_s)
+            for r in live:
+                self.recorder.observe("serve.ingest_latency_s",
+                                      ack_t - r.t_arrival)
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.recorder is not None:
+            self.recorder.count(name, n)

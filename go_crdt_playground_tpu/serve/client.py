@@ -1,0 +1,277 @@
+"""Pipelined client for the op-ingest frontend.
+
+One connection, many in-flight ops: ``submit_async`` assigns a
+connection-scoped request id and returns a ``PendingOp`` immediately; a
+background reader thread matches ACK/REJECT frames back by id, stamps
+the latency, and resolves the handle.  The synchronous ``add`` /
+``delete`` / ``members`` helpers are one submit + wait.  Rejects raise
+the typed ``serve.protocol`` exceptions (``Overloaded``,
+``DeadlineExceeded``, ``Draining``, ``InvalidOp``), so a load generator
+can count shed classes without string matching.
+
+An op the server never answered (connection died, server killed) is
+UNRESOLVED, not acked — ``PendingOp.wait`` raises ``ConnectionError``
+for it.  The protocol is deliberately at-least-once: ops are idempotent
+CRDT mutations, so the client-side retry for an ambiguous outcome is a
+plain resubmit.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from go_crdt_playground_tpu.net import framing
+from go_crdt_playground_tpu.serve import protocol
+
+
+class PendingOp:
+    """One in-flight op's resolution slot."""
+
+    __slots__ = ("req_id", "t_sent", "_event", "_error", "latency_s")
+
+    def __init__(self, req_id: int, t_sent: float):
+        self.req_id = req_id
+        self.t_sent = t_sent
+        self._event = threading.Event()
+        self._error: Optional[BaseException] = None
+        self.latency_s: Optional[float] = None
+
+    def _resolve(self, error: Optional[BaseException],
+                 latency_s: Optional[float]) -> None:
+        self._error = error
+        self.latency_s = latency_s
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> float:
+        """Block until acked/rejected; returns the measured latency.
+        Raises the typed reject, or ``ConnectionError`` if the server
+        went away without answering (outcome UNKNOWN — resubmit)."""
+        if not self._event.wait(timeout):
+            raise socket.timeout(f"op {self.req_id}: no reply")
+        if self._error is not None:
+            raise self._error
+        return self.latency_s if self.latency_s is not None else 0.0
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def acked(self) -> bool:
+        return self._event.is_set() and self._error is None
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        """The typed reject (or transport failure) that resolved this
+        op, None if acked/pending — load generators classify shed
+        classes from this without catching."""
+        return self._error
+
+
+class ServeClient:
+    """One pipelined connection to a ``ServeFrontend``."""
+
+    def __init__(self, addr: Tuple[str, int], timeout: float = 30.0,
+                 on_result: Optional[Callable[[PendingOp], None]] = None):
+        self.timeout = timeout
+        self._on_result = on_result
+        self._sock = socket.create_connection(addr, timeout=timeout)
+        self._sock.settimeout(timeout)
+        self._wlock = threading.Lock()
+        self._lock = threading.Lock()
+        self._pending: dict = {}  # guarded-by: _lock
+        self._next_id = 0  # guarded-by: _lock
+        self._replies: dict = {}  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
+        self._reader = threading.Thread(
+            target=self._read_loop, name="serve-client-reader", daemon=True)
+        self._reader.start()
+
+    # -- submit path --------------------------------------------------------
+
+    def submit_async(self, kind: int, elements: Sequence[int],
+                     deadline_s: Optional[float] = None) -> PendingOp:
+        with self._lock:
+            if self._closed:
+                raise ConnectionError("client closed")
+            self._next_id += 1
+            req_id = self._next_id
+            op = PendingOp(req_id, time.monotonic())
+            self._pending[req_id] = op
+        deadline_us = int(deadline_s * 1e6) if deadline_s else 0
+        body = protocol.encode_op(req_id, kind, elements, deadline_us)
+        try:
+            with self._wlock:
+                framing.send_frame(self._sock, protocol.MSG_OP, body)
+        except OSError as e:
+            # ownership handshake with the read loop's death sweep: if
+            # the sweep already popped this op it also resolved it and
+            # fired on_result — return the resolved op so the caller
+            # counts it exactly once (raising too would double-count);
+            # if we still own it, resolve quietly and raise.
+            with self._lock:
+                owned = self._pending.pop(req_id, None) is not None
+            if not owned:
+                return op
+            op._resolve(ConnectionError(f"send failed: {e}"), None)
+            raise
+        return op
+
+    def add(self, *elements: int,
+            deadline_s: Optional[float] = None) -> float:
+        """Submit one Add(k...) op and wait for its durable ack; returns
+        the measured latency.  Raises the typed rejects."""
+        return self.submit_async(protocol.OP_ADD, elements,
+                                 deadline_s).wait(self.timeout)
+
+    def delete(self, *elements: int,
+               deadline_s: Optional[float] = None) -> float:
+        return self.submit_async(protocol.OP_DEL, elements,
+                                 deadline_s).wait(self.timeout)
+
+    def _request_reply(self, msg_type: int, encode) -> object:
+        with self._lock:
+            if self._closed:
+                raise ConnectionError("client closed")
+            self._next_id += 1
+            req_id = self._next_id
+            op = PendingOp(req_id, time.monotonic())
+            self._pending[req_id] = op
+        try:
+            with self._wlock:
+                framing.send_frame(self._sock, msg_type, encode(req_id))
+        except OSError:
+            # a failed send must not leave the entry pending (the read
+            # loop would later resolve it as a phantom failure on top
+            # of the raised error); if the death sweep popped it first
+            # it owns the resolution — just don't double-resolve
+            with self._lock:
+                owned = self._pending.pop(req_id, None) is not None
+            if owned:
+                op._resolve(ConnectionError("send failed"), None)
+            raise
+        try:
+            op.wait(self.timeout)
+        except BaseException:
+            # abandoned waiter: drop our entries so a LATE reply can't
+            # strand a decoded snapshot in _replies forever (_finish
+            # drops the other half of the race)
+            with self._lock:
+                self._pending.pop(req_id, None)
+                self._replies.pop(req_id, None)
+            raise
+        with self._lock:
+            return self._replies.pop(req_id)
+
+    def members(self) -> Tuple[List[int], np.ndarray]:
+        """Read back the replica's live element ids + vv."""
+        return self._request_reply(protocol.MSG_QUERY,
+                                   protocol.encode_query)
+
+    def stats(self) -> dict:
+        """The frontend's SLO read-out: its ``obs.Recorder.snapshot()``
+        (serve.ingest_latency_s p50/p95/p99, shed counters, batch
+        occupancy, queue depth) — what dashboards and the serve soak
+        both consume."""
+        return self._request_reply(protocol.MSG_STATS,
+                                   protocol.encode_stats)
+
+    # -- reader -------------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        err: BaseException = ConnectionError("connection closed")
+        try:
+            while True:
+                msg_type, body = framing.recv_frame(self._sock)
+                now = time.monotonic()
+                if msg_type == protocol.MSG_ACK:
+                    req_id = protocol.decode_ack(body)
+                    self._finish(req_id, None, now)
+                elif msg_type == protocol.MSG_REJECT:
+                    req_id, code, reason = protocol.decode_reject(body)
+                    exc = protocol.REJECT_EXCEPTIONS[code](reason)
+                    self._finish(req_id, exc, now)
+                elif msg_type == protocol.MSG_MEMBERS:
+                    req_id, members, vv = protocol.decode_members(body)
+                    with self._lock:
+                        self._replies[req_id] = (members, vv)
+                    self._finish(req_id, None, now)
+                elif msg_type == protocol.MSG_STATS_REPLY:
+                    req_id, snapshot = protocol.decode_stats_reply(body)
+                    with self._lock:
+                        self._replies[req_id] = snapshot
+                    self._finish(req_id, None, now)
+                else:
+                    err = framing.ProtocolError(
+                        f"unexpected frame type {msg_type}")
+                    return
+        except (framing.RemoteError, framing.ProtocolError, OSError) as e:
+            err = e
+        finally:
+            # the reader IS the client's liveness: once it exits (idle
+            # timeout, torn connection) later submits could send fine
+            # but never resolve — flip closed so they fail fast instead
+            # of hanging out their full wait.  Socket teardown happens
+            # inline (close() would join the current thread).
+            with self._lock:
+                self._closed = True
+                pending = list(self._pending.values())
+                self._pending.clear()
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            wrapped = (err if isinstance(err, framing.RemoteError)
+                       else ConnectionError(f"server went away: {err}"))
+            for op in pending:
+                op._resolve(wrapped, None)
+                if self._on_result is not None:
+                    # load generators tally through this callback; an op
+                    # resolved by connection death must count there too,
+                    # or the tally reads "unresolved" for ops that DID
+                    # resolve (with an unknown outcome)
+                    self._on_result(op)
+
+    def _finish(self, req_id: int, exc: Optional[BaseException],
+                now: float) -> None:
+        with self._lock:
+            op = self._pending.pop(req_id, None)
+            if op is None:
+                # duplicate/stale reply — a waiter that timed out and
+                # cleaned up may have raced our reply store; drop it so
+                # abandoned queries can't strand replies forever
+                self._replies.pop(req_id, None)
+                return
+        latency = now - op.t_sent
+        op._resolve(exc, None if exc is not None else latency)
+        if self._on_result is not None:
+            self._on_result(op)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        # shutdown BEFORE close: a reader blocked in recv() does not
+        # reliably wake on close() alone (it can sit until the socket
+        # timeout); shutdown tears the connection under it immediately
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._reader.join(timeout=5.0)
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

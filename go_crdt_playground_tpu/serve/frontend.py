@@ -1,0 +1,354 @@
+"""The op-ingest serving frontend: listener + admission + batcher + node.
+
+``ServeFrontend`` is the subsystem the ROADMAP's "serves heavy traffic"
+north star plugs into: clients dial a TCP port and submit add/del ops
+against a keyed AWSet replica (serve/protocol.py); connection reader
+threads admit them into the bounded ``AdmissionQueue`` (full queue ⇒
+typed ``Overloaded`` shed, never a silent drop); the ``MicroBatcher``
+coalesces admitted ops into packed ``(B, E)`` tensor applies through
+the kernel path and acks only after the WAL group commit
+(``Node.ingest_batch``); and the merged state disseminates through the
+EXISTING anti-entropy machinery — the frontend's ``Node`` is an
+ordinary ``net/peer.py`` replica, optionally driven against a peer set
+by a ``SyncSupervisor`` on the §14 durability regime.
+
+Shutdown is a drain, not a drop (``close()``): stop accepting dials,
+flip draining (in-flight connections get typed ``Draining`` rejects for
+NEW ops), flush the batcher (every admitted op acks or typed-rejects),
+take a final durable checkpoint (seals + retires the WAL segments the
+dump covers), then close sessions and the node.
+
+SLO accounting rides the shared ``obs.Recorder`` (names in DESIGN.md
+§16): listener-side counters ``serve.ops.admitted``,
+``serve.shed.overload``, ``serve.shed.draining``,
+``serve.rejects.invalid``, ``serve.queries``, ``serve.connections``;
+the batcher adds the latency/occupancy streams.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+from go_crdt_playground_tpu.net import framing
+from go_crdt_playground_tpu.net.peer import Node
+from go_crdt_playground_tpu.serve import protocol
+from go_crdt_playground_tpu.serve.admission import AdmissionQueue, OpRequest
+from go_crdt_playground_tpu.serve.batcher import MicroBatcher
+from go_crdt_playground_tpu.serve.session import Session
+
+Addr = Tuple[str, int]
+
+
+class ServeFrontend:
+    """TCP op-ingest frontend over one durable AWSet replica."""
+
+    # a client that connects and sends nothing must release its reader
+    # thread eventually; ops themselves are admitted in microseconds.
+    # Replies ride the session's OWN bounded write half (serve/session.
+    # py), so a client that stops reading can never head-of-line-block
+    # the batcher for this long.
+    IDLE_TIMEOUT_S = 60.0
+    # every legal serve frame is tiny (an OP is a few varints per key);
+    # cap the declared body size far below framing's peer-payload limit
+    # so an untrusted length header cannot balloon per-connection memory
+    MAX_FRAME_BODY = 1 << 20
+
+    # client-connection cap (the net/peer.py _conn_slots pattern): at
+    # capacity new dials are shed, not queued — unbounded reader-thread
+    # growth is how a slow-loris client kills the process, and an op
+    # client retries idempotently
+    MAX_CONNS = 256
+
+    def __init__(self, num_elements: int, num_actors: int, *,
+                 actor: int = 0, durable_dir: Optional[str] = None,
+                 peers: Sequence[Addr] = (), queue_depth: int = 256,
+                 max_batch: int = 32, flush_ms: float = 2.0,
+                 checkpoint_every: int = 0, sync_interval_s: float = 0.05,
+                 wal_fsync: bool = True, recorder=None, seed: int = 0,
+                 max_conns: Optional[int] = None):
+        from go_crdt_playground_tpu.obs import Recorder
+
+        self.recorder = recorder if recorder is not None else Recorder()
+        self.durable_dir = durable_dir
+        if durable_dir is not None:
+            os.makedirs(durable_dir, exist_ok=True)
+            self.node = Node.restore_durable(
+                durable_dir, recorder=self.recorder,
+                fallback_init=lambda: Node(
+                    actor, num_elements, num_actors,
+                    recorder=self.recorder))
+        else:
+            # non-durable regime (benchmarks/tests): acks are NOT backed
+            # by an fsync — production serving always passes durable_dir
+            self.node = Node(actor, num_elements, num_actors,
+                             recorder=self.recorder)
+        self.queue = AdmissionQueue(queue_depth)
+        self.batcher = MicroBatcher(
+            self.node, self.queue, max_batch=max_batch,
+            flush_s=flush_ms / 1000.0, recorder=self.recorder)
+        # the dissemination half rides the EXISTING supervisor; it also
+        # owns the durable checkpoint cadence (and attaches a WAL to a
+        # fresh non-restored node when durable_dir is set)
+        self.supervisor = None
+        if peers or durable_dir is not None:
+            from go_crdt_playground_tpu.net.antientropy import SyncSupervisor
+
+            self.supervisor = SyncSupervisor(
+                self.node, peers, durable_dir=durable_dir,
+                checkpoint_every=checkpoint_every,
+                interval_s=sync_interval_s, wal_fsync=wal_fsync,
+                recorder=self.recorder, seed=seed)
+        self._conn_slots = threading.BoundedSemaphore(
+            self.MAX_CONNS if max_conns is None else max_conns)
+        self._lock = threading.Lock()
+        self._sessions: set = set()  # guarded-by: _lock
+        self._draining = threading.Event()
+        self._closed = threading.Event()
+        # race-ok: serve()/close() owner thread; accept loop snapshots
+        self._listener: Optional[socket.socket] = None
+        # race-ok: serve()/close() owner thread only
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0,
+              peer_port: Optional[int] = None) -> Addr:
+        """Start serving client ops; returns the bound (host, port).
+        With ``peer_port`` (or any registered peers) the node also
+        starts its anti-entropy server / supervisor loop."""
+        if self._listener is not None:
+            raise RuntimeError("already serving")
+        self._warmup()
+        sock = socket.create_server((host, port))
+        self._listener = sock
+        self.batcher.start()
+        if peer_port is not None:
+            self.node.serve(host, peer_port)
+        if self.supervisor is not None and (self.supervisor.peers
+                                            or self.supervisor.
+                                            checkpoint_every > 0):
+            self.supervisor.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="serve-accept", daemon=True)
+        self._accept_thread.start()
+        return sock.getsockname()[:2]
+
+    def _warmup(self) -> None:
+        """Run one full throwaway ingest (batch apply + δ extraction +
+        wire encode + WAL append) on a scratch node of the serving
+        shapes BEFORE the listener opens: the first client batch must
+        pay the flush watermark, not a multi-second trace+compile (the
+        un-warmed stall measured ~600ms-4s on CPU — at 200 ops/s that
+        alone fills a 128-deep admission queue and sheds a burst).  The
+        REAL node is untouched; compile caches are shape-keyed, so the
+        scratch run warms the serving programs exactly."""
+        import tempfile
+
+        import numpy as np
+
+        from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+        B, E = self.batcher.max_batch, self.node.num_elements
+        with tempfile.TemporaryDirectory(prefix="serve-warmup-") as d:
+            scratch = Node(self.node.actor, E, self.node.num_actors,
+                           wal=DeltaWal(os.path.join(d, "wal"),
+                                        fsync=False))
+            add = np.zeros((B, E), bool)
+            add[0, 0] = True  # one live lane: the δ-extract path runs
+            scratch.ingest_batch(add, np.zeros((B, E), bool),
+                                 np.asarray([True] + [False] * (B - 1)))
+            with scratch._lock:
+                scratch.wal.close()
+
+    def close(self, drain_timeout_s: float = 30.0) -> None:
+        """Graceful drain (module docstring): admitted ops ack before
+        the process lets go of them."""
+        if self._closed.is_set():
+            return
+        self._draining.set()
+        listener = self._listener
+        if listener is not None:
+            try:
+                listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        self.batcher.drain(timeout=drain_timeout_s)
+        if self.supervisor is not None:
+            self.supervisor.stop()
+            if self.supervisor.durable_dir is not None:
+                # final checkpoint: seals the WAL and retires the
+                # segments the dump covers (Node.save_durable two-phase)
+                try:
+                    self.supervisor.checkpoint()
+                except Exception:  # noqa: BLE001 — drain must finish;
+                    # the WAL already holds everything the dump would
+                    self._count("serve.final_checkpoint_failures")
+        # node BEFORE wal: the node's peer-sync server logs every
+        # applied payload, so the WAL must outlive the listener (an
+        # inbound exchange against a closed WAL is a served error, not
+        # a crashed handler — net/peer.py catches it — but not serving
+        # it at all is better)
+        self.node.close()
+        with self.node._lock:
+            wal = self.node.wal
+        if wal is not None:
+            wal.close()
+        with self._lock:
+            sessions = list(self._sessions)
+            self._sessions.clear()
+        for s in sessions:
+            s.close()
+        self._closed.set()
+
+    def __enter__(self) -> "ServeFrontend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- accept / per-connection reader -------------------------------------
+
+    def _accept_loop(self) -> None:
+        sock = self._listener  # snapshot: close() may null the field
+        assert sock is not None
+        while not self._draining.is_set():
+            try:
+                conn, addr = sock.accept()
+            except OSError:
+                return  # listener closed
+            if not self._conn_slots.acquire(blocking=False):
+                self._count("serve.shed.connections")
+                conn.close()  # at capacity: shed the dial, not queue it
+                continue
+            self._count("serve.connections")
+            session = Session(conn, peer=f"{addr[0]}:{addr[1]}")
+            with self._lock:
+                self._sessions.add(session)
+            # finally-shaped slot handoff (the net/peer.py lesson): ANY
+            # failure to start the reader must shed the dial AND return
+            # the slot, else capacity decays one leak at a time
+            handed_off = False
+            try:
+                threading.Thread(
+                    target=self._reader, args=(conn, session),
+                    daemon=True).start()
+                handed_off = True
+            except RuntimeError:
+                pass  # OS thread exhaustion: shed, keep accepting
+            finally:
+                if not handed_off:
+                    with self._lock:
+                        self._sessions.discard(session)
+                    session.close()
+                    self._conn_slots.release()
+
+    def _reader(self, conn: socket.socket, session: Session) -> None:
+        try:
+            conn.settimeout(self.IDLE_TIMEOUT_S)
+            while not session.closed:
+                try:
+                    msg_type, body = framing.recv_frame(
+                        conn, timeout=self.IDLE_TIMEOUT_S,
+                        max_body=self.MAX_FRAME_BODY)
+                except (framing.ProtocolError, OSError):
+                    return  # torn/idle/garbled connection: drop it
+                if msg_type == protocol.MSG_OP:
+                    if not self._handle_op(session, body):
+                        return
+                elif msg_type == protocol.MSG_QUERY:
+                    self._handle_query(session, body)
+                elif msg_type == protocol.MSG_STATS:
+                    self._handle_stats(session, body)
+                else:
+                    session.send(framing.MSG_ERROR,
+                                 f"unexpected frame type {msg_type}"
+                                 .encode())
+                    return
+        finally:
+            with self._lock:
+                self._sessions.discard(session)
+            session.close()
+            self._conn_slots.release()
+
+    def _handle_op(self, session: Session, body: bytes) -> bool:
+        """Admit one OP frame; False ends the connection (undecodable
+        frame — the stream may be out of sync)."""
+        try:
+            req_id, kind, elements, deadline_us = protocol.decode_op(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return False
+        E = self.node.num_elements
+        if any(not 0 <= e < E for e in elements):
+            self._count("serve.rejects.invalid")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_INVALID,
+                f"element id outside universe E={E}"))
+            return True
+        if len(set(elements)) != len(elements):
+            # key-SET contract (serve/protocol.py): duplicates would
+            # apply set-wise here but per-argument on the reference host
+            # path — refuse rather than silently diverge by ingress
+            self._count("serve.rejects.invalid")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_INVALID,
+                "duplicate element ids in one op"))
+            return True
+        if self._draining.is_set():
+            self._count("serve.shed.draining")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_DRAINING, "frontend draining"))
+            return True
+        now = time.monotonic()
+        deadline = (now + deadline_us / 1e6) if deadline_us > 0 else None
+        req = OpRequest(req_id, kind, elements, deadline, session, now)
+        if self.queue.offer(req):
+            self._count("serve.ops.admitted")
+        else:
+            # admission limit: shed with the TYPED reply — under
+            # saturation offered load converts to Overloaded replies,
+            # not queue growth (bounded p99, SERVE_CURVE.json)
+            self._count("serve.shed.overload")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_OVERLOADED,
+                f"admission queue full (depth {self.queue.maxdepth})"))
+        return True
+
+    def _handle_query(self, session: Session, body: bytes) -> None:
+        try:
+            req_id = protocol.decode_query(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return
+        self._count("serve.queries")
+        # ONE lock hold for membership + vv: separate members()/vv()
+        # calls could interleave with a batch commit and reply with a
+        # vv covering an add the membership doesn't show — a state no
+        # replica ever held
+        import numpy as np
+
+        snap = self.node.state_slice()
+        members = np.nonzero(np.asarray(snap.present))[0]
+        session.send(protocol.MSG_MEMBERS, protocol.encode_members(
+            req_id, [int(e) for e in members], np.asarray(snap.vv)))
+
+    def _handle_stats(self, session: Session, body: bytes) -> None:
+        """The SLO read-out: the recorder snapshot (ingest latency
+        p50/p95/p99, batch occupancy, shed counters, queue depth) over
+        the wire — operators and the serve soak read the same numbers."""
+        try:
+            req_id = protocol.decode_stats(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return
+        session.send(protocol.MSG_STATS_REPLY, protocol.encode_stats_reply(
+            req_id, self.recorder.snapshot()))
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.recorder is not None:
+            self.recorder.count(name, n)

@@ -1,0 +1,271 @@
+"""Client op-ingest wire protocol (DESIGN.md §16 "Serving ladder").
+
+Rides the SAME frame armor as the peer sync protocol —
+``net/framing.py``'s ``MAGIC(2) | type(1) | varint body_len | body`` —
+with a disjoint message-type range (>= 16), so one listener could in
+principle speak both dialects and a serve frame can never be mistaken
+for an anti-entropy frame.  Bodies reuse the ``utils/wire.py`` varint
+codec; there is no new byte format below the body layouts here.
+
+    OP       varint req_id | kind(1: 0=add 1=del) | varint deadline_us
+             | varint k | k x varint element_id
+    ACK      varint req_id
+    REJECT   varint req_id | code(1) | utf-8 reason
+    QUERY    varint req_id
+    MEMBERS  varint req_id | varint n | n x varint element_id
+             | varint A | A x varint vv
+    STATS    varint req_id
+    STATS_REPLY  varint req_id | utf-8 JSON (obs.Recorder.snapshot())
+
+``deadline_us`` is the client's remaining latency budget in
+MICROSECONDS at send time (0 = none); the server converts it to an
+absolute deadline at admission and sheds the op with ``REJECT_EXPIRED``
+instead of applying it late — deadline propagation, not server-side
+guessing.  ``REJECT`` is the typed load-shed reply (never a silent
+drop): ``REJECT_OVERLOADED`` (admission queue full), ``REJECT_EXPIRED``
+(deadline passed before apply), ``REJECT_DRAINING`` (shutdown in
+progress), ``REJECT_INVALID`` (element id outside the universe).  Each
+maps to a typed client-side exception below.
+
+An ``ACK`` is only ever sent AFTER the op's effects are fsync'd in the
+replica's delta WAL (``Node.ingest_batch`` group commit) — the same
+durable-before-ack contract as DESIGN.md §14.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from go_crdt_playground_tpu.net.framing import ProtocolError
+from go_crdt_playground_tpu.utils import wire
+
+# message types (>= 16: disjoint from net/framing's HELLO/PAYLOAD/ERROR)
+MSG_OP = 16
+MSG_ACK = 17
+MSG_REJECT = 18
+MSG_QUERY = 19
+MSG_MEMBERS = 20
+MSG_STATS = 21
+MSG_STATS_REPLY = 22
+
+OP_ADD = 0
+OP_DEL = 1
+
+REJECT_OVERLOADED = 1
+REJECT_EXPIRED = 2
+REJECT_DRAINING = 3
+REJECT_INVALID = 4
+
+_MAX_REASON = 1 << 16
+
+
+class ServeError(RuntimeError):
+    """Base of every typed op-reject a client can receive."""
+
+
+class Overloaded(ServeError):
+    """The frontend shed the op WITHOUT applying it and the condition
+    is transient: admission queue at depth, or a server-side apply
+    fault.  Retry with backoff — the CRDT op is idempotent, so a
+    duplicate retry after an ambiguous failure is harmless by
+    construction."""
+
+
+class DeadlineExceeded(ServeError):
+    """The op's propagated deadline passed before the batcher applied
+    it; it was NOT applied."""
+
+
+class Draining(ServeError):
+    """The frontend is shutting down gracefully and no longer admits
+    new ops (already-admitted ops still flush and ack)."""
+
+
+class InvalidOp(ServeError):
+    """The op named an element outside the configured universe."""
+
+
+REJECT_EXCEPTIONS = {
+    REJECT_OVERLOADED: Overloaded,
+    REJECT_EXPIRED: DeadlineExceeded,
+    REJECT_DRAINING: Draining,
+    REJECT_INVALID: InvalidOp,
+}
+
+
+def encode_op(req_id: int, kind: int, elements: Sequence[int],
+              deadline_us: int = 0) -> bytes:
+    if kind not in (OP_ADD, OP_DEL):
+        raise ValueError(f"unknown op kind {kind}")
+    if not elements:
+        raise ValueError("an op must name at least one element")
+    if len(set(elements)) != len(elements):
+        # the frame body is a key SET: the packed batch apply is
+        # selector-based, while the reference host path ticks the clock
+        # once per ARGUMENT — duplicates would make identical op
+        # streams diverge by ingress path, so they are refused at both
+        # ends (the listener rejects them typed, serve/frontend.py)
+        raise ValueError("duplicate element ids in one op")
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    out.append(kind)
+    wire._put_varint(out, max(0, int(deadline_us)))
+    wire._put_varint(out, len(elements))
+    for e in elements:
+        wire._put_varint(out, int(e))
+    return bytes(out)
+
+
+def decode_op(body: bytes) -> Tuple[int, int, List[int], int]:
+    """Returns (req_id, kind, elements, deadline_us).  Range-validation
+    of element ids against the universe is the LISTENER's job (it knows
+    the universe and owes the client a typed per-request reject, not a
+    connection-fatal protocol error)."""
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+        if pos >= len(body):
+            raise ProtocolError("truncated OP body")
+        kind = body[pos]
+        pos += 1
+        if kind not in (OP_ADD, OP_DEL):
+            raise ProtocolError(f"unknown op kind {kind}")
+        deadline_us, pos = wire._get_varint(body, pos)
+        k, pos = wire._get_varint(body, pos)
+        if k == 0:
+            raise ProtocolError("empty OP key set")
+        elements = []
+        for _ in range(k):
+            e, pos = wire._get_varint(body, pos)
+            elements.append(e)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos != len(body):
+        raise ProtocolError("trailing bytes after OP")
+    return req_id, kind, elements, deadline_us
+
+
+def encode_ack(req_id: int) -> bytes:
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    return bytes(out)
+
+
+def decode_ack(body: bytes) -> int:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos != len(body):
+        raise ProtocolError("trailing bytes after ACK")
+    return req_id
+
+
+def encode_reject(req_id: int, code: int, reason: str) -> bytes:
+    if code not in REJECT_EXCEPTIONS:
+        raise ValueError(f"unknown reject code {code}")
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    out.append(code)
+    return bytes(out) + reason.encode("utf-8")[:_MAX_REASON]
+
+
+def decode_reject(body: bytes) -> Tuple[int, int, str]:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos >= len(body):
+        raise ProtocolError("truncated REJECT body")
+    code = body[pos]
+    if code not in REJECT_EXCEPTIONS:
+        raise ProtocolError(f"unknown reject code {code}")
+    return req_id, code, body[pos + 1:].decode("utf-8", "replace")
+
+
+def encode_query(req_id: int) -> bytes:
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    return bytes(out)
+
+
+def decode_query(body: bytes) -> int:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos != len(body):
+        raise ProtocolError("trailing bytes after QUERY")
+    return req_id
+
+
+def encode_stats(req_id: int) -> bytes:
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    return bytes(out)
+
+
+def decode_stats(body: bytes) -> int:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos != len(body):
+        raise ProtocolError("trailing bytes after STATS")
+    return req_id
+
+
+def encode_stats_reply(req_id: int, snapshot: dict) -> bytes:
+    import json
+
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    return bytes(out) + json.dumps(snapshot).encode("utf-8")
+
+
+def decode_stats_reply(body: bytes) -> Tuple[int, dict]:
+    import json
+
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+        snapshot = json.loads(body[pos:].decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as err:
+        raise ProtocolError(str(err)) from err
+    return req_id, snapshot
+
+
+def encode_members(req_id: int, members: Sequence[int],
+                   vv: np.ndarray) -> bytes:
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    wire._put_varint(out, len(members))
+    for e in members:
+        wire._put_varint(out, int(e))
+    vv = np.asarray(vv, np.uint32)
+    wire._put_varint(out, vv.shape[0])
+    for c in vv:
+        wire._put_varint(out, int(c))
+    return bytes(out)
+
+
+def decode_members(body: bytes) -> Tuple[int, List[int], np.ndarray]:
+    """Self-describing (carries its own lengths): the client needs no
+    out-of-band universe/actor-axis configuration to read a reply."""
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+        n, pos = wire._get_varint(body, pos)
+        members = []
+        for _ in range(n):
+            e, pos = wire._get_varint(body, pos)
+            members.append(e)
+        a, pos = wire._get_varint(body, pos)
+        vv = np.zeros(a, np.uint32)
+        for i in range(a):
+            v, pos = wire._get_varint(body, pos)
+            vv[i] = v
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos != len(body):
+        raise ProtocolError("trailing bytes after MEMBERS")
+    return req_id, members, vv

@@ -1,0 +1,90 @@
+"""One client connection's write half, shared across threads.
+
+A session's socket is written by TWO threads — its own reader (immediate
+rejects, QUERY replies) and the batcher (acks after the group commit) —
+so every send serializes on a per-session lock, and a broken transport
+flips the session closed instead of raising into the batcher: a client
+that died mid-batch must cost exactly its own acks, never the batch.
+
+The write half is a ``dup()`` of the connection with its OWN short
+timeout: socket timeouts are per-object, so the reader's whole-frame
+idle deadline and the writer's send bound never race over one setting.
+The bound matters because the batcher is a single thread: a client that
+stops READING its acks fills its TCP window, and an unbounded sendall
+there would head-of-line-block every other client's acks for as long
+as the idle timeout — with the bound, a stalled client costs one short
+stall, its session flips closed, and all further replies to it are
+instant no-ops.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from go_crdt_playground_tpu.net import framing
+
+
+class Session:
+    """Locked, failure-absorbing frame writer over one client socket."""
+
+    # short, because these stalls SERIALIZE on the single batcher
+    # thread: a cycling population of stalled clients costs one bound
+    # each.  A healthy client's kernel window absorbs thousands of the
+    # tiny reply frames, so only a reader stalled long enough to fill
+    # ~64KB of unread replies ever trips this.  (Fully decoupling acks
+    # from the batcher — per-session writer queues — is queued in
+    # ROADMAP "Open items" for the sharded-serving round.)
+    SEND_TIMEOUT_S = 0.25
+
+    def __init__(self, conn: socket.socket, peer: str = "?",
+                 send_timeout_s: float = SEND_TIMEOUT_S):
+        self._conn = conn
+        self._wconn = conn.dup()  # independent timeout for the writers
+        self._wconn.settimeout(send_timeout_s)
+        self.peer = peer
+        self._wlock = threading.Lock()
+        self._closed = False  # guarded-by: _wlock
+
+    def send(self, msg_type: int, body: bytes) -> bool:
+        """Send one frame; False if the session is (now) closed.  Any
+        transport failure — including the send bound expiring against a
+        stalled reader — closes the session: replies to a dead or wedged
+        client are dropped, not retried (the op itself is already
+        durable; the client re-learns outcomes via QUERY or idempotent
+        resubmit)."""
+        with self._wlock:
+            if self._closed:
+                return False
+            try:
+                framing.send_frame(self._wconn, msg_type, body)
+                return True
+            except OSError:
+                self._close_locked()
+                return False
+
+    # requires-lock: _wlock
+    def _close_locked(self) -> None:
+        self._closed = True
+        # shutdown BEFORE close: the connection's reader thread may be
+        # blocked in recv() and does not reliably wake on a bare
+        # close() (it can sit out the idle timeout)
+        try:
+            self._conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        for s in (self._wconn, self._conn):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        with self._wlock:
+            if not self._closed:
+                self._close_locked()
+
+    @property
+    def closed(self) -> bool:
+        with self._wlock:
+            return self._closed

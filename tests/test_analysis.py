@@ -708,6 +708,14 @@ def test_gate_fast(tmp_path):
             "lattice_laws"} <= set(report["passes"])
     # the runtime pass must have actually exercised instrumented objects
     assert report["passes"]["locksets"]["stats"]["fields_tracked"] > 0
+    # the PR-5 serving frontend's shared state is inside the gate: its
+    # classes must appear in the lock-discipline sweep (acceptance
+    # criterion of the serve ISSUE — "0 findings on the serve/ locks"
+    # only means something if serve/ was actually covered)
+    covered = set(report["passes"]["lockdiscipline"]["stats"]
+                  ["classes_by_name"])
+    assert {"AdmissionQueue", "Session", "MicroBatcher", "ServeFrontend",
+            "ServeClient"} <= covered, covered
 
 
 def test_report_shape_roundtrips(tmp_path):

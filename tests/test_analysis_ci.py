@@ -10,6 +10,7 @@ contract, CI images that have ruff enforce it), and the repo-root
 
 import json
 import os
+import re
 import shutil
 import subprocess
 import sys
@@ -26,10 +27,18 @@ def test_ruff_baseline_is_configured():
     assert "[tool.ruff" in cfg
     assert '"F82"' in cfg, \
         "undefined-name checking is the floor of the ruff baseline"
+    # the dev extra is how a contributor GETS ruff (the skip message of
+    # test_ruff_baseline_clean points at it; keep the two in lockstep)
+    assert "[project.optional-dependencies]" in cfg
+    assert re.search(r'dev\s*=\s*\[\s*"ruff', cfg), \
+        "pyproject must carry a dev extra providing ruff"
 
 
-@pytest.mark.skipif(shutil.which("ruff") is None,
-                    reason="ruff not installed in this image")
+@pytest.mark.skipif(
+    shutil.which("ruff") is None,
+    reason="ruff not installed in this image — `pip install -e .[dev]` "
+           "(the pyproject dev extra) provides it; CI images that have "
+           "it enforce the baseline")
 def test_ruff_baseline_clean():
     proc = subprocess.run(["ruff", "check", "."], cwd=REPO,
                           capture_output=True, text=True, timeout=300)

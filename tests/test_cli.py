@@ -94,6 +94,55 @@ def test_serve_command_end_to_end(tmp_path):
             proc.wait(timeout=10)
 
 
+def test_serve_ingest_command_end_to_end(tmp_path):
+    """`serve --ingest` as a real subprocess: parse the address, submit
+    ops through the serve client, read membership back, then SIGTERM
+    for a graceful drain (the drain summary line is the contract the
+    serve soak's parent also reads)."""
+    from __graft_entry__ import _scrubbed_cpu_env
+    from go_crdt_playground_tpu.serve import ServeClient
+
+    err_path = tmp_path / "ingest.err"
+    with open(err_path, "w") as err_f:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "go_crdt_playground_tpu", "serve",
+             "--ingest", "--elements", "64", "--actors", "2",
+             "--durable-dir", str(tmp_path / "n0"), "--flush-ms", "1"],
+            env=_scrubbed_cpu_env(1), cwd=REPO,
+            stdout=subprocess.PIPE, stderr=err_f, text=True)
+    try:
+        import queue
+        import threading
+
+        lines: "queue.Queue[str]" = queue.Queue()
+        threading.Thread(target=lambda: lines.put(proc.stdout.readline()),
+                         daemon=True).start()
+        try:
+            line = lines.get(timeout=120)
+        except queue.Empty:
+            raise AssertionError(
+                "serve --ingest printed no address within 120s; stderr:\n"
+                + err_path.read_text()[-3000:])
+        m = re.search(r"listening on ([\d.]+):(\d+)", line)
+        assert m, (f"no address line: {line!r}; stderr:\n"
+                   + err_path.read_text()[-3000:])
+        with ServeClient((m.group(1), int(m.group(2))),
+                         timeout=120.0) as client:
+            client.add(1, 2, 3)
+            client.delete(2)
+            members, vv = client.members()
+        assert members == [1, 3]
+        assert int(vv[0]) == 4  # 3 add ticks + 1 del tick
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=60)
+        assert proc.returncode == 0
+        assert re.search(r"drained: 2 ops acked, ingest p99 ", out), out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
 def test_gossip_command_rejects_certain_loss():
     """--drop-rate 1.0 can never converge; the parser fails fast with a
     clean error instead of grinding the full round budget."""
@@ -102,6 +151,16 @@ def test_gossip_command_rejects_certain_loss():
     with pytest.raises(SystemExit) as exc:
         main(["gossip", "--drop-rate", "1.0"])
     assert exc.value.code == 2  # argparse usage error
+
+
+def test_serve_ingest_rejects_malformed_peer():
+    """--peer without a port is a clean argparse error, not an int('')
+    traceback at startup."""
+    import pytest
+
+    with pytest.raises(SystemExit) as exc:
+        main(["serve", "--ingest", "--peer", "otherhost"])
+    assert exc.value.code == 2
 
 
 def test_gossip_command_seed_flag(capsys):

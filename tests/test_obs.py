@@ -173,6 +173,47 @@ def test_recorder():
     assert snap["observations"]["round_s"]["n"] == 1
 
 
+def test_recorder_percentiles_log_spaced_histogram():
+    """observe() keeps a BOUNDED log-spaced histogram so percentile()
+    reports real p50/p95/p99 (within the √2 bucket error), not max —
+    the serve frontend's SLO numbers ride this."""
+    r = Recorder()
+    # 98 fast observations + 2 slow outliers: p50 must sit near the
+    # fast mode, p99 near (but never above) the outliers — the old
+    # n/sum/min/max summary could only ever report 2.0 here
+    for _ in range(98):
+        r.observe("lat_s", 0.001)
+    r.observe("lat_s", 2.0)
+    r.observe("lat_s", 2.0)
+    p50 = r.percentile("lat_s", 0.50)
+    p99 = r.percentile("lat_s", 0.99)
+    assert 0.001 <= p50 <= 0.001 * 2 ** 0.5
+    assert 1.0 < p99 <= 2.0
+    # snapshot carries the derived quantiles alongside the summary
+    o = r.snapshot()["observations"]["lat_s"]
+    assert o["p50"] == p50 and o["p99"] == p99 and o["p95"] <= o["p99"]
+    # identical values report exactly (clamped to observed min/max)
+    r2 = Recorder()
+    for _ in range(10):
+        r2.observe("x", 0.25)
+    assert r2.percentile("x", 0.5) == 0.25
+    assert r2.percentile("x", 0.99) == 0.25
+
+
+def test_recorder_percentile_edges():
+    import pytest as _pytest
+
+    r = Recorder()
+    with _pytest.raises(KeyError):
+        r.percentile("never", 0.5)  # no data must not read as 0 latency
+    r.observe("edge", 0.0)       # underflow bucket
+    r.observe("edge", 1e9)       # overflow bucket
+    assert r.percentile("edge", 0.0) <= 1e-6  # underflow bucket bound
+    assert r.percentile("edge", 1.0) == 1e9   # overflow reports exact max
+    with _pytest.raises(ValueError):
+        r.percentile("edge", 1.5)
+
+
 def test_payload_metrics():
     import jax
     import jax.numpy as jnp

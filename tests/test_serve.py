@@ -1,0 +1,539 @@
+"""Op-ingest serving frontend (serve/): protocol, admission, batching,
+durability, deadlines, drain (DESIGN.md §16).
+
+The load-shape tests (shed curves, SIGKILL windows) live in the slow
+serve soak (tests/test_serve_soak.py); here every behavior is pinned
+DETERMINISTICALLY — the batcher is gated where a test needs the queue
+to back up, so no assertion depends on thread timing races.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from go_crdt_playground_tpu.net.framing import ProtocolError
+from go_crdt_playground_tpu.serve import protocol
+from go_crdt_playground_tpu.serve.admission import AdmissionQueue, OpRequest
+from go_crdt_playground_tpu.serve.client import ServeClient
+from go_crdt_playground_tpu.serve.frontend import ServeFrontend
+
+
+# ---------------------------------------------------------------------------
+# protocol bodies
+# ---------------------------------------------------------------------------
+
+
+def test_protocol_op_roundtrip():
+    body = protocol.encode_op(7, protocol.OP_ADD, [1, 5, 300],
+                              deadline_us=2_000_000)
+    assert protocol.decode_op(body) == (7, protocol.OP_ADD, [1, 5, 300],
+                                        2_000_000)
+    body = protocol.encode_op(1, protocol.OP_DEL, [0])
+    assert protocol.decode_op(body) == (1, protocol.OP_DEL, [0], 0)
+
+
+def test_protocol_op_rejects_malformed():
+    with pytest.raises(ValueError):
+        protocol.encode_op(1, 9, [1])  # unknown kind
+    with pytest.raises(ValueError):
+        protocol.encode_op(1, protocol.OP_ADD, [])  # empty key set
+    good = protocol.encode_op(3, protocol.OP_ADD, [1, 2])
+    with pytest.raises(ProtocolError):
+        protocol.decode_op(good + b"\x00")  # trailing bytes
+    with pytest.raises(ProtocolError):
+        protocol.decode_op(good[:-1])  # truncated
+    with pytest.raises(ProtocolError):
+        protocol.decode_op(b"")
+
+
+def test_protocol_ack_reject_members_roundtrip():
+    assert protocol.decode_ack(protocol.encode_ack(42)) == 42
+    body = protocol.encode_reject(9, protocol.REJECT_OVERLOADED, "full")
+    assert protocol.decode_reject(body) == (9, protocol.REJECT_OVERLOADED,
+                                            "full")
+    with pytest.raises(ValueError):
+        protocol.encode_reject(1, 99, "?")
+    req, members, vv = protocol.decode_members(
+        protocol.encode_members(5, [1, 2, 9], np.asarray([3, 0, 7])))
+    assert (req, members, vv.tolist()) == (5, [1, 2, 9], [3, 0, 7])
+    # every reject code maps to a typed exception
+    assert set(protocol.REJECT_EXCEPTIONS) == {
+        protocol.REJECT_OVERLOADED, protocol.REJECT_EXPIRED,
+        protocol.REJECT_DRAINING, protocol.REJECT_INVALID}
+
+
+# ---------------------------------------------------------------------------
+# admission queue (no sockets)
+# ---------------------------------------------------------------------------
+
+
+def _req(i: int) -> OpRequest:
+    return OpRequest(i, protocol.OP_ADD, [i], None, None, 0.0)
+
+
+def test_admission_queue_bounds_and_sheds():
+    q = AdmissionQueue(2)
+    assert q.offer(_req(1)) and q.offer(_req(2))
+    assert not q.offer(_req(3))  # at depth: shed, never queue
+    assert q.depth() == 2
+    batch = q.take_batch(10, wait_s=0.0, flush_s=0.0)
+    assert [r.req_id for r in batch] == [1, 2]
+    assert q.offer(_req(4))  # drained: admits again
+
+
+def test_admission_queue_size_watermark():
+    q = AdmissionQueue(16)
+    for i in range(5):
+        q.offer(_req(i))
+    # size watermark fires before the flush timer: 3 now, 2 next
+    assert len(q.take_batch(3, wait_s=0.0, flush_s=10.0)) == 3
+    assert len(q.take_batch(3, wait_s=0.0, flush_s=0.0)) == 2
+
+
+def test_admission_queue_time_watermark_gathers_late_arrivals():
+    q = AdmissionQueue(16)
+    q.offer(_req(0))
+    t = threading.Thread(
+        target=lambda: (time.sleep(0.05), q.offer(_req(1))), daemon=True)
+    t.start()
+    batch = q.take_batch(8, wait_s=1.0, flush_s=1.0)
+    t.join()
+    # the flush window kept the batch open long enough to coalesce both
+    assert [r.req_id for r in batch] == [0, 1]
+
+
+def test_admission_queue_close_drains_then_refuses():
+    q = AdmissionQueue(4)
+    q.offer(_req(1))
+    q.close()
+    assert not q.offer(_req(2))  # closed: refuse new
+    assert [r.req_id for r in q.take_batch(4, 0.0, 0.0)] == [1]  # drain old
+    assert q.take_batch(4, wait_s=5.0, flush_s=0.0) == []  # no hang
+
+
+# ---------------------------------------------------------------------------
+# end-to-end frontend (in-process, deterministic)
+# ---------------------------------------------------------------------------
+
+E, A = 64, 2
+
+
+@pytest.fixture()
+def frontend(tmp_path):
+    fe = ServeFrontend(E, A, durable_dir=str(tmp_path / "n0"),
+                       max_batch=8, flush_ms=1.0, queue_depth=16)
+    fe.serve()
+    yield fe
+    fe.close()
+
+
+def _addr(fe):
+    return fe._listener.getsockname()[:2]
+
+
+def test_ingest_end_to_end_and_query(frontend):
+    with ServeClient(_addr(frontend)) as c:
+        c.add(1, 2, 3)
+        c.add(5)
+        c.delete(2)
+        members, vv = c.members()
+    assert members == [1, 3, 5]
+    assert vv[0] == 5  # 4 add ticks + 1 del tick, actor 0
+    snap = frontend.recorder.snapshot()
+    assert snap["counters"]["serve.ops.acked"] == 3
+    assert snap["counters"]["serve.ops.admitted"] == 3
+    lat = snap["observations"]["serve.ingest_latency_s"]
+    assert lat["n"] == 3 and 0 < lat["p50"] <= lat["p99"]
+    assert snap["observations"]["serve.batch.occupancy"]["n"] >= 1
+
+
+def test_ingest_batch_matches_sequential_ops(tmp_path):
+    """The packed (B, E) batch apply is bitwise-identical to the same
+    requests through the host-driven per-op path (the ops/ingest.py
+    conformance pin), exercised END-TO-END through the wire."""
+    import jax
+
+    from go_crdt_playground_tpu.net.peer import Node
+
+    fe = ServeFrontend(E, A, durable_dir=str(tmp_path / "n0"),
+                       max_batch=4, flush_ms=0.5)
+    fe.serve()
+    try:
+        with ServeClient(_addr(fe)) as c:
+            c.add(3, 9, 11)
+            c.delete(9)
+            c.add(9, 20)
+            c.delete(3, 20)
+        got = fe.node.state_slice()
+    finally:
+        fe.close()
+    ref = Node(0, E, A)
+    ref.add(3, 9, 11)
+    ref.delete(9)
+    ref.add(9, 20)
+    ref.delete(3, 20)
+    want = ref.state_slice()
+    for name in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, name)), np.asarray(getattr(want, name)),
+            err_msg=name)
+    assert jax is not None
+
+
+def test_invalid_element_is_typed_reject(frontend):
+    with ServeClient(_addr(frontend)) as c:
+        with pytest.raises(protocol.InvalidOp):
+            c.add(E + 5)
+        c.add(1)  # the connection survives an invalid op
+    assert frontend.recorder.snapshot()["counters"][
+        "serve.rejects.invalid"] == 1
+
+
+def test_duplicate_elements_refused_both_ends(frontend):
+    """Review fix: an OP body is a key SET — duplicates would apply
+    set-wise through the packed batch but per-argument through the
+    reference host path (Node.add(7, 7) ticks the clock twice), so
+    identical op streams would diverge by ingress.  The client encoder
+    refuses them locally; a hand-crafted wire frame gets the typed
+    per-request reject."""
+    from go_crdt_playground_tpu.net import framing
+    from go_crdt_playground_tpu.utils import wire
+
+    with pytest.raises(ValueError, match="duplicate"):
+        protocol.encode_op(1, protocol.OP_ADD, [7, 7])
+    # wire-level: bypass the encoder's check
+    body = bytearray()
+    wire._put_varint(body, 5)          # req_id
+    body.append(protocol.OP_ADD)
+    wire._put_varint(body, 0)          # deadline
+    wire._put_varint(body, 2)          # k
+    wire._put_varint(body, 7)
+    wire._put_varint(body, 7)
+    import socket as socket_mod
+
+    raw = socket_mod.create_connection(_addr(frontend), timeout=10.0)
+    try:
+        framing.send_frame(raw, protocol.MSG_OP, bytes(body))
+        msg_type, reply = framing.recv_frame(raw, timeout=10.0)
+        assert msg_type == protocol.MSG_REJECT
+        req_id, code, reason = protocol.decode_reject(reply)
+        assert (req_id, code) == (5, protocol.REJECT_INVALID)
+        assert "duplicate" in reason
+    finally:
+        raw.close()
+
+
+def test_client_fails_fast_after_reader_death(frontend):
+    """Review fix: once the read loop exits (idle timeout / torn
+    connection) the client flips closed — a later submit raises
+    immediately instead of sending an op whose ack nothing will read."""
+    c = ServeClient(_addr(frontend))
+    c.add(1)
+    c._sock.shutdown(2)  # tear the transport under the reader
+    c._reader.join(timeout=10.0)
+    assert not c._reader.is_alive()
+    with pytest.raises(ConnectionError):
+        c.submit_async(protocol.OP_ADD, [2])
+    c.close()
+
+
+def _gate_batcher(fe):
+    """Block the batcher inside its next apply until the gate releases —
+    the deterministic way to make the admission queue back up."""
+    gate = threading.Event()
+    inner = fe.node.ingest_batch
+
+    def gated(*args, **kwargs):
+        gate.wait(10.0)
+        return inner(*args, **kwargs)
+
+    fe.node.ingest_batch = gated
+    return gate
+
+
+def test_overload_sheds_with_typed_reply(tmp_path):
+    fe = ServeFrontend(E, A, durable_dir=str(tmp_path / "n0"),
+                       max_batch=1, flush_ms=0.0, queue_depth=2)
+    gate = _gate_batcher(fe)
+    fe.serve()
+    try:
+        with ServeClient(_addr(fe)) as c:
+            # one op occupies the (gated) batcher, two fill the queue;
+            # the fourth MUST shed with the typed Overloaded reply
+            ops = [c.submit_async(protocol.OP_ADD, [i]) for i in range(3)]
+            while fe.queue.depth() < 2:
+                time.sleep(0.005)
+            with pytest.raises(protocol.Overloaded):
+                c.submit_async(protocol.OP_ADD, [7]).wait(5.0)
+            gate.set()
+            for op in ops:  # everything admitted still acks
+                op.wait(10.0)
+        snap = fe.recorder.snapshot()
+        assert snap["counters"]["serve.shed.overload"] == 1
+        assert snap["counters"]["serve.ops.acked"] == 3
+    finally:
+        gate.set()
+        fe.close()
+
+
+def test_deadline_propagation_sheds_expired(tmp_path):
+    fe = ServeFrontend(E, A, durable_dir=str(tmp_path / "n0"),
+                       max_batch=8, flush_ms=0.0, queue_depth=16)
+    gate = _gate_batcher(fe)
+    fe.serve()
+    try:
+        with ServeClient(_addr(fe)) as c:
+            hold = c.submit_async(protocol.OP_ADD, [1])  # gates the batcher
+            while fe.queue.depth() > 0:  # batcher took hold -> blocked
+                time.sleep(0.005)        # inside the gated apply
+            doomed = c.submit_async(protocol.OP_ADD, [2], deadline_s=0.01)
+            time.sleep(0.05)  # deadline passes while queued
+            gate.set()
+            with pytest.raises(protocol.DeadlineExceeded):
+                doomed.wait(10.0)
+            hold.wait(10.0)
+            members, _ = c.members()
+        assert members == [1]  # the expired op was NEVER applied
+        assert fe.recorder.snapshot()["counters"]["serve.shed.expired"] == 1
+    finally:
+        gate.set()
+        fe.close()
+
+
+def test_graceful_drain_acks_admitted_ops(tmp_path):
+    fe = ServeFrontend(E, A, durable_dir=str(tmp_path / "n0"),
+                       max_batch=4, flush_ms=0.0, queue_depth=16)
+    gate = _gate_batcher(fe)
+    fe.serve()
+    addr = _addr(fe)
+    with ServeClient(addr) as c:
+        ops = [c.submit_async(protocol.OP_ADD, [i]) for i in range(6)]
+        while fe.queue.depth() < 5:  # one op is held by the gated batcher
+            time.sleep(0.005)
+        # drain while ops are queued: a new op gets the typed Draining
+        # reject, the queued ones ack before close() returns
+        closer = threading.Thread(target=fe.close, daemon=True)
+        closer.start()
+        while not fe._draining.is_set():
+            time.sleep(0.005)
+        with pytest.raises(protocol.Draining):
+            c.submit_async(protocol.OP_ADD, [9]).wait(5.0)
+        gate.set()
+        closer.join(timeout=30.0)
+        assert not closer.is_alive()
+        for op in ops:
+            op.wait(5.0)  # already resolved: close() flushed first
+    snap = fe.recorder.snapshot()
+    assert snap["counters"]["serve.ops.acked"] == 6
+    assert snap["counters"]["serve.shed.draining"] == 1
+
+
+def test_durable_ack_survives_restart(tmp_path):
+    """fsync-before-ack, end to end: everything acked before an abrupt
+    teardown (no final checkpoint) is recovered by restore_durable from
+    the WAL alone — the §14 contract extended to the ingest path."""
+    d = str(tmp_path / "n0")
+    fe = ServeFrontend(E, A, durable_dir=d, max_batch=8, flush_ms=0.5)
+    fe.serve()
+    with ServeClient(_addr(fe)) as c:
+        c.add(1, 2, 3)
+        c.delete(2)
+        c.add(40)
+    # crash-shaped teardown: NO drain/checkpoint — the WAL is the only
+    # carrier (close the open segment handle so the file is complete)
+    fe.batcher.stop()
+    with fe.node._lock:
+        fe.node.wal.close()
+    fe.node.close()
+    fe2 = ServeFrontend(E, A, durable_dir=d)
+    assert list(fe2.node.members()) == [1, 3, 40]
+    fe2.close()
+
+
+def test_frontend_disseminates_to_peers(tmp_path):
+    """Ingested state rides the EXISTING anti-entropy path: a plain
+    net.peer.Node peer converges to the frontend's membership."""
+    from go_crdt_playground_tpu.net.peer import Node
+
+    peer = Node(1, E, A)
+    peer_addr = peer.serve("127.0.0.1", 0)
+    fe = ServeFrontend(E, A, durable_dir=str(tmp_path / "n0"),
+                       peers=[peer_addr], max_batch=8, flush_ms=0.5,
+                       sync_interval_s=0.01)
+    fe.serve()
+    try:
+        with ServeClient(_addr(fe)) as c:
+            c.add(4, 8, 15)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if list(peer.members()) == [4, 8, 15]:
+                break
+            time.sleep(0.02)
+        assert list(peer.members()) == [4, 8, 15]
+    finally:
+        fe.close()
+        peer.close()
+
+
+def test_session_send_bound_sheds_stalled_reader():
+    """Review fix: a client that stops READING its acks fills its TCP
+    window; the session's bounded write half must fail the send within
+    its timeout and flip closed — never block the (single) batcher
+    thread for the idle timeout."""
+    import socket as socket_mod
+
+    from go_crdt_playground_tpu.serve.session import Session
+
+    a, b = socket_mod.socketpair()
+    try:
+        # tiny buffers so the window fills after a few frames
+        a.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_SNDBUF, 4096)
+        b.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_RCVBUF, 4096)
+        s = Session(a, send_timeout_s=0.2)
+        body = b"x" * 8192
+        t0 = time.monotonic()
+        sends = 0
+        while s.send(protocol.MSG_ACK, body):
+            sends += 1
+            assert sends < 1000, "send never hit the stalled window"
+        elapsed = time.monotonic() - t0
+        assert s.closed
+        assert elapsed < 5.0, f"send blocked {elapsed:.1f}s despite bound"
+        assert not s.send(protocol.MSG_ACK, b"y")  # closed: instant no-op
+    finally:
+        for sock in (a, b):
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def test_poison_batch_rejects_retryable_and_keeps_serving(tmp_path):
+    """Review fix: an apply failure (transient server trouble) rejects
+    the batch's ops as RETRYABLE Overloaded — not the permanent
+    InvalidOp — and the batcher keeps serving afterwards."""
+    fe = ServeFrontend(E, A, durable_dir=str(tmp_path / "n0"),
+                       max_batch=4, flush_ms=0.5)
+    inner = fe.node.ingest_batch
+    poison = {"on": True}
+
+    def flaky(*args, **kwargs):
+        if poison["on"]:
+            raise OSError("injected disk error")
+        return inner(*args, **kwargs)
+
+    fe.node.ingest_batch = flaky
+    fe.serve()
+    try:
+        with ServeClient(_addr(fe)) as c:
+            with pytest.raises(protocol.Overloaded, match="retry"):
+                c.add(1)
+            poison["off"] = poison.pop("on")  # heal the fault
+            poison["on"] = False
+            c.add(2)  # the loop survived the poison batch
+            members, _ = c.members()
+        assert members == [2]
+        snap = fe.recorder.snapshot()
+        assert snap["counters"]["serve.batch_errors"] == 1
+    finally:
+        fe.close()
+
+
+def test_client_on_result_fires_on_connection_death():
+    """Review fix: ops resolved by the server going away must reach the
+    on_result tally (outcome unknown), not read as forever-unresolved."""
+    import socket as socket_mod
+
+    listener = socket_mod.create_server(("127.0.0.1", 0))
+    results = []
+    try:
+        c = ServeClient(listener.getsockname()[:2],
+                        on_result=results.append)
+        conn, _ = listener.accept()
+        op = c.submit_async(protocol.OP_ADD, [1])
+        conn.close()  # server dies without answering
+        with pytest.raises(ConnectionError):
+            op.wait(10.0)
+        assert len(results) == 1 and results[0] is op
+        assert isinstance(op.error, ConnectionError)
+        c.close()
+    finally:
+        listener.close()
+
+
+def test_connection_cap_sheds_excess_dials(tmp_path):
+    """Review fix: the client listener bounds its reader threads (the
+    net/peer.py _conn_slots pattern) — at capacity a new dial is shed
+    (connection dropped), and a released slot admits again."""
+    fe = ServeFrontend(E, A, durable_dir=str(tmp_path / "n0"),
+                       max_conns=2, flush_ms=0.5)
+    fe.serve()
+    try:
+        c1 = ServeClient(_addr(fe))
+        c2 = ServeClient(_addr(fe))
+        c1.add(1)
+        c2.add(2)
+        # third dial: TCP-accepted then immediately dropped by the slot
+        # gate — the first use fails with a connection error
+        c3 = ServeClient(_addr(fe))
+        with pytest.raises((ConnectionError, OSError)):
+            c3.add(3)
+        c3.close()
+        c1.close()
+        deadline = time.monotonic() + 10.0
+        c4 = None
+        while time.monotonic() < deadline:  # c1's slot frees asynchronously
+            try:
+                c4 = ServeClient(_addr(fe))
+                c4.add(4)
+                break
+            except (ConnectionError, OSError):
+                if c4 is not None:
+                    c4.close()
+                    c4 = None
+                time.sleep(0.05)
+        assert c4 is not None, "released slot never admitted a new dial"
+        c4.close()
+        c2.close()
+        assert fe.recorder.snapshot()["counters"][
+            "serve.shed.connections"] >= 1
+    finally:
+        fe.close()
+
+
+def test_oversized_frame_drops_connection(tmp_path):
+    """Review fix: a hostile length header (within framing's 1 GiB peer
+    limit but far above any legal serve frame) is refused before any
+    body byte is buffered — the connection drops, the frontend lives."""
+    import socket as socket_mod
+
+    from go_crdt_playground_tpu.net import framing
+    from go_crdt_playground_tpu.utils import wire
+
+    fe = ServeFrontend(E, A, durable_dir=str(tmp_path / "n0"))
+    fe.serve()
+    try:
+        raw = socket_mod.create_connection(_addr(fe), timeout=10.0)
+        head = bytearray(framing.MAGIC)
+        head.append(protocol.MSG_OP)
+        wire._put_varint(head, 64 << 20)  # declares a 64 MiB body
+        raw.sendall(bytes(head))
+        assert raw.recv(1) == b""  # server dropped us without buffering
+        raw.close()
+        # the frontend still serves
+        with ServeClient(_addr(fe)) as c:
+            c.add(1)
+            assert c.members()[0] == [1]
+    finally:
+        fe.close()
+
+
+def test_close_is_idempotent_and_queryable_metrics(tmp_path):
+    fe = ServeFrontend(E, A, durable_dir=str(tmp_path / "n0"))
+    fe.serve()
+    fe.close()
+    fe.close()  # second close is a no-op, not an error
+    assert os.path.isdir(str(tmp_path / "n0"))

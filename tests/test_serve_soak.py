@@ -1,0 +1,60 @@
+"""CI wrapper for the serve-frontend load soak (tools/serve_soak.py).
+
+Mirrors the chaos/crash soak wrappers: the --quick sweep must complete
+with the acceptance shape — goodput scaling below the admission limit,
+typed Overloaded shedding (not silent drops, not latency collapse)
+beyond it, and ZERO acked-op loss across both SIGKILL flavors (the
+deterministic between-WAL-fsync-and-ack window hook, and a parent-timed
+mid-load kill).  slow-marked: it spawns real `serve --ingest`
+subprocesses and SIGKILLs them, so tier-1 runtime never pays for it.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+
+@pytest.mark.slow
+def test_serve_soak_quick_mode(tmp_path):
+    import serve_soak
+
+    out = str(tmp_path / "SERVE_CURVE.json")
+    rc = serve_soak.main(["--quick", "--out", out])
+    assert rc == 0, "serve soak failed (goodput shape, unbounded p99, " \
+                    "missing shed, or acked-op loss)"
+    with open(out) as f:
+        artifact = json.load(f)
+
+    open_curve = artifact["open_loop"]
+    assert len(open_curve) >= 3
+    # (a) goodput scales with offered load until the admission limit
+    assert open_curve[-1]["goodput"] > open_curve[0]["goodput"] * 1.5
+    assert open_curve[0]["goodput"] >= \
+        0.8 * open_curve[0]["achieved_offer_rate"]
+    # (b) beyond it: typed Overloaded shedding, bounded SERVER-side p99
+    top = open_curve[-1]
+    assert top["shed_overloaded"] > 0, \
+        "the overload leg never shed — admission control untested"
+    assert top["server"]["ingest_p99_ms"] < 2000.0
+    # sheds are TYPED, not silent: every submitted op is accounted for
+    for leg in open_curve:
+        accounted = (leg["acked"] + leg["shed_overloaded"]
+                     + leg["shed_expired"] + leg["other_failures"])
+        assert accounted == leg["submitted"], leg
+        assert leg["unresolved"] == 0, leg
+
+    # (c) the crash cycles: both kill flavors landed, nothing acked was
+    # lost, nothing unsubmitted appeared (the ingest-window contract)
+    crash = artifact["crash"]
+    assert crash["kills"]["window_hook"] >= 1, \
+        "the between-WAL-fsync-and-ack window kill never landed"
+    assert crash["kills"]["parent_sigkill"] >= 1
+    assert crash["lost_acked_ops"] == []
+    assert crash["phantom_members"] == []
+    assert crash["unfinished"] == []
+    assert crash["acked_ops"] == crash["elements"]

@@ -1,0 +1,562 @@
+#!/usr/bin/env python
+"""Serve-frontend load soak: offered load vs goodput vs p99 vs shed rate.
+
+CHAOS_CURVE.json proves the wire stack survives networks,
+CRASH_CURVE.json that the durability layer survives machines; this tool
+proves the SERVING frontend (serve/, DESIGN.md §16) holds its SLO shape
+under load and its durability contract under SIGKILL:
+
+* **open loop** — a paced generator offers ops at fixed rates against a
+  real ``serve --ingest`` subprocess; goodput must scale with offered
+  load up to the admission limit, and BEYOND it the frontend must shed
+  with typed ``Overloaded`` replies while p99 stays bounded (the bounded
+  admission queue converts excess load into rejects, not latency
+  collapse).
+* **closed loop** — synchronous submitters at increasing concurrency:
+  the per-op latency a well-behaved client actually experiences.
+* **crash** — an add-only workload with a client-side acked-op ledger.
+  Kill one: the ``CRDT_SERVE_CRASH_AFTER_BATCHES`` hook SIGKILLs the
+  worker EXACTLY between a batch's WAL fsync and its acks (the
+  narrowest window of the fsync-before-ack contract).  Kill two: the
+  parent SIGKILLs mid-load at a random moment.  After each restart
+  (``ServeFrontend`` → ``Node.restore_durable``: checkpoint ⊔ WAL tail)
+  the generator resubmits every unacknowledged op (idempotent), and the
+  final adjudication is the §14 contract extended to ingest: every
+  ACKED op is in the final membership (zero acked-op loss) and every
+  member was actually submitted (no phantom applies).
+
+Output: SERVE_CURVE.json next to the other curves.
+
+Usage:
+    python tools/serve_soak.py            # full sweep
+    python tools/serve_soak.py --quick    # CI-sized (slow-marked pytest
+                                          # wraps this mode)
+    python tools/serve_soak.py --out P    # default SERVE_CURVE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from go_crdt_playground_tpu.serve import protocol  # noqa: E402
+from go_crdt_playground_tpu.serve.client import ServeClient  # noqa: E402
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _pctl(values: List[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    xs = sorted(values)
+    return xs[min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))]
+
+
+class Worker:
+    """One ``serve --ingest`` subprocess (the REAL CLI, not an import)."""
+
+    def __init__(self, dirpath: str, port: int, elements: int, *,
+                 queue_depth: int, max_batch: int, flush_ms: float,
+                 crash_after_batches: Optional[int] = None):
+        self.dirpath = dirpath
+        self.port = port
+        os.makedirs(dirpath, exist_ok=True)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        if crash_after_batches is not None:
+            env["CRDT_SERVE_CRASH_AFTER_BATCHES"] = str(crash_after_batches)
+        else:
+            env.pop("CRDT_SERVE_CRASH_AFTER_BATCHES", None)
+        self.log = open(os.path.join(dirpath, "worker.log"), "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "go_crdt_playground_tpu", "serve",
+             "--ingest", "--port", str(port),
+             "--elements", str(elements), "--actors", "4",
+             "--durable-dir", os.path.join(dirpath, "state"),
+             "--queue-depth", str(queue_depth),
+             "--max-batch", str(max_batch),
+             "--flush-ms", str(flush_ms), "--checkpoint-every", "0"],
+            env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=self.log)
+        # the pump thread inside _await_address keeps draining stdout
+        # afterwards, so the drain summary can't block the pipe.  On a
+        # failed start, contain the orphan: a still-running worker would
+        # hold the (reused) crash-leg port and a CPU core past the soak.
+        try:
+            self.addr = self._await_address()
+        except Exception:
+            if self.proc.poll() is None:
+                self.proc.kill()
+                self.proc.wait()
+            self.log.close()
+            raise
+
+    def _await_address(self) -> Tuple[str, int]:
+        # readline() through a thread + queue: a worker wedged BEFORE
+        # printing (import deadlock, warmup stall) keeps the pipe open
+        # without writing, and a bare readline would block past any
+        # deadline check — the tests/test_cli.py pattern
+        import queue as queue_mod
+
+        lines: "queue_mod.Queue[bytes]" = queue_mod.Queue()
+
+        def pump() -> None:
+            while True:
+                line = self.proc.stdout.readline()
+                lines.put(line)
+                if not line:
+                    return
+
+        threading.Thread(target=pump, daemon=True).start()
+        deadline = time.time() + 120
+        while True:
+            try:
+                line = lines.get(timeout=max(0.1, deadline - time.time()))
+            except queue_mod.Empty:
+                raise RuntimeError("worker printed no address within 120s")
+            if not line:
+                raise RuntimeError(
+                    f"worker exited before address (rc={self.proc.poll()})")
+            m = re.search(rb"listening on ([\d.]+):(\d+)", line)
+            if m:
+                return m.group(1).decode(), int(m.group(2))
+            if time.time() > deadline:
+                raise RuntimeError(f"no address line within 120s: {line!r}")
+
+    def sigkill(self) -> None:
+        if self.proc.poll() is None:
+            os.kill(self.proc.pid, signal.SIGKILL)
+        self.proc.wait()
+
+    def wait_dead(self, timeout: float = 120.0) -> int:
+        return self.proc.wait(timeout=timeout)
+
+    def terminate(self) -> int:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                return self.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                return self.proc.wait()
+        return self.proc.returncode
+
+    def close_log(self) -> None:
+        self.log.close()
+
+
+class _Tally:
+    """Thread-safe completion tally for one load leg."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.latencies: List[float] = []  # guarded-by: lock
+        self.acked = 0  # guarded-by: lock
+        self.overloaded = 0  # guarded-by: lock
+        self.expired = 0  # guarded-by: lock
+        self.other = 0  # guarded-by: lock
+
+    def on_result(self, op) -> None:
+        with self.lock:
+            if op.acked:
+                self.acked += 1
+                self.latencies.append(op.latency_s)
+            elif isinstance(op.error, protocol.Overloaded):
+                self.overloaded += 1
+            elif isinstance(op.error, protocol.DeadlineExceeded):
+                self.expired += 1
+            else:
+                self.other += 1
+
+
+def open_loop_leg(addr, rate: float, duration_s: float, elements: int,
+                  n_conns: int = 4, deadline_s: float = 1.0,
+                  del_every: int = 10) -> Dict[str, object]:
+    """Offer ops at ``rate`` for ``duration_s`` (pipelined, paced);
+    measure goodput/shed/latency from the client side."""
+    tally = _Tally()
+    clients = [ServeClient(addr, timeout=30.0, on_result=tally.on_result)
+               for _ in range(n_conns)]
+    submitted = 0
+    send_errors = 0
+    t0 = time.monotonic()
+    try:
+        i = 0
+        while True:
+            now = time.monotonic()
+            if now - t0 >= duration_s:
+                break
+            target_t = t0 + i / rate
+            if target_t > now:
+                time.sleep(target_t - now)
+            kind = (protocol.OP_DEL if del_every and i % del_every == 9
+                    else protocol.OP_ADD)
+            try:
+                clients[i % n_conns].submit_async(
+                    kind, [i % elements], deadline_s=deadline_s)
+                submitted += 1
+            except (OSError, ConnectionError):
+                send_errors += 1
+            i += 1
+        elapsed = time.monotonic() - t0  # offer window (goodput basis)
+        # grace: let EVERY in-flight op resolve before reading the tally
+        # (a saturating leg parks ops in kernel socket buffers; the
+        # server drains them at its own pace — wait while it makes
+        # progress, so the next leg starts against an idle frontend and
+        # the shed accounting is complete, never "lost in a buffer")
+        grace_cap = time.monotonic() + 120.0
+        last_done, last_progress = -1, time.monotonic()
+        while time.monotonic() < grace_cap:
+            with tally.lock:
+                done = (tally.acked + tally.overloaded + tally.expired
+                        + tally.other)
+            if done >= submitted:
+                break
+            if done > last_done:
+                last_done, last_progress = done, time.monotonic()
+            elif time.monotonic() - last_progress > 10.0:
+                break  # stalled: count the remainder as unresolved
+            time.sleep(0.05)
+    finally:
+        for c in clients:
+            c.close()
+    # server-side SLO read-out (cumulative since worker start): the
+    # admission queue bounds the ADMITTED ops' latency; client-observed
+    # latency under an abusive open loop also includes kernel-socket
+    # wait the server cannot bound (queueing theory, not a defect)
+    server = None
+    try:
+        with ServeClient(addr, timeout=30.0) as sc:
+            snap = sc.stats()
+        lat = snap["observations"].get("serve.ingest_latency_s", {})
+        server = {
+            "ingest_p50_ms": _r(lat.get("p50")),
+            "ingest_p99_ms": _r(lat.get("p99")),
+            "acked_total": snap["counters"].get("serve.ops.acked", 0),
+            "shed_overload_total": snap["counters"].get(
+                "serve.shed.overload", 0),
+            "batch_occupancy_mean": round(
+                snap["observations"].get("serve.batch.occupancy", {})
+                .get("mean", 0.0), 2),
+        }
+    except (OSError, ConnectionError):
+        pass
+    with tally.lock:
+        shed = tally.overloaded
+        resolved = tally.acked + shed + tally.expired + tally.other
+        return {
+            "offered_rate": rate,
+            "achieved_offer_rate": round(submitted / elapsed, 1),
+            "submitted": submitted,
+            "goodput": round(tally.acked / elapsed, 1),
+            "acked": tally.acked,
+            "shed_overloaded": shed,
+            "shed_expired": tally.expired,
+            "other_failures": tally.other,
+            # ops whose submit itself raised: never counted in
+            # `submitted`, so kept OUT of the resolved/submitted
+            # accounting identity
+            "send_errors": send_errors,
+            "unresolved": submitted - resolved,
+            "shed_rate": round(shed / submitted, 4) if submitted else 0.0,
+            "p50_ms": _r(_pctl(tally.latencies, 0.50)),
+            "p95_ms": _r(_pctl(tally.latencies, 0.95)),
+            "p99_ms": _r(_pctl(tally.latencies, 0.99)),
+            "server": server,  # cumulative-since-start SLO snapshot
+        }
+
+
+def _r(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v * 1e3, 2)
+
+
+def closed_loop_leg(addr, concurrency: int, duration_s: float,
+                    elements: int) -> Dict[str, object]:
+    """``concurrency`` synchronous submitters, each one op in flight."""
+    stop = threading.Event()
+    lock = threading.Lock()
+    latencies: List[float] = []  # guarded-by: lock
+    failures = [0]
+
+    def run(worker_id: int) -> None:
+        try:
+            with ServeClient(addr, timeout=30.0) as c:
+                i = worker_id
+                while not stop.is_set():
+                    try:
+                        lat = c.add(i % elements)
+                    except protocol.ServeError:
+                        with lock:
+                            failures[0] += 1
+                        continue
+                    with lock:
+                        latencies.append(lat)
+                    i += concurrency
+        except (OSError, ConnectionError):
+            with lock:
+                failures[0] += 1
+
+    threads = [threading.Thread(target=run, args=(w,), daemon=True)
+               for w in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    elapsed = time.monotonic() - t0
+    with lock:
+        return {
+            "concurrency": concurrency,
+            "goodput": round(len(latencies) / elapsed, 1),
+            "acked": len(latencies),
+            "failures": failures[0],
+            "p50_ms": _r(_pctl(latencies, 0.50)),
+            "p99_ms": _r(_pctl(latencies, 0.99)),
+        }
+
+
+# ---------------------------------------------------------------------------
+# crash leg
+# ---------------------------------------------------------------------------
+
+
+def crash_leg(root: str, elements: int, *, queue_depth: int,
+              max_batch: int, flush_ms: float, window_batches: int,
+              seed: int) -> Dict[str, object]:
+    """Add-only ledgered workload across two SIGKILL+restart cycles (see
+    module docstring).  Returns the adjudication."""
+    import random
+
+    rng = random.Random(seed)
+    port = _free_port()
+    dirpath = os.path.join(root, "crash")
+    os.makedirs(dirpath, exist_ok=True)
+    acked: Set[int] = set()
+    submitted: Set[int] = set()
+    kills = {"window_hook": 0, "parent_sigkill": 0}
+
+    def submit_all(worker: Worker, todo: List[int],
+                   kill_at: Optional[int] = None) -> bool:
+        """Synchronously submit each element once; False = the worker
+        died mid-stream (expected for a kill cycle)."""
+        try:
+            client = ServeClient(worker.addr, timeout=30.0)
+        except (OSError, ConnectionError):
+            return False
+        try:
+            for n, e in enumerate(todo):
+                if kill_at is not None and n == kill_at:
+                    kills["parent_sigkill"] += 1
+                    worker.sigkill()
+                submitted.add(e)
+                try:
+                    client.add(e, deadline_s=5.0)
+                except (protocol.ServeError, OSError, ConnectionError,
+                        socket.timeout):
+                    return False  # outcome unknown -> stays un-acked
+                acked.add(e)
+            return True
+        finally:
+            client.close()
+
+    todo = list(range(elements))
+    rng.shuffle(todo)
+
+    # cycle 1: the deterministic between-fsync-and-ack window — the
+    # worker SIGKILLs ITSELF right after batch #window_batches' WAL
+    # fsync, before any of that batch's acks go out
+    w = Worker(dirpath, port, elements, queue_depth=queue_depth,
+               max_batch=max_batch, flush_ms=flush_ms,
+               crash_after_batches=window_batches)
+    finished = submit_all(w, todo)
+    if finished and w.proc.poll() is None:
+        w.terminate()  # hook never fired; the rc check below fails the run
+        rc = 0
+    else:
+        rc = w.wait_dead()
+    w.close_log()
+    window_fired = (not finished) and rc == -signal.SIGKILL
+    if window_fired:
+        kills["window_hook"] += 1
+
+    # cycle 2: restart (restore_durable under the hood), resubmit
+    # everything not acked, with a parent-timed SIGKILL mid-stream
+    remaining = [e for e in todo if e not in acked]
+    w = Worker(dirpath, port, elements, queue_depth=queue_depth,
+               max_batch=max_batch, flush_ms=flush_ms)
+    kill_at = rng.randrange(max(1, len(remaining) // 2)) + 1 \
+        if remaining else None
+    submit_all(w, remaining, kill_at=kill_at)
+    if w.proc.poll() is None:
+        # the stream ended before kill_at (everything acked first):
+        # still exercise the parent-SIGKILL flavor, mid-idle
+        kills["parent_sigkill"] += 1
+        w.sigkill()
+    w.wait_dead()
+    w.close_log()
+
+    # cycle 3: final restart, finish the workload, read membership
+    remaining = [e for e in todo if e not in acked]
+    w = Worker(dirpath, port, elements, queue_depth=queue_depth,
+               max_batch=max_batch, flush_ms=flush_ms)
+    submit_all(w, remaining)
+    with ServeClient(w.addr, timeout=60.0) as client:
+        members, vv = client.members()
+    w.terminate()
+    w.close_log()
+
+    members_set = set(members)
+    lost_acked = sorted(acked - members_set)
+    phantom = sorted(members_set - submitted)
+    return {
+        "elements": elements,
+        "kills": kills,
+        "window_batches": window_batches,
+        "window_kill_landed": window_fired,
+        "acked_ops": len(acked),
+        "submitted_ops": len(submitted),
+        "final_members": len(members_set),
+        "lost_acked_ops": lost_acked,      # MUST be [] — fsync'd ack lost
+        "phantom_members": phantom,        # MUST be [] — unsubmitted apply
+        "unfinished": sorted(set(todo) - acked),
+    }
+
+
+# ---------------------------------------------------------------------------
+# sweep
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized sweep (the slow-marked pytest wrapper)")
+    ap.add_argument("--out", default=os.path.join(REPO, "SERVE_CURVE.json"))
+    ap.add_argument("--seed", type=int, default=23)
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        elements = 192
+        rates = [200.0, 1000.0, 6000.0]
+        duration_s = 3.0
+        concurrencies = [1, 4]
+        closed_s = 2.0
+        window_batches = 6
+    else:
+        elements = 384
+        rates = [200.0, 800.0, 2500.0, 8000.0]
+        duration_s = 6.0
+        concurrencies = [1, 4, 16]
+        closed_s = 4.0
+        window_batches = 10
+
+    queue_depth, max_batch, flush_ms = 128, 32, 2.0
+    t0 = time.time()
+    root = tempfile.mkdtemp(prefix="serve-soak-")
+    open_curve: List[Dict] = []
+    closed_curve: List[Dict] = []
+    try:
+        # one long-lived worker serves both throughput legs
+        w = Worker(os.path.join(root, "load"), _free_port(), elements,
+                   queue_depth=queue_depth, max_batch=max_batch,
+                   flush_ms=flush_ms)
+        try:
+            for rate in rates:
+                leg = open_loop_leg(w.addr, rate, duration_s, elements)
+                open_curve.append(leg)
+                print(json.dumps(leg), flush=True)
+            for conc in concurrencies:
+                leg = closed_loop_leg(w.addr, conc, closed_s, elements)
+                closed_curve.append(leg)
+                print(json.dumps(leg), flush=True)
+        finally:
+            w.terminate()
+            w.close_log()
+        crash = crash_leg(root, elements, queue_depth=queue_depth,
+                          max_batch=max_batch, flush_ms=flush_ms,
+                          window_batches=window_batches, seed=args.seed)
+        print(json.dumps({"crash": {k: crash[k] for k in
+                                    ("kills", "acked_ops",
+                                     "lost_acked_ops",
+                                     "phantom_members")}}), flush=True)
+    finally:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+    peak = max((e["goodput"] for e in open_curve + closed_curve),
+               default=0.0)
+    artifact = {
+        "metric": ("op-ingest frontend: offered load vs goodput vs p99 vs "
+                   "typed-shed rate (open+closed loop against a real "
+                   "`serve --ingest` subprocess), plus zero acked-op loss "
+                   "across SIGKILL+restart incl. the between-WAL-fsync-"
+                   "and-ack window"),
+        "value": peak,
+        "unit": "acked ops/s (peak goodput)",
+        "server": {"elements": elements, "queue_depth": queue_depth,
+                   "max_batch": max_batch, "flush_ms": flush_ms,
+                   "durable_fsync": True, "quick": bool(args.quick)},
+        "open_loop": open_curve,
+        "closed_loop": closed_curve,
+        "crash": crash,
+        "elapsed_s": round(time.time() - t0, 1),
+        "platform": "cpu",
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"wrote {args.out}")
+
+    # honest exit — the acceptance shape, adjudicated:
+    # (a) goodput scales with offered load below the admission limit
+    low, high = open_curve[0], open_curve[-1]
+    ok = high["goodput"] > low["goodput"] * 1.5
+    ok = ok and low["goodput"] >= 0.8 * low["achieved_offer_rate"]
+    # every submitted op resolved to ack or a TYPED reject — a shed
+    # that vanishes into a buffer is a silent drop with extra steps
+    ok = ok and all(e["unresolved"] == 0 for e in open_curve)
+    # (b) past the limit the frontend SHEDS (typed Overloaded) and the
+    # SERVER-side ingest p99 stays bounded — the bounded admission queue
+    # converts excess offered load into rejects, not admitted-op latency
+    # (client-observed latency additionally holds kernel-socket wait an
+    # overloaded-by-construction open loop always accrues; it is
+    # reported, not adjudicated)
+    ok = ok and high["shed_overloaded"] > 0
+    ok = ok and high["server"] is not None \
+        and high["server"]["ingest_p99_ms"] is not None \
+        and high["server"]["ingest_p99_ms"] < 2000.0
+    # (c) the crash cycles lost nothing acked and applied nothing
+    # phantom, and both kill flavors actually landed
+    ok = ok and crash["lost_acked_ops"] == []
+    ok = ok and crash["phantom_members"] == []
+    ok = ok and crash["kills"]["window_hook"] >= 1
+    ok = ok and crash["kills"]["parent_sigkill"] >= 1
+    ok = ok and crash["unfinished"] == []
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
