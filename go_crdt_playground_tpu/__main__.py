@@ -18,6 +18,14 @@ a fleet-scale taste:
                                               # durable acks, SLO metrics
                                               # (DESIGN.md §16; SIGTERM/
                                               # ctrl-C drains gracefully)
+  python -m go_crdt_playground_tpu router --serve --shard s0=H:P ...
+                                              # consistent-hash router tier
+                                              # over N ingest frontends
+                                              # (DESIGN.md §17); without
+                                              # --serve: print the seeded
+                                              # owner-map digest and exit
+                                              # (cross-process routing
+                                              # determinism probe)
 """
 
 from __future__ import annotations
@@ -145,6 +153,64 @@ def _cmd_serve_ingest(args) -> int:
     return 0
 
 
+def _cmd_router(args) -> int:
+    """The shard-router tier (DESIGN.md §17): serve the EXISTING client
+    dialect over N shard frontends, or — without ``--serve`` — print
+    the seeded owner-map digest + per-shard loads and exit, so two
+    operators (or a test and a subprocess) can assert they route
+    identically before any traffic moves."""
+    from go_crdt_playground_tpu.shard.ring import HashRing, load_stats
+
+    sids = [sid for sid, _ in args.shard]
+    if len(set(sids)) != len(sids):
+        # dict() below would silently keep the LAST addr per id —
+        # exactly the operator typo HashRing's duplicate check exists
+        # to catch, so refuse before the dict can swallow it
+        dupes = sorted({s for s in sids if sids.count(s) > 1})
+        print(f"error: duplicate shard id(s) {dupes} in --shard flags",
+              file=sys.stderr, flush=True)
+        return 2
+    shards = dict(args.shard)
+    if not args.serve:
+        ring = HashRing(list(shards), seed=args.seed)
+        # ONE owner-map sweep shared by the load split and the digest
+        # (it is the dry-run's dominant cost: E x shards blake2b)
+        owners = ring.owner_map(args.elements)
+        stats = load_stats(owners, len(ring.shards))
+        print(f"owner-map digest {ring.digest(args.elements, owners)} "
+              f"(shards={list(ring.shards)} seed={args.seed} "
+              f"E={args.elements}) loads={stats['loads']} "
+              f"max/mean={stats['max_over_mean']:.3f}", flush=True)
+        return 0
+
+    import signal
+    import threading
+
+    from go_crdt_playground_tpu.shard.router import ShardRouter
+
+    router = ShardRouter(shards, args.elements, seed=args.seed)
+    # the banner's load split reuses the router's OWN precomputed owner
+    # map — recomputing it here would double the O(E x shards) blake2b
+    # startup cost for a log line
+    stats = load_stats(router._owner, len(router.ring.shards))
+    host, bound = router.serve(port=args.port)
+    print(f"Shard router listening on {host}:{bound} "
+          f"(E={args.elements} shards={list(router.ring.shards)} "
+          f"seed={args.seed} loads={stats['loads']})", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    router.close()
+    snap = router.recorder.snapshot()
+    fwd = snap["counters"].get("router.ops.forwarded", 0)
+    acks = snap["counters"].get("router.acks.relayed", 0)
+    print(f"drained: {fwd} ops forwarded, {acks} acks relayed", flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="go_crdt_playground_tpu")
     p.add_argument("--platform", default="auto",
@@ -217,6 +283,30 @@ def main(argv=None) -> int:
                    default=50,
                    help="durable checkpoint cadence in supervisor rounds "
                         "(0 = only the final drain checkpoint)")
+
+    def _shard_spec(text: str):
+        sid, _, addr = text.partition("=")
+        host, _, port = addr.rpartition(":")
+        if not sid or not host or not port.isdigit():
+            raise argparse.ArgumentTypeError(
+                f"shard must be ID=HOST:PORT, got {text!r}")
+        return sid, (host, int(port))
+
+    r = sub.add_parser("router")
+    r.add_argument("--serve", action="store_true",
+                   help="serve the router tier (omit to print the "
+                        "seeded owner-map digest and exit)")
+    r.add_argument("--port", type=int, default=0)
+    r.add_argument("--elements", type=int, default=1024,
+                   help="fleet-wide element universe E (must match the "
+                        "shards')")
+    r.add_argument("--seed", type=int, default=0,
+                   help="ring seed: same (shards, seed, E) routes "
+                        "identically in ANY process")
+    r.add_argument("--shard", action="append", default=[],
+                   type=_shard_spec, metavar="ID=HOST:PORT", required=True,
+                   help="one shard frontend (repeatable; order does not "
+                        "affect routing)")
     args = p.parse_args(argv)
     if args.platform != "auto":
         import jax
@@ -237,6 +327,8 @@ def main(argv=None) -> int:
         if args.ingest:
             return _cmd_serve_ingest(args)
         return _cmd_serve(args.port)
+    if args.cmd == "router":
+        return _cmd_router(args)
     return 2
 
 

@@ -38,11 +38,14 @@ PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # pass targets, package-relative (DESIGN.md §15 pass catalog; the
 # serve/ files are the PR-5 serving frontend — its admission queue,
 # session writers, batcher, and client are all multi-threaded shared
-# state, so the guarded-by sweep covers them like the sync runtime)
+# state, so the guarded-by sweep covers them like the sync runtime;
+# the shard/ files are the router tier — its per-shard links, relay
+# fan-in, and fleet runner cross as many threads as the frontend does)
 LOCK_TARGETS = ["net/peer.py", "net/antientropy.py", "utils/wal.py",
                 "serve/admission.py", "serve/session.py",
                 "serve/batcher.py", "serve/frontend.py",
-                "serve/client.py", "obs/metrics.py"]
+                "serve/client.py", "obs/metrics.py",
+                "shard/ring.py", "shard/router.py", "shard/fleet.py"]
 # extra files that participate in the lock-ORDER graph (their locks can
 # nest under the runtime's)
 LOCK_ORDER_EXTRA = ["utils/checkpoint.py"]
@@ -56,7 +59,9 @@ ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "recorder": "Recorder", "_store": "CheckpointStore",
                 "breaker": "CircuitBreaker", "queue": "AdmissionQueue",
                 "session": "Session", "batcher": "MicroBatcher",
-                "supervisor": "SyncSupervisor"}
+                "supervisor": "SyncSupervisor", "target": "Node",
+                "ring": "HashRing", "router": "ShardRouter",
+                "relay": "_Relay", "_client": "ServeClient"}
 
 
 def _paths(rel: List[str], root: str) -> List[str]:

@@ -1,0 +1,42 @@
+"""The batcher's pluggable apply target (the sharded-fleet unlock).
+
+``MicroBatcher`` used to be hard-wired to a local ``net/peer.Node``;
+everything it actually NEEDS is this protocol: the element universe (to
+shape the packed ``(B, E)`` selector pair), an actor id (thread
+naming/diagnostics), and one durable group-commit apply.  With the
+dependency narrowed to the protocol, the whole serving frontend —
+listener, admission queue, batcher, drain sequence — is reusable
+unchanged in front of ANY replica flavor: the local node it fronts
+today (each shard of the fleet runs one, shard/fleet.py), a
+mesh-sharded replica driven over ``NamedSharding`` next, or a remote
+shard proxy.
+
+The durability contract RIDES the protocol: ``ingest_batch`` must not
+return until the batch's effects are as durable as the deployment
+claims (for a WAL-backed node: state applied AND the batch δ fsync'd),
+because the batcher sends acks immediately after it returns —
+DESIGN.md §16's fsync-before-ack, hinged here.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+import numpy as np
+
+
+@runtime_checkable
+class ApplyTarget(Protocol):
+    """What the micro-batcher requires of the replica it feeds.
+    ``net/peer.Node`` satisfies it as-is (the local target)."""
+
+    num_elements: int
+    actor: int
+
+    def ingest_batch(self, add_rows: np.ndarray, del_rows: np.ndarray,
+                     live: np.ndarray) -> None:
+        """Apply one packed ``(B, E)`` op-batch; row ``b`` is request
+        b's Add/Del key selector, ``live`` masks padding rows.
+        durable-on-return: the batcher acks the batch's ops the moment
+        this returns."""
+        ...

@@ -3,10 +3,12 @@
 The serving core (inference-serving shape): a single batcher thread
 drains the admission queue on time/size watermarks
 (``AdmissionQueue.take_batch``), coalesces the drained ops into one
-packed ``(B, E)`` tensor pair, applies them with ONE compiled dispatch
-+ ONE WAL fsync (``Node.ingest_batch`` — the group commit), and only
-then acks each op.  Under load the fsync and dispatch costs amortize
-over whole batches; idle, a lone op pays at most the flush watermark.
+packed ``(B, E)`` tensor pair, applies them with ONE durable
+group-commit call on its ``ApplyTarget`` (serve/apply.py — a local
+``Node``'s compiled dispatch + WAL fsync today; a sharded or remote
+replica behind the same protocol tomorrow), and only then acks each
+op.  Under load the fsync and dispatch costs amortize over whole
+batches; idle, a lone op pays at most the flush watermark.
 
 Deadline propagation happens at BUILD time: an op whose absolute
 deadline passed while queued is shed with a typed ``REJECT_EXPIRED``
@@ -47,13 +49,15 @@ _CRASH_ENV = "CRDT_SERVE_CRASH_AFTER_BATCHES"
 class MicroBatcher:
     """One thread turning queued ops into packed durable batches."""
 
-    def __init__(self, node, queue: AdmissionQueue, *,
+    def __init__(self, target, queue: AdmissionQueue, *,
                  max_batch: int = 32, flush_s: float = 0.002,
                  idle_wait_s: float = 0.05, recorder=None,
                  clock: Callable[[], float] = time.monotonic):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
-        self.node = node
+        # anything satisfying serve/apply.ApplyTarget (ingest_batch
+        # must be durable-on-return: acks follow immediately)
+        self.target = target
         self.queue = queue
         self.max_batch = max_batch
         self.flush_s = flush_s
@@ -85,7 +89,8 @@ class MicroBatcher:
             raise RuntimeError("batcher already running")
         self._stop.clear()
         self._thread = threading.Thread(
-            target=self._loop, name=f"serve-batcher-{self.node.actor}",
+            target=self._loop,
+            name=f"serve-batcher-{getattr(self.target, 'actor', '?')}",
             daemon=True)
         self._thread.start()
 
@@ -163,7 +168,7 @@ class MicroBatcher:
             return
         # one packed (B, E) pair, B static = max_batch so every
         # occupancy reuses one compiled program (ops/ingest.ingest_rows)
-        E = self.node.num_elements
+        E = self.target.num_elements
         add_rows = np.zeros((self.max_batch, E), bool)
         del_rows = np.zeros((self.max_batch, E), bool)
         live_mask = np.zeros(self.max_batch, bool)
@@ -174,7 +179,7 @@ class MicroBatcher:
         t0 = self._clock()
         try:
             # durable on return: state applied + batch δ WAL-fsync'd
-            self.node.ingest_batch(add_rows, del_rows, live_mask)
+            self.target.ingest_batch(add_rows, del_rows, live_mask)
         except Exception as e:  # noqa: BLE001 — poison batch: reject
             # its (not-yet-replied) ops as RETRYABLE — an apply failure
             # is transient server trouble (disk error, kernel fault),

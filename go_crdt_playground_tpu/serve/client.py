@@ -77,10 +77,16 @@ class ServeClient:
     """One pipelined connection to a ``ServeFrontend``."""
 
     def __init__(self, addr: Tuple[str, int], timeout: float = 30.0,
-                 on_result: Optional[Callable[[PendingOp], None]] = None):
+                 on_result: Optional[Callable[[PendingOp], None]] = None,
+                 connect_timeout: Optional[float] = None):
+        """``connect_timeout`` bounds the DIAL separately from the
+        reply ``timeout`` (a router probing a blackholed shard needs a
+        short dial bound without shortening reply waits)."""
         self.timeout = timeout
         self._on_result = on_result
-        self._sock = socket.create_connection(addr, timeout=timeout)
+        self._sock = socket.create_connection(
+            addr, timeout=timeout if connect_timeout is None
+            else connect_timeout)
         self._sock.settimeout(timeout)
         self._wlock = threading.Lock()
         self._lock = threading.Lock()

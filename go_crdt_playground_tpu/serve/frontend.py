@@ -172,6 +172,16 @@ class ServeFrontend:
         self._draining.set()
         listener = self._listener
         if listener is not None:
+            # shutdown BEFORE close (the session.py lesson, for the
+            # LISTENER): a bare close does not reliably wake the accept
+            # loop blocked in accept(), and until it wakes the kernel
+            # keeps completing new dials into the backlog — "stop
+            # accepting dials" must mean refused, not accepted-then-
+            # Draining
+            try:
+                listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 listener.close()
             except OSError:
@@ -201,8 +211,15 @@ class ServeFrontend:
         with self._lock:
             sessions = list(self._sessions)
             self._sessions.clear()
+        # flush: the batcher's final acks are in per-session writer
+        # queues (serve/session.py); give the writers ONE shared
+        # bounded window to get them onto the wire before teardown — a
+        # shared deadline, not per-session, so a herd of stalled
+        # clients costs ~2s total, never sessions x 2s
+        flush_deadline = time.monotonic() + 2.0
         for s in sessions:
-            s.close()
+            s.close(flush_timeout_s=max(
+                0.0, flush_deadline - time.monotonic()))
         self._closed.set()
 
     def __enter__(self) -> "ServeFrontend":

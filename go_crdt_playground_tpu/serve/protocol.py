@@ -24,8 +24,10 @@ instead of applying it late — deadline propagation, not server-side
 guessing.  ``REJECT`` is the typed load-shed reply (never a silent
 drop): ``REJECT_OVERLOADED`` (admission queue full), ``REJECT_EXPIRED``
 (deadline passed before apply), ``REJECT_DRAINING`` (shutdown in
-progress), ``REJECT_INVALID`` (element id outside the universe).  Each
-maps to a typed client-side exception below.
+progress), ``REJECT_INVALID`` (element id outside the universe),
+``REJECT_UNAVAILABLE`` (the routed shard owning the keyspace is
+unreachable — shard/router.py degradation, DESIGN.md §17).  Each maps
+to a typed client-side exception below.
 
 An ``ACK`` is only ever sent AFTER the op's effects are fsync'd in the
 replica's delta WAL (``Node.ingest_batch`` group commit) — the same
@@ -57,6 +59,7 @@ REJECT_OVERLOADED = 1
 REJECT_EXPIRED = 2
 REJECT_DRAINING = 3
 REJECT_INVALID = 4
+REJECT_UNAVAILABLE = 5
 
 _MAX_REASON = 1 << 16
 
@@ -87,12 +90,27 @@ class InvalidOp(ServeError):
     """The op named an element outside the configured universe."""
 
 
+class ShardUnavailable(ServeError):
+    """The router tier (shard/router.py) could not reach the shard
+    frontend owning this op's keyspace — its circuit breaker is open or
+    the dial/forward failed.  The op was NOT applied on that shard (a
+    spanning op's sub-ops on REACHABLE shards may have applied — they
+    are idempotent, so the retry is still a plain resubmit).  Transient:
+    retry with backoff; other shards' keyspaces keep serving."""
+
+
 REJECT_EXCEPTIONS = {
     REJECT_OVERLOADED: Overloaded,
     REJECT_EXPIRED: DeadlineExceeded,
     REJECT_DRAINING: Draining,
     REJECT_INVALID: InvalidOp,
+    REJECT_UNAVAILABLE: ShardUnavailable,
 }
+
+# exception class -> wire code (the ROUTER's relay direction: a typed
+# reject read off a downstream shard re-encodes upstream with the same
+# code, so the client sees exactly what the shard said)
+REJECT_CODES = {exc: code for code, exc in REJECT_EXCEPTIONS.items()}
 
 
 def encode_op(req_id: int, kind: int, elements: Sequence[int],

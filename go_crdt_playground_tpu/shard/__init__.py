@@ -1,0 +1,24 @@
+"""Sharded serving fleet (DESIGN.md §17 "Sharded fleet").
+
+The step from one replica to a fleet: a seeded consistent-hash ring
+partitions the element universe across N `serve/` ingest frontends
+(each an ordinary durable `net/peer.Node` replica on its own actor
+lane), and a thin router tier speaks the EXISTING serve dialect on both
+sides — clients dial the router with an unmodified ``ServeClient``, the
+router forwards each OP to the owning shard over pipelined downstream
+clients, relays typed ACK/REJECT back preserving req_ids, and fans
+QUERY/MEMBERS/STATS out across the fleet.  Per-shard anti-entropy and
+durability payloads stay O(shard), not O(universe) — the precondition
+for the O(diff) digest rounds of PAPERS.md arxiv 1803.02750.
+
+A dead shard degrades, never silently drops: ops owned by its keyspace
+get a typed ``ShardUnavailable`` reject (gated by the existing
+circuit-breaker/backoff machinery), while every surviving shard's
+keyspace keeps serving.
+"""
+
+from go_crdt_playground_tpu.shard.fleet import (FleetSpec,  # noqa: F401
+                                                RouterProc, ShardFleet,
+                                                ShardProc)
+from go_crdt_playground_tpu.shard.ring import HashRing  # noqa: F401
+from go_crdt_playground_tpu.shard.router import ShardRouter  # noqa: F401

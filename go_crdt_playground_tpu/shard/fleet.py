@@ -1,0 +1,275 @@
+"""Fleet runner: N shard frontends + one router, as real subprocesses.
+
+The fleet soak (tools/fleet_serve_soak.py) and the slow-marked pytest
+wrapper drive REAL ``python -m go_crdt_playground_tpu`` processes — the
+same CLI an operator runs — never in-process imports: a shard SIGKILL
+must kill a process with its own WAL fds, page cache, and JAX runtime,
+or the zero-acked-op-loss adjudication proves nothing.
+
+``ShardFleet`` owns the lifecycle: it pre-allocates every port (so a
+killed shard RESTARTS on the address the router was configured with —
+the router's links redial through their breakers and the keyspace comes
+back without touching the router), launches all shards concurrently
+(each costs a JAX import + warmup; serial launch would dominate the
+soak), then the router, and tears everything down on ``close()``.
+
+Address handshake: each process prints one ``... listening on H:P``
+line on stdout; a pump thread per process keeps draining stdout
+afterwards so drain summaries can never block the pipe (the
+tools/serve_soak.py lesson).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+Addr = Tuple[str, int]
+
+_ADDR_RE = re.compile(rb"listening on ([\d.]+):(\d+)")
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Proc:
+    """One CLI subprocess with the address-line handshake."""
+
+    def __init__(self, argv: List[str], cwd: str, log_path: str,
+                 env: Optional[Dict[str, str]] = None,
+                 env_drop: Tuple[str, ...] = ()):
+        full_env = dict(os.environ, JAX_PLATFORMS="cpu")
+        for k in env_drop:
+            full_env.pop(k, None)
+        if env:
+            full_env.update(env)
+        self.log = open(log_path, "ab")
+        self.proc = subprocess.Popen(
+            argv, env=full_env, cwd=cwd, stdout=subprocess.PIPE,
+            stderr=self.log)
+        self._lines: "list[bytes]" = []
+        self._line_cond = threading.Condition()
+        threading.Thread(target=self._pump, daemon=True).start()
+        self.addr: Optional[Addr] = None
+
+    def _pump(self) -> None:
+        while True:
+            line = self.proc.stdout.readline()
+            with self._line_cond:
+                self._lines.append(line)
+                self._line_cond.notify_all()
+            if not line:
+                return
+
+    def await_address(self, timeout_s: float = 120.0) -> Addr:
+        deadline = time.monotonic() + timeout_s
+        seen = 0
+        while True:
+            with self._line_cond:
+                while seen >= len(self._lines):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise RuntimeError(
+                            f"no address line within {timeout_s}s "
+                            f"(argv={self.proc.args[:6]}...)")
+                    self._line_cond.wait(timeout=remaining)
+                line = self._lines[seen]
+                seen += 1
+            if not line:
+                raise RuntimeError(
+                    f"process exited before address "
+                    f"(rc={self.proc.poll()})")
+            m = _ADDR_RE.search(line)
+            if m:
+                self.addr = (m.group(1).decode(), int(m.group(2)))
+                return self.addr
+            if time.monotonic() > deadline:
+                # enforced on NON-matching lines too: a subprocess
+                # spamming warnings without ever printing its address
+                # must still time out, not pin the soak forever
+                raise RuntimeError(
+                    f"no address line within {timeout_s}s; last output "
+                    f"line: {line!r}")
+
+    def sigkill(self) -> None:
+        if self.proc.poll() is None:
+            os.kill(self.proc.pid, signal.SIGKILL)
+        self.proc.wait()
+
+    def terminate(self) -> int:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                return self.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                return self.proc.wait()
+        return self.proc.returncode
+
+    def close(self) -> None:
+        self.terminate()
+        self.log.close()
+
+
+@dataclass
+class FleetSpec:
+    """Shape of one fleet: N shards over a shared element universe."""
+
+    n_shards: int
+    elements: int
+    actors: int = 0          # 0 = n_shards (one actor lane per shard)
+    seed: int = 0
+    queue_depth: int = 128
+    max_batch: int = 32
+    flush_ms: float = 2.0
+
+    def __post_init__(self) -> None:
+        if self.n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
+        if self.actors == 0:
+            self.actors = max(self.n_shards, 1)
+        if self.actors < self.n_shards:
+            raise ValueError(
+                f"actors={self.actors} < n_shards={self.n_shards}: each "
+                "shard replica ticks its own actor lane")
+
+
+class ShardProc(_Proc):
+    """One ``serve --ingest`` shard frontend subprocess."""
+
+    def __init__(self, repo: str, dirpath: str, spec: FleetSpec,
+                 index: int, port: int,
+                 crash_after_batches: Optional[int] = None):
+        self.index = index
+        self.port = port
+        self.dirpath = dirpath
+        os.makedirs(dirpath, exist_ok=True)
+        env = {}
+        if crash_after_batches is not None:
+            env["CRDT_SERVE_CRASH_AFTER_BATCHES"] = str(crash_after_batches)
+        argv = [sys.executable, "-m", "go_crdt_playground_tpu", "serve",
+                "--ingest", "--port", str(port),
+                "--elements", str(spec.elements),
+                "--actors", str(spec.actors), "--actor", str(index),
+                "--durable-dir", os.path.join(dirpath, "state"),
+                "--queue-depth", str(spec.queue_depth),
+                "--max-batch", str(spec.max_batch),
+                "--flush-ms", str(spec.flush_ms),
+                "--checkpoint-every", "0"]
+        super().__init__(argv, cwd=repo,
+                         log_path=os.path.join(dirpath, "shard.log"),
+                         env=env,
+                         env_drop=("CRDT_SERVE_CRASH_AFTER_BATCHES",))
+
+
+class RouterProc(_Proc):
+    """One ``router --serve`` subprocess over a fixed shard map."""
+
+    def __init__(self, repo: str, dirpath: str, spec: FleetSpec,
+                 shard_addrs: Dict[str, Addr], port: int):
+        os.makedirs(dirpath, exist_ok=True)
+        argv = [sys.executable, "-m", "go_crdt_playground_tpu", "router",
+                "--serve", "--port", str(port),
+                "--elements", str(spec.elements),
+                "--seed", str(spec.seed)]
+        for sid in sorted(shard_addrs):
+            host, p = shard_addrs[sid]
+            argv += ["--shard", f"{sid}={host}:{p}"]
+        super().__init__(argv, cwd=repo,
+                         log_path=os.path.join(dirpath, "router.log"))
+
+
+@dataclass
+class ShardFleet:
+    """N shard subprocesses behind one router subprocess.
+
+    Single-owner object: the soak's main thread starts, kills,
+    restarts and closes it — nothing here is touched concurrently.
+    """
+
+    repo: str
+    root: str
+    spec: FleetSpec
+    shards: List[Optional[ShardProc]] = field(default_factory=list)
+    shard_ports: List[int] = field(default_factory=list)
+    router: Optional[RouterProc] = None
+
+    @staticmethod
+    def sid(index: int) -> str:
+        return f"s{index}"
+
+    def start(self) -> Addr:
+        """Launch every shard concurrently, then the router; returns
+        the router's client address."""
+        self.shard_ports = [free_port() for _ in range(self.spec.n_shards)]
+        router_port = free_port()
+        # append-as-launched (never a bulk comprehension): if shard k's
+        # constructor raises, the caller's close() must still reach
+        # shards 0..k-1 or they outlive the soak holding ports + cores
+        self.shards = []
+        for i in range(self.spec.n_shards):
+            self.shards.append(
+                ShardProc(self.repo, os.path.join(self.root, self.sid(i)),
+                          self.spec, i, self.shard_ports[i]))
+        for s in self.shards:
+            s.await_address()
+        addrs = {self.sid(i): ("127.0.0.1", self.shard_ports[i])
+                 for i in range(self.spec.n_shards)}
+        self.router = RouterProc(self.repo, os.path.join(self.root, "router"),
+                                 self.spec, addrs, router_port)
+        return self.router.await_address()
+
+    def kill_shard(self, index: int) -> None:
+        """SIGKILL one shard; its keyspace degrades to typed rejects at
+        the router until ``restart_shard``."""
+        shard = self.shards[index]
+        assert shard is not None
+        shard.sigkill()
+        shard.log.close()
+        self.shards[index] = None
+
+    def restart_shard(self, index: int) -> None:
+        """Restart a killed shard on ITS ORIGINAL port and durable dir
+        (``Node.restore_durable``: checkpoint ⊔ WAL tail) — the router
+        config is static, so recovery is invisible to it beyond the
+        breaker's probe."""
+        assert self.shards[index] is None, "shard still running"
+        self.shards[index] = ShardProc(
+            self.repo, os.path.join(self.root, self.sid(index)),
+            self.spec, index, self.shard_ports[index])
+        self.shards[index].await_address()
+
+    def owned_elements(self, index: int) -> List[int]:
+        """The element ids shard ``index`` owns under the fleet ring
+        (client-side ledger for the kill leg)."""
+        from go_crdt_playground_tpu.shard.ring import HashRing
+
+        ring = HashRing([self.sid(i) for i in range(self.spec.n_shards)],
+                        seed=self.spec.seed)
+        owners = ring.owner_map(self.spec.elements)
+        want = ring.shards.index(self.sid(index))
+        return [int(e) for e in
+                (owners == want).nonzero()[0]]
+
+    def close(self) -> None:
+        if self.router is not None:
+            self.router.close()
+            self.router = None
+        for s in self.shards:
+            if s is not None:
+                s.close()
+        self.shards = []

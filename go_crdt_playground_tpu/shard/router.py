@@ -1,0 +1,621 @@
+"""The router tier: one serve-dialect endpoint over N shard frontends.
+
+``ShardRouter`` speaks ``serve/protocol.py`` on BOTH sides.  Upstream
+it is indistinguishable from a ``ServeFrontend`` — an unmodified
+``ServeClient`` dials it, pipelines OPs, and reads typed ACK/REJECT
+back by req_id.  Downstream it holds one pipelined ``ServeClient`` per
+shard frontend and forwards:
+
+* **OP** — elements are grouped by the ring's owner
+  (``shard/ring.HashRing``; the owner map is precomputed once, so the
+  hot path is one array lookup per element).  An op whose keys span
+  shards fans out as one sub-op per owner; the upstream reply is ONE
+  frame: ACK when every sub-op acked, else the first reject (relayed
+  with the downstream's own code — the client sees what the shard
+  said).  Sub-ops on reachable shards may have applied when another
+  shard rejects; that is the protocol's at-least-once shape — CRDT ops
+  are idempotent, the client resubmits the whole op.
+* **QUERY** — fan-out to every shard, MEMBERS replies joined by set
+  union and vv joined element-wise (shards tick disjoint actor lanes).
+  Unreachable shards are EXCLUDED and counted: the union is a correct
+  CRDT lower bound (membership only inflates), not an error.
+* **STATS** — fan-out; the JSON reply carries ``router`` (this tier's
+  recorder), ``shards`` (per-shard snapshots, ``null`` for unreachable
+  ones) and ``aggregate`` (summed shard counters).
+
+**Degradation ladder** (the per-shard half of DESIGN.md §13's):
+each shard link carries the EXISTING ``net/antientropy.CircuitBreaker``
+and a seeded ``utils/backoff.BackoffPolicy``-jittered redial gate.  A
+dead shard costs its keyspace a typed ``REJECT_UNAVAILABLE`` per op —
+never a silent drop, never a stall — while every other shard's
+keyspace keeps serving; the breaker's HALF_OPEN probe re-admits the
+shard the moment it answers again.  Downstream ops in flight when a
+shard dies resolve as connection errors and relay upstream as the same
+typed reject, so THROUGH the router every submitted op resolves
+ack-or-typed-reject even across a shard SIGKILL (the fleet soak's
+``unresolved == 0`` adjudication).
+
+Relay threads write upstream through the per-session writer queues
+(serve/session.py), so one read-stalled client never blocks a shard
+link's reply stream.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from go_crdt_playground_tpu.net import framing
+from go_crdt_playground_tpu.net.antientropy import CircuitBreaker
+from go_crdt_playground_tpu.serve import protocol
+from go_crdt_playground_tpu.serve.client import ServeClient
+from go_crdt_playground_tpu.serve.session import Session
+from go_crdt_playground_tpu.shard.ring import HashRing
+from go_crdt_playground_tpu.utils.backoff import Backoff, BackoffPolicy
+
+Addr = Tuple[str, int]
+
+
+class _Unreachable(Exception):
+    """Internal: the link could not take the sub-op (breaker open, dial
+    or forward failed).  Always surfaces upstream as the typed
+    ``REJECT_UNAVAILABLE`` — callers never let it escape the frame
+    handler."""
+
+
+class _Relay:
+    """One upstream OP's fan-out accounting: ack upstream only when
+    every sub-op acked; the FIRST reject wins otherwise (deterministic
+    for the common one-shard case; for spanning ops any reject means
+    "resubmit", so which one the client sees is immaterial)."""
+
+    __slots__ = ("_lock", "session", "req_id", "_remaining", "_reject")
+
+    def __init__(self, session: Session, req_id: int, n_subops: int):
+        self._lock = threading.Lock()
+        self.session = session
+        self.req_id = req_id
+        self._remaining = n_subops  # guarded-by: _lock
+        self._reject: Optional[Tuple[int, str]] = None  # guarded-by: _lock
+
+    def resolve_one(self, reject: Optional[Tuple[int, str]]
+                    ) -> Optional[Optional[Tuple[int, str]]]:
+        """Record one sub-op outcome (None = acked).  Returns the final
+        verdict — None-the-ack or (code, reason) — once ALL sub-ops
+        resolved, else the not-done-yet sentinel ``None`` is NOT
+        returned: the caller distinguishes via the wrapped tuple."""
+        with self._lock:
+            if reject is not None and self._reject is None:
+                self._reject = reject
+            self._remaining -= 1
+            if self._remaining > 0:
+                return None
+            return (self._reject,)  # wrapped: (None,) means "ack now"
+
+
+class _ShardLink:
+    """Router-side state for ONE shard frontend: a lazily-dialed
+    pipelined ServeClient, the breaker/backoff gate, and the
+    downstream-req-id -> _Relay map."""
+
+    # bound on the DIAL alone: a blackholed shard (SYN silently
+    # dropped, no RST) must cost its keyspace at most this per breaker
+    # probe, not the full reply timeout, and the cost is paid at most
+    # once per cooldown because the breaker opens on the failure
+    DIAL_TIMEOUT_S = 1.0
+
+    def __init__(self, sid: str, addr: Addr, *, timeout_s: float,
+                 breaker_threshold: int, breaker_cooldown_s: float,
+                 policy: BackoffPolicy, seed: int, on_reply) -> None:
+        self.sid = sid
+        self.addr = (addr[0], int(addr[1]))
+        self.timeout_s = timeout_s
+        self._on_reply = on_reply  # router._relay_reply (thread-safe)
+        self._lock = threading.Lock()
+        self._client: Optional[ServeClient] = None  # guarded-by: _lock
+        # latched by close(): a reader that raced past the router's
+        # draining check must not redial a "closed" link (the leaked
+        # client would outlive the router)
+        self._closing = False  # guarded-by: _lock
+        # req_ids are CONNECTION-scoped, so pending keys carry the dial
+        # generation: a dead client's sweep can only ever resolve its
+        # own generation's entries, never a successor's
+        self._gen = 0  # guarded-by: _lock
+        self._pending: Dict[Tuple[int, int], _Relay] = {}  # guarded-by: _lock
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s)
+        self._backoff = Backoff(policy, seed=seed)
+        self._earliest_redial = 0.0  # guarded-by: _lock
+
+    # -- dialing ------------------------------------------------------------
+
+    # requires-lock: _lock
+    def _ensure_client_locked(self) -> ServeClient:
+        if self._closing:
+            raise _Unreachable(f"shard {self.sid} link closed")
+        if self._client is not None:
+            return self._client
+        now = time.monotonic()
+        if now < self._earliest_redial or not self.breaker.allow():
+            raise _Unreachable(f"shard {self.sid} breaker open")
+        gen = self._gen + 1
+        try:
+            client = ServeClient(
+                self.addr, timeout=self.timeout_s,
+                connect_timeout=self.DIAL_TIMEOUT_S,
+                on_result=lambda op: self._downstream_result(gen, op))
+        except (OSError, ConnectionError) as e:
+            self.breaker.record_failure()
+            delay = self._backoff.next_delay()
+            if delay is None:
+                self._backoff.reset()
+                delay = self._backoff.policy.cap_s
+            self._earliest_redial = now + delay
+            raise _Unreachable(
+                f"shard {self.sid} dial failed: {e}") from e
+        self.breaker.record_success()
+        self._backoff.reset()
+        self._earliest_redial = 0.0
+        self._gen = gen
+        self._client = client
+        return client
+
+    # requires-lock: _lock
+    def _retire_client_locked(self, gen: int) -> Optional[ServeClient]:
+        """Detach the current client if it is still generation ``gen``;
+        the CALLER must close the returned client OUTSIDE the lock
+        (close() joins the reader thread, and the reader takes this
+        lock in the reply callback — closing under the lock would stall
+        both sides on each other)."""
+        if self._gen != gen or self._client is None:
+            return None
+        client, self._client = self._client, None
+        self.breaker.record_failure()
+        return client
+
+    def submit(self, relay: _Relay, kind: int, elements: Sequence[int],
+               deadline_s: Optional[float]) -> None:
+        """Forward one sub-op; registers the relay BEFORE the reply can
+        race back (submit + register share the lock the reply callback
+        takes).  Raises ``_Unreachable`` — the caller owes the relay a
+        typed resolve_one."""
+        retired = None
+        try:
+            with self._lock:
+                client = self._ensure_client_locked()
+                gen = self._gen
+                try:
+                    op = client.submit_async(kind, elements,
+                                             deadline_s=deadline_s)
+                except (OSError, ConnectionError) as e:
+                    # forward failed: the connection is dead.  Retire it
+                    # (closed below, outside the lock) so the next op
+                    # redials through the breaker; its in-flight ops
+                    # resolve via its own sweep -> _downstream_result.
+                    retired = self._retire_client_locked(gen)
+                    raise _Unreachable(
+                        f"shard {self.sid} send failed: {e}") from e
+                self._pending[(gen, op.req_id)] = relay
+        finally:
+            if retired is not None:
+                retired.close()
+
+    # -- reply path (runs on the downstream client's reader thread) ---------
+
+    def _downstream_result(self, gen: int, op) -> None:
+        with self._lock:
+            relay = self._pending.pop((gen, op.req_id), None)
+            if op.error is not None and not isinstance(
+                    op.error, protocol.ServeError):
+                # transport death: every pending op on this client is
+                # being swept (generation-fenced: a stale sweep cannot
+                # retire a successor client).  No close() here — the
+                # sweep IS the client's own teardown path.
+                self._retire_client_locked(gen)
+        if relay is None:
+            return
+        if op.error is None:
+            reject = None
+        elif isinstance(op.error, protocol.ServeError):
+            # relay the shard's own verdict, code-for-code
+            code = protocol.REJECT_CODES.get(
+                type(op.error), protocol.REJECT_OVERLOADED)
+            reject = (code, f"shard {self.sid}: {op.error}")
+        else:
+            reject = (protocol.REJECT_UNAVAILABLE,
+                      f"shard {self.sid} went away (retry): {op.error}")
+        self._on_reply(relay, reject)
+
+    # -- fan-out reads ------------------------------------------------------
+
+    def members(self) -> Tuple[List[int], np.ndarray]:
+        with self._lock:
+            client = self._ensure_client_locked()
+            gen = self._gen
+        try:
+            return client.members()
+        except (OSError, ConnectionError, socket.timeout,
+                framing.RemoteError) as e:
+            # RemoteError too: a shard answering MSG_ERROR (e.g. a
+            # --shard flag pointed at the wrong dialect's port) must
+            # count as unreachable, not kill the fan-out thread
+            self._drop_client(gen)
+            raise _Unreachable(
+                f"shard {self.sid} members failed: {e}") from e
+
+    def stats(self) -> dict:
+        with self._lock:
+            client = self._ensure_client_locked()
+            gen = self._gen
+        try:
+            return client.stats()
+        except (OSError, ConnectionError, socket.timeout,
+                framing.RemoteError) as e:
+            self._drop_client(gen)
+            raise _Unreachable(
+                f"shard {self.sid} stats failed: {e}") from e
+
+    def _drop_client(self, gen: int) -> None:
+        """Retire after a fan-out failure and CLOSE the retired client
+        (a timeout on a live-but-slow connection would otherwise leak
+        its socket + reader thread every poll)."""
+        with self._lock:
+            retired = self._retire_client_locked(gen)
+        if retired is not None:
+            retired.close()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closing = True
+            client, self._client = self._client, None
+        if client is not None:
+            client.close()
+
+
+class ShardRouter:
+    """Serve-dialect TCP router over a static shard fleet.
+
+    ``shards`` maps shard id -> (host, port) of a ``serve --ingest``
+    frontend.  ``num_elements`` is the fleet-wide element universe the
+    owner map is built over (every shard runs the same E; each owns the
+    ring's slice of it).
+    """
+
+    IDLE_TIMEOUT_S = 60.0
+    MAX_FRAME_BODY = 1 << 20
+    MAX_CONNS = 256
+
+    def __init__(self, shards: Mapping[str, Addr], num_elements: int, *,
+                 seed: int = 0, recorder=None,
+                 downstream_timeout_s: float = 10.0,
+                 breaker_threshold: int = 1,
+                 breaker_cooldown_s: float = 0.5,
+                 backoff: Optional[BackoffPolicy] = None,
+                 max_conns: Optional[int] = None):
+        from go_crdt_playground_tpu.obs import Recorder
+
+        if not shards:
+            raise ValueError("a router needs at least one shard")
+        self.recorder = recorder if recorder is not None else Recorder()
+        self.num_elements = int(num_elements)
+        self._downstream_timeout_s = downstream_timeout_s
+        self.ring = HashRing(list(shards), seed=seed)
+        # the hot path: element id -> owner index, one lookup per key
+        self._owner = self.ring.owner_map(self.num_elements)
+        policy = backoff if backoff is not None else BackoffPolicy(
+            base_s=0.05, multiplier=2.0, cap_s=2.0, jitter=0.1,
+            max_retries=4)
+        self._links: Dict[str, _ShardLink] = {
+            sid: _ShardLink(
+                sid, shards[sid], timeout_s=downstream_timeout_s,
+                breaker_threshold=breaker_threshold,
+                breaker_cooldown_s=breaker_cooldown_s, policy=policy,
+                seed=seed * 1000 + i, on_reply=self._relay_reply)
+            for i, sid in enumerate(self.ring.shards)}
+        self._conn_slots = threading.BoundedSemaphore(
+            self.MAX_CONNS if max_conns is None else max_conns)
+        self._lock = threading.Lock()
+        self._sessions: set = set()  # guarded-by: _lock
+        self._draining = threading.Event()
+        self._closed = threading.Event()
+        # race-ok: serve()/close() owner thread; accept loop snapshots
+        self._listener: Optional[socket.socket] = None
+        # race-ok: serve()/close() owner thread only
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
+        if self._listener is not None:
+            raise RuntimeError("already serving")
+        sock = socket.create_server((host, port))
+        self._listener = sock
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="router-accept", daemon=True)
+        self._accept_thread.start()
+        return sock.getsockname()[:2]
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._draining.set()
+        listener = self._listener
+        if listener is not None:
+            # shutdown BEFORE close: a bare close does not reliably
+            # wake the blocked accept loop, and until it wakes the
+            # kernel keeps completing new dials into the backlog
+            try:
+                listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        # downstream first: closing a link resolves its in-flight ops as
+        # connection errors, which relay typed rejects through sessions
+        # that are still open
+        for link in self._links.values():
+            link.close()
+        with self._lock:
+            sessions = list(self._sessions)
+            self._sessions.clear()
+        # one SHARED flush window across all sessions (the frontend's
+        # drain shape): stalled clients cost ~1s total, not each
+        flush_deadline = time.monotonic() + 1.0
+        for s in sessions:
+            s.close(flush_timeout_s=max(
+                0.0, flush_deadline - time.monotonic()))
+        self._closed.set()
+
+    def __enter__(self) -> "ShardRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- accept / per-connection reader (the ServeFrontend shape) -----------
+
+    def _accept_loop(self) -> None:
+        sock = self._listener  # snapshot: close() may null the field
+        assert sock is not None
+        while not self._draining.is_set():
+            try:
+                conn, addr = sock.accept()
+            except OSError:
+                return  # listener closed
+            if not self._conn_slots.acquire(blocking=False):
+                self._count("router.shed.connections")
+                conn.close()
+                continue
+            self._count("router.connections")
+            session = Session(conn, peer=f"{addr[0]}:{addr[1]}")
+            with self._lock:
+                self._sessions.add(session)
+            handed_off = False
+            try:
+                threading.Thread(
+                    target=self._reader, args=(conn, session),
+                    daemon=True).start()
+                handed_off = True
+            except RuntimeError:
+                pass  # OS thread exhaustion: shed, keep accepting
+            finally:
+                if not handed_off:
+                    with self._lock:
+                        self._sessions.discard(session)
+                    session.close()
+                    self._conn_slots.release()
+
+    def _reader(self, conn: socket.socket, session: Session) -> None:
+        try:
+            conn.settimeout(self.IDLE_TIMEOUT_S)
+            while not session.closed:
+                try:
+                    msg_type, body = framing.recv_frame(
+                        conn, timeout=self.IDLE_TIMEOUT_S,
+                        max_body=self.MAX_FRAME_BODY)
+                except (framing.ProtocolError, OSError):
+                    return  # torn/idle/garbled connection: drop it
+                if msg_type == protocol.MSG_OP:
+                    if not self._handle_op(session, body):
+                        return
+                elif msg_type == protocol.MSG_QUERY:
+                    self._handle_query(session, body)
+                elif msg_type == protocol.MSG_STATS:
+                    self._handle_stats(session, body)
+                else:
+                    session.send(framing.MSG_ERROR,
+                                 f"unexpected frame type {msg_type}"
+                                 .encode())
+                    return
+        finally:
+            with self._lock:
+                self._sessions.discard(session)
+            session.close()
+            self._conn_slots.release()
+
+    # -- OP forwarding ------------------------------------------------------
+
+    def _handle_op(self, session: Session, body: bytes) -> bool:
+        try:
+            req_id, kind, elements, deadline_us = protocol.decode_op(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return False
+        E = self.num_elements
+        if any(not 0 <= e < E for e in elements):
+            self._count("router.rejects.invalid")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_INVALID,
+                f"element id outside universe E={E}"))
+            return True
+        if len(set(elements)) != len(elements):
+            self._count("router.rejects.invalid")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_INVALID,
+                "duplicate element ids in one op"))
+            return True
+        if self._draining.is_set():
+            self._count("router.shed.draining")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_DRAINING, "router draining"))
+            return True
+        # group by owner, preserving client key order within each group
+        groups: Dict[str, List[int]] = {}
+        for e in elements:
+            sid = self.ring.shards[self._owner[e]]
+            groups.setdefault(sid, []).append(e)
+        self._count("router.ops.forwarded")
+        if len(groups) > 1:
+            self._count("router.ops.split")
+        # deadline: forward the client's remaining budget unchanged —
+        # grouping costs microseconds, and the shard re-anchors it at
+        # its own admission (propagation, not re-guessing)
+        deadline_s = deadline_us / 1e6 if deadline_us > 0 else None
+        relay = _Relay(session, req_id, len(groups))
+        for sid, elems in groups.items():
+            try:
+                self._links[sid].submit(relay, kind, elems, deadline_s)
+            except _Unreachable as e:
+                self._count("router.shed.unavailable")
+                self._relay_reply(
+                    relay, (protocol.REJECT_UNAVAILABLE, str(e)))
+        return True
+
+    def _relay_reply(self, relay: _Relay,
+                     reject: Optional[Tuple[int, str]]) -> None:
+        """One sub-op resolved; sends the upstream frame when the whole
+        op has.  Runs on downstream reader threads AND the upstream
+        reader thread (unreachable-at-submit) — the relay's own lock
+        arbitrates."""
+        verdict = relay.resolve_one(reject)
+        if verdict is None:
+            return  # sub-ops still outstanding
+        final = verdict[0]
+        if final is None:
+            self._count("router.acks.relayed")
+            relay.session.send(protocol.MSG_ACK,
+                               protocol.encode_ack(relay.req_id))
+        else:
+            code, reason = final
+            self._count("router.rejects.relayed")
+            relay.session.send(protocol.MSG_REJECT,
+                               protocol.encode_reject(relay.req_id, code,
+                                                      reason))
+
+    # -- fan-out reads ------------------------------------------------------
+
+    def _fan_out(self, call: str) -> Dict[str, object]:
+        """Run ``link.<call>()`` on every shard concurrently; returns
+        sid -> result or the _Unreachable error.  Thread-per-shard per
+        request is a deliberate control-plane tradeoff: QUERY/STATS are
+        orders of magnitude rarer than OPs, and the alternative (async
+        QUERY plumbing through ServeClient or long-lived fan-out
+        workers) buys nothing until read fan-out is a measured cost —
+        revisit if dashboards ever poll hot."""
+        # pre-seeded: a worker that dies unexpectedly or outlives the
+        # join bound leaves its sentinel in place, so the shard reads
+        # as unreachable-and-counted — NEVER silently absent from the
+        # union (indistinguishable from a smaller healthy fleet)
+        results: Dict[str, object] = {
+            sid: _Unreachable(f"shard {sid} fan-out timed out")
+            for sid in self._links}
+        lock = threading.Lock()
+
+        def one(sid: str, link: _ShardLink) -> None:
+            try:
+                r = getattr(link, call)()
+            except _Unreachable as e:
+                r = e
+            except Exception as e:  # noqa: BLE001 — any escape still
+                # counts as unreachable rather than a vanished shard
+                r = _Unreachable(f"shard {sid} {call} raised: {e}")
+            with lock:
+                results[sid] = r
+
+        threads = [threading.Thread(target=one, args=(sid, link),
+                                    daemon=True)
+                   for sid, link in self._links.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self._downstream_timeout_s + 5.0)
+        with lock:
+            return dict(results)
+
+    def _handle_query(self, session: Session, body: bytes) -> None:
+        try:
+            req_id = protocol.decode_query(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return
+        self._count("router.queries")
+        results = self._fan_out("members")
+        members: set = set()
+        vvs: List[np.ndarray] = []
+        unreachable = 0
+        for sid, r in results.items():
+            if isinstance(r, _Unreachable):
+                unreachable += 1
+                continue
+            m, vv = r
+            members.update(m)
+            vvs.append(np.asarray(vv, np.uint32))
+        if unreachable:
+            # the union over reachable shards is a valid CRDT lower
+            # bound (membership only inflates) — served, and counted,
+            # not errored
+            self._count("router.queries.partial", unreachable)
+        if vvs:
+            a = max(v.shape[0] for v in vvs)
+            vv = np.zeros(a, np.uint32)
+            for v in vvs:  # element-wise join; shards tick disjoint lanes
+                vv[:v.shape[0]] = np.maximum(vv[:v.shape[0]], v)
+        else:
+            vv = np.zeros(0, np.uint32)
+        session.send(protocol.MSG_MEMBERS, protocol.encode_members(
+            req_id, sorted(int(e) for e in members), vv))
+
+    def _handle_stats(self, session: Session, body: bytes) -> None:
+        try:
+            req_id = protocol.decode_stats(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return
+        self._count("router.stats")
+        results = self._fan_out("stats")
+        shards: Dict[str, object] = {}
+        aggregate: Dict[str, int] = {}
+        for sid, r in results.items():
+            if isinstance(r, _Unreachable):
+                shards[sid] = None
+                continue
+            shards[sid] = r
+            for name, v in r.get("counters", {}).items():
+                aggregate[name] = aggregate.get(name, 0) + int(v)
+        snap = self.recorder.snapshot()
+        # top level is FRONTEND-shaped (counters/observations/gauges):
+        # a stats reader written against one frontend reads the fleet
+        # aggregate unmodified; the per-shard split rides alongside.
+        # Aggregating shard-side latency PERCENTILES is statistically
+        # meaningless, so observations stay router-local (empty today).
+        counters = dict(aggregate)
+        counters.update(snap.get("counters", {}))
+        session.send(protocol.MSG_STATS_REPLY, protocol.encode_stats_reply(
+            req_id, {"counters": counters,
+                     "observations": {},
+                     "gauges": snap.get("gauges", {}),
+                     "router": snap,
+                     "shards": shards,
+                     "aggregate": {"counters": aggregate}}))
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.recorder is not None:
+            self.recorder.count(name, n)

@@ -716,6 +716,11 @@ def test_gate_fast(tmp_path):
                   ["classes_by_name"])
     assert {"AdmissionQueue", "Session", "MicroBatcher", "ServeFrontend",
             "ServeClient"} <= covered, covered
+    # ... and the shard/ router tier (the sharded-fleet ISSUE): ring,
+    # router + its per-shard links/relays, and the fleet runner are all
+    # multi-threaded shared state inside the same sweep
+    assert {"HashRing", "ShardRouter", "_ShardLink", "_Relay",
+            "ShardFleet", "ShardProc", "RouterProc"} <= covered, covered
 
 
 def test_report_shape_roundtrips(tmp_path):

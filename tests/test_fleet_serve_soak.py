@@ -1,0 +1,55 @@
+"""CI wrapper for the sharded-fleet soak (tools/fleet_serve_soak.py).
+
+Mirrors the serve/crash soak wrappers: the --quick sweep must complete
+with the acceptance shape — every op through the router resolves
+ack-or-typed-reject at every shard count, and the SIGKILL-one-shard leg
+shows typed ``ShardUnavailable`` rejects for the dead keyspace,
+survivor keyspaces still acking, and ZERO acked-op loss across the
+restart.  slow-marked: it spawns N real ``serve --ingest`` subprocesses
+plus a real ``router --serve`` subprocess and SIGKILLs one, so tier-1
+runtime never pays for it.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+
+@pytest.mark.slow
+def test_fleet_serve_soak_quick_mode(tmp_path):
+    import fleet_serve_soak
+
+    out = str(tmp_path / "SHARD_CURVE.json")
+    rc = fleet_serve_soak.main(["--quick", "--out", out])
+    assert rc == 0, "fleet soak failed (unresolved ops, missing typed " \
+                    "rejects, dead survivors, or acked-op loss)"
+    with open(out) as f:
+        artifact = json.load(f)
+
+    curve = artifact["shard_curve"]
+    assert [leg["shards"] for leg in curve] == [1, 3]
+    for leg in curve:
+        # ack-or-typed-reject THROUGH the router, at every shard count
+        assert leg["unresolved"] == 0, leg
+        assert leg["goodput"] > 0, leg
+
+    kill = artifact["kill_leg"]
+    assert kill["shards"] >= 3
+    # the outage was real and typed: the dead shard's keyspace rejected
+    # ShardUnavailable while surviving keyspaces kept acking
+    assert kill["outage"]["typed_unavailable"] > 0, kill
+    assert kill["outage"]["acked_survivor"] > 0, kill
+    assert kill["outage"]["unresolved"] == 0, kill
+    # the ledger: acks on the victim BEFORE the SIGKILL all survived
+    # its restore_durable restart; nothing phantom appeared; the whole
+    # keyspace eventually landed
+    assert kill["victim_acked_before_kill"] > 0
+    assert kill["lost_acked_ops"] == []
+    assert kill["phantom_members"] == []
+    assert kill["unfinished"] == []
+    assert kill["final_members"] == kill["elements"]

@@ -59,10 +59,14 @@ def test_protocol_ack_reject_members_roundtrip():
     req, members, vv = protocol.decode_members(
         protocol.encode_members(5, [1, 2, 9], np.asarray([3, 0, 7])))
     assert (req, members, vv.tolist()) == (5, [1, 2, 9], [3, 0, 7])
-    # every reject code maps to a typed exception
+    # every reject code maps to a typed exception, and back (the
+    # router's relay direction re-encodes the downstream verdict)
     assert set(protocol.REJECT_EXCEPTIONS) == {
         protocol.REJECT_OVERLOADED, protocol.REJECT_EXPIRED,
-        protocol.REJECT_DRAINING, protocol.REJECT_INVALID}
+        protocol.REJECT_DRAINING, protocol.REJECT_INVALID,
+        protocol.REJECT_UNAVAILABLE}
+    for code, exc in protocol.REJECT_EXCEPTIONS.items():
+        assert protocol.REJECT_CODES[exc] == code
 
 
 # ---------------------------------------------------------------------------
@@ -378,11 +382,13 @@ def test_frontend_disseminates_to_peers(tmp_path):
         peer.close()
 
 
-def test_session_send_bound_sheds_stalled_reader():
-    """Review fix: a client that stops READING its acks fills its TCP
-    window; the session's bounded write half must fail the send within
-    its timeout and flip closed — never block the (single) batcher
-    thread for the idle timeout."""
+def test_session_writer_queue_sheds_stalled_reader():
+    """Serve-path ladder satellite: ``send()`` only ENQUEUES (the
+    per-session writer thread owns the socket), so a client that stops
+    READING its acks never blocks the calling thread — the stall fills
+    its TCP window, then the writer's per-frame bound or the bounded
+    outbound queue flips the session closed.  Either way the shed costs
+    THIS session, and every send call stays O(1)."""
     import socket as socket_mod
 
     from go_crdt_playground_tpu.serve.session import Session
@@ -392,19 +398,70 @@ def test_session_send_bound_sheds_stalled_reader():
         # tiny buffers so the window fills after a few frames
         a.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_SNDBUF, 4096)
         b.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_RCVBUF, 4096)
-        s = Session(a, send_timeout_s=0.2)
+        s = Session(a, send_timeout_s=0.2, queue_depth=64)
         body = b"x" * 8192
         t0 = time.monotonic()
         sends = 0
-        while s.send(protocol.MSG_ACK, body):
+        max_send_s = 0.0
+        while True:
+            s0 = time.monotonic()
+            ok = s.send(protocol.MSG_ACK, body)
+            max_send_s = max(max_send_s, time.monotonic() - s0)
+            if not ok:
+                break
             sends += 1
-            assert sends < 1000, "send never hit the stalled window"
+            assert sends < 10_000, "send never shed the stalled reader"
         elapsed = time.monotonic() - t0
         assert s.closed
-        assert elapsed < 5.0, f"send blocked {elapsed:.1f}s despite bound"
+        # the caller was never the one paying the stall: no single
+        # enqueue blocked anywhere near the writer's socket bound
+        assert max_send_s < 0.1, f"send() blocked {max_send_s:.3f}s"
+        assert elapsed < 5.0, f"shed took {elapsed:.1f}s despite bounds"
         assert not s.send(protocol.MSG_ACK, b"y")  # closed: instant no-op
     finally:
         for sock in (a, b):
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def test_session_writer_decouples_sessions():
+    """The point of the per-session queues: one read-stalled client
+    must not delay another session's replies THROUGH THE SAME CALLING
+    THREAD (pre-refactor, the batcher serialized one SEND_TIMEOUT_S
+    stall per stalled client per batch)."""
+    import socket as socket_mod
+
+    from go_crdt_playground_tpu.net import framing
+    from go_crdt_playground_tpu.serve.session import Session
+
+    a1, b1 = socket_mod.socketpair()  # stalled: b1 never read
+    a2, b2 = socket_mod.socketpair()  # healthy: b2 read below
+    try:
+        a1.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_SNDBUF, 4096)
+        b1.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_RCVBUF, 4096)
+        stalled = Session(a1, send_timeout_s=0.2, queue_depth=16)
+        healthy = Session(a2, send_timeout_s=0.2)
+        body = b"x" * 8192
+        # interleave like a batcher acking a mixed batch: the stalled
+        # session absorbs/sheds, the healthy one must deliver promptly
+        t0 = time.monotonic()
+        for i in range(20):
+            stalled.send(protocol.MSG_ACK, body)
+            assert healthy.send(protocol.MSG_ACK,
+                                protocol.encode_ack(i))
+        enqueue_s = time.monotonic() - t0
+        assert enqueue_s < 1.0, f"interleaved sends took {enqueue_s:.1f}s"
+        b2.settimeout(10.0)
+        for i in range(20):  # every healthy ack arrives, in order
+            msg_type, reply = framing.recv_frame(b2, timeout=10.0)
+            assert msg_type == protocol.MSG_ACK
+            assert protocol.decode_ack(reply) == i
+        stalled.close()
+        healthy.close()
+    finally:
+        for sock in (a1, b1, a2, b2):
             try:
                 sock.close()
             except OSError:

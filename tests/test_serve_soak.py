@@ -58,3 +58,16 @@ def test_serve_soak_quick_mode(tmp_path):
     assert crash["phantom_members"] == []
     assert crash["unfinished"] == []
     assert crash["acked_ops"] == crash["elements"]
+
+    # (d) the chaos leg: wire faults actually fired on the INGEST port
+    # (torn OP frames / delayed acks / refused dials incl. the
+    # partition window) and the durable-ack ledger held under them
+    chaos = artifact["chaos"]
+    pc = chaos["proxy_counters"]
+    assert pc["dropped"] + pc["truncated"] >= 1, pc
+    assert pc["delayed"] >= 1, pc
+    assert pc["refused"] >= 1, "the partition window never refused a dial"
+    assert chaos["lost_acked_ops"] == []
+    assert chaos["phantom_members"] == []
+    assert chaos["gave_up"] == []
+    assert chaos["final_members"] == chaos["elements"]

@@ -1,0 +1,108 @@
+"""Ring properties (shard/ring.py), pinned as the ISSUE demands:
+seeded balance bound, minimal remap under membership change, and
+routing determinism ACROSS PROCESSES — a router restart (or a second
+router) must route every key identically or the fleet silently splits
+its keyspaces.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from go_crdt_playground_tpu.shard.ring import (HashRing, load_stats,
+                                               remap_fraction)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ring_rejects_bad_config():
+    with pytest.raises(ValueError):
+        HashRing([])
+    with pytest.raises(ValueError):
+        HashRing(["a", "a"])
+    with pytest.raises(ValueError):
+        HashRing([""])
+    with pytest.raises(ValueError):
+        HashRing(["only"]).without_shard("only")
+    with pytest.raises(ValueError):
+        HashRing(["a"]).without_shard("missing")
+
+
+def test_ring_owner_is_total_and_stable():
+    r = HashRing(["s0", "s1", "s2"], seed=3)
+    owners = r.owner_map(512)
+    assert owners.shape == (512,)
+    assert set(np.unique(owners)) <= {0, 1, 2}
+    for e in (0, 7, 511):
+        assert r.shards[owners[e]] == r.owner(e)
+        assert r.owner_index(e) == owners[e]
+
+
+def test_ring_ignores_shard_listing_order():
+    """Two operators listing the same fleet in different --shard order
+    must route identically."""
+    a = HashRing(["s2", "s0", "s1"], seed=9)
+    b = HashRing(["s0", "s1", "s2"], seed=9)
+    assert a.shards == b.shards
+    assert a.digest(256) == b.digest(256)
+
+
+@pytest.mark.parametrize("n_shards,seed", [(2, 0), (3, 7), (5, 23)])
+def test_ring_balance_bound(n_shards, seed):
+    """Seeded balance: with E >> n the max/mean shard load stays near
+    1 (rendezvous scores are i.i.d. uniform per (shard, key))."""
+    E = 4096
+    r = HashRing([f"s{i}" for i in range(n_shards)], seed=seed)
+    stats = load_stats(r.owner_map(E), n_shards)
+    assert all(x > 0 for x in stats["loads"])
+    assert stats["max_over_mean"] < 1.15, stats
+    assert stats["min_over_mean"] > 0.85, stats
+
+
+def test_ring_minimal_remap_on_join_and_leave():
+    """HRW's exact minimal-remap property: a join moves ONLY keys into
+    the joiner (an expected 1/(n+1) fraction), a leave moves ONLY the
+    leaver's keys — zero gratuitous moves either way."""
+    E = 4096
+    r3 = HashRing(["s0", "s1", "s2"], seed=11)
+    r4 = r3.with_shard("s3")
+    m3, m4 = r3.owner_map(E), r4.owner_map(E)
+    join = remap_fraction(m3, m4, r3.shards, r4.shards)
+    assert join["gratuitous"] == []
+    # expected 1/4; well under double it, well over half it
+    assert 0.125 < join["fraction"] < 0.5, join
+    # a leave is the exact inverse membership change
+    back = r4.without_shard("s3")
+    assert back.shards == r3.shards
+    leave = remap_fraction(m4, back.owner_map(E), r4.shards, back.shards)
+    assert leave["gratuitous"] == []
+    assert leave["moved"] == join["moved"]
+
+
+def test_ring_seed_changes_placement_not_balance():
+    E = 2048
+    a = HashRing(["s0", "s1", "s2"], seed=1)
+    b = HashRing(["s0", "s1", "s2"], seed=2)
+    assert a.digest(E) != b.digest(E)
+    assert load_stats(b.owner_map(E), 3)["max_over_mean"] < 1.2
+
+
+def test_ring_determinism_across_processes():
+    """Same (shards, seed, E) ⇒ same owner map in a FRESH interpreter:
+    the ``router`` CLI's dry-run mode prints the digest this process
+    computes.  This is the property that lets a restarted router (or a
+    second one) serve the same fleet without remapping a single key."""
+    E, seed = 384, 17
+    ring = HashRing(["s0", "s1", "s2"], seed=seed)
+    argv = [sys.executable, "-m", "go_crdt_playground_tpu", "router",
+            "--elements", str(E), "--seed", str(seed)]
+    for sid in ("s1", "s0", "s2"):  # permuted on purpose
+        argv += ["--shard", f"{sid}=127.0.0.1:1"]
+    out = subprocess.run(
+        argv, cwd=REPO, capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr
+    assert f"owner-map digest {ring.digest(E)} " in out.stdout, out.stdout

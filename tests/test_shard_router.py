@@ -1,0 +1,273 @@
+"""Router-tier tests (shard/router.py): dialect preservation, the
+bitwise routed-vs-single-node pin, fan-out joins, spanning-op fan-out,
+and per-shard degradation — all in-process (subprocess fleets are the
+slow-marked fleet soak's job).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from go_crdt_playground_tpu.serve import ServeFrontend, protocol
+from go_crdt_playground_tpu.serve.client import ServeClient
+from go_crdt_playground_tpu.shard.router import ShardRouter
+
+E, A = 64, 4
+N_SHARDS = 3
+
+
+class _Fleet:
+    """N in-process frontends + a router, torn down in order."""
+
+    def __init__(self, tmp_path, n_shards=N_SHARDS, **router_kw):
+        self.frontends = [
+            ServeFrontend(E, A, actor=i,
+                          durable_dir=str(tmp_path / f"s{i}"),
+                          max_batch=8, flush_ms=1.0, queue_depth=32)
+            for i in range(n_shards)]
+        self.addrs = {f"s{i}": fe.serve()
+                      for i, fe in enumerate(self.frontends)}
+        self.router = ShardRouter(self.addrs, E, seed=5, **router_kw)
+        self.addr = self.router.serve()
+
+    def owned_by(self, sid):
+        return [e for e in range(E)
+                if self.router.ring.shards[self.router._owner[e]] == sid]
+
+    def close(self):
+        self.router.close()
+        for fe in self.frontends:
+            fe.close()
+
+
+@pytest.fixture()
+def fleet(tmp_path):
+    f = _Fleet(tmp_path)
+    yield f
+    f.close()
+
+
+def test_routed_ingest_end_to_end(fleet):
+    """An UNMODIFIED ServeClient against the router: ops ack, the
+    QUERY fan-out unions membership across shards."""
+    with ServeClient(fleet.addr) as c:
+        c.add(1, 2, 3)
+        c.add(40)
+        c.delete(2)
+        members, vv = c.members()
+    assert members == [1, 3, 40]
+    # 4 add ticks + 1 del tick, spread over the shards' actor lanes
+    assert int(np.asarray(vv).sum()) == 5
+    snap = fleet.router.recorder.snapshot()
+    assert snap["counters"]["router.ops.forwarded"] == 3
+    assert snap["counters"]["router.acks.relayed"] == 3
+
+
+def test_routed_matches_single_node_bitwise(tmp_path):
+    """The acceptance pin: the same op stream through router+fleet
+    converges to the same state as single-node ingest — membership
+    array bitwise-equal, and EACH shard's replica bitwise-equal to a
+    reference node ingesting the sub-stream the ring assigns it."""
+    import jax
+
+    from go_crdt_playground_tpu.net.peer import Node
+
+    fleet = _Fleet(tmp_path)
+    stream = [(protocol.OP_ADD, [3, 9, 11]), (protocol.OP_DEL, [9]),
+              (protocol.OP_ADD, [9, 20]), (protocol.OP_DEL, [3, 20]),
+              (protocol.OP_ADD, [40, 41, 42, 43]), (protocol.OP_DEL, [41]),
+              (protocol.OP_ADD, [0, 63])]
+    try:
+        with ServeClient(fleet.addr) as c:
+            for kind, elems in stream:
+                # synchronous: per-shard sub-stream order is the client
+                # order restricted to that shard's keyspace
+                c.submit_async(kind, elems).wait(30.0)
+            members, _ = c.members()
+        # reference 1: one node ingesting the whole stream
+        single = Node(0, E, A)
+        for kind, elems in stream:
+            (single.add if kind == protocol.OP_ADD
+             else single.delete)(*elems)
+        np.testing.assert_array_equal(
+            np.asarray(members),
+            np.nonzero(np.asarray(single.state_slice().present))[0])
+        # reference 2: per-shard bitwise — each shard replica equals a
+        # node (same actor lane) fed exactly its ring-assigned keys
+        for i, fe in enumerate(fleet.frontends):
+            sid = f"s{i}"
+            owned = set(fleet.owned_by(sid))
+            ref = Node(i, E, A)
+            for kind, elems in stream:
+                mine = [e for e in elems if e in owned]
+                if mine:
+                    (ref.add if kind == protocol.OP_ADD
+                     else ref.delete)(*mine)
+            got, want = fe.node.state_slice(), ref.state_slice()
+            for name in want._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(got, name)),
+                    np.asarray(getattr(want, name)),
+                    err_msg=f"shard {sid} field {name}")
+    finally:
+        fleet.close()
+    assert jax is not None
+
+
+def test_spanning_op_acks_once(fleet):
+    """An op whose keys span shards fans out but answers with ONE
+    frame; the split is visible in the router counters."""
+    all_elems = list(range(12))  # 12 keys over 3 shards: guaranteed span
+    with ServeClient(fleet.addr) as c:
+        c.add(*all_elems)
+        members, _ = c.members()
+    assert members == all_elems
+    snap = fleet.router.recorder.snapshot()
+    assert snap["counters"]["router.ops.split"] >= 1
+    assert snap["counters"]["router.acks.relayed"] == 1
+
+
+def test_router_rejects_invalid_and_duplicate(fleet):
+    from go_crdt_playground_tpu.net import framing
+    from go_crdt_playground_tpu.utils import wire
+
+    with ServeClient(fleet.addr) as c:
+        with pytest.raises(protocol.InvalidOp):
+            c.add(E + 3)
+        c.add(1)  # connection survives
+    # duplicate keys, hand-crafted past the client encoder
+    import socket as socket_mod
+
+    body = bytearray()
+    wire._put_varint(body, 5)
+    body.append(protocol.OP_ADD)
+    wire._put_varint(body, 0)
+    wire._put_varint(body, 2)
+    wire._put_varint(body, 7)
+    wire._put_varint(body, 7)
+    raw = socket_mod.create_connection(fleet.addr, timeout=10.0)
+    try:
+        framing.send_frame(raw, protocol.MSG_OP, bytes(body))
+        msg_type, reply = framing.recv_frame(raw, timeout=10.0)
+        assert msg_type == protocol.MSG_REJECT
+        req_id, code, _ = protocol.decode_reject(reply)
+        assert (req_id, code) == (5, protocol.REJECT_INVALID)
+    finally:
+        raw.close()
+
+
+def test_dead_shard_degrades_typed_and_survivors_serve(fleet):
+    """The per-shard degradation ladder: killing one shard turns ITS
+    keyspace into typed ShardUnavailable rejects (breaker-gated, never
+    a silent drop or a stall) while other shards' keyspaces keep
+    acking and the MEMBERS fan-out serves the surviving union."""
+    dead_sid = "s1"
+    dead_keys = fleet.owned_by(dead_sid)
+    live_keys = [e for e in range(E) if e not in set(dead_keys)]
+    with ServeClient(fleet.addr) as c:
+        c.add(live_keys[0])
+        c.add(dead_keys[0])
+        fleet.frontends[1].close()  # the shard goes away
+        t0 = time.monotonic()
+        with pytest.raises(protocol.ShardUnavailable):
+            c.add(dead_keys[1])
+        assert time.monotonic() - t0 < 5.0, "reject stalled"
+        # breaker open now: the next op insta-rejects
+        with pytest.raises(protocol.ShardUnavailable):
+            c.add(dead_keys[2])
+        c.add(live_keys[1])  # survivors keep serving
+        members, _ = c.members()
+    assert live_keys[0] in members and live_keys[1] in members
+    # the dead shard's earlier key is simply absent from the partial
+    # union — a correct CRDT lower bound, counted as partial
+    assert dead_keys[0] not in members
+    snap = fleet.router.recorder.snapshot()
+    assert snap["counters"]["router.shed.unavailable"] >= 1
+    assert snap["counters"]["router.queries.partial"] >= 1
+
+
+def test_spanning_op_with_dead_shard_rejects_whole_op(fleet):
+    """A spanning op with one unreachable owner resolves as ONE typed
+    reject (sub-ops on live shards may have applied — idempotent, the
+    client resubmits the whole op)."""
+    dead_sid = "s2"
+    dead_keys = fleet.owned_by(dead_sid)
+    live_keys = fleet.owned_by("s0")
+    fleet.frontends[2].close()
+    with ServeClient(fleet.addr) as c:
+        with pytest.raises(protocol.ShardUnavailable):
+            c.add(live_keys[0], dead_keys[0])
+        # the live half applied (at-least-once semantics)
+        members, _ = c.members()
+    assert live_keys[0] in members
+
+
+def test_router_stats_fan_out_shapes(fleet):
+    with ServeClient(fleet.addr) as c:
+        c.add(1, 2, 3)
+        snap = c.stats()
+    # frontend-shaped top level (a single-node stats reader works) ...
+    assert snap["counters"]["serve.ops.acked"] >= 1
+    assert "observations" in snap
+    # ... with the per-shard split and the aggregate alongside
+    assert set(snap["shards"]) == {"s0", "s1", "s2"}
+    assert all(s is not None for s in snap["shards"].values())
+    agg = snap["aggregate"]["counters"]
+    assert agg["serve.ops.acked"] == sum(
+        s["counters"].get("serve.ops.acked", 0)
+        for s in snap["shards"].values())
+    assert snap["router"]["counters"]["router.stats"] == 1
+
+
+def test_router_draining_rejects_typed(fleet):
+    with ServeClient(fleet.addr) as c:
+        c.add(1)
+        fleet.router._draining.set()
+        with pytest.raises(protocol.Draining):
+            c.add(2)
+
+
+def test_router_concurrent_clients_converge(fleet):
+    """Pipelined concurrent clients through the router: every op
+    resolves, the union is exactly the submitted set.  A typed
+    ``Overloaded`` shed is NOT a failure — it is the protocol working
+    under 2-core scheduling noise — and resolves the protocol way:
+    idempotent resubmit."""
+    n_clients, per_client = 4, 24
+    errors = []
+
+    def run(base):
+        try:
+            with ServeClient(fleet.addr) as c:
+                todo = [(base + i) % E for i in range(per_client)]
+                for _ in range(50):
+                    ops = [(e, c.submit_async(protocol.OP_ADD, [e]))
+                           for e in todo]
+                    shed = []
+                    for e, op in ops:
+                        try:
+                            op.wait(30.0)
+                        except protocol.Overloaded:
+                            shed.append(e)
+                    if not shed:
+                        return
+                    todo = shed
+                    time.sleep(0.01)
+                errors.append(AssertionError(f"ops never landed: {todo}"))
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(w * per_client,))
+               for w in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not errors, errors
+    with ServeClient(fleet.addr) as c:
+        members, _ = c.members()
+    want = sorted({(w * per_client + i) % E
+                   for w in range(n_clients) for i in range(per_client)})
+    assert members == want

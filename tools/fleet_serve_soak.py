@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Sharded-fleet soak: goodput/p99 vs shard count + the kill leg.
+
+SERVE_CURVE.json proves ONE ingest frontend holds its SLO and
+durability shape; this tool proves the FLEET does (shard/, DESIGN.md
+§17): N real ``serve --ingest`` subprocesses behind a real
+``router --serve`` subprocess, driven through an UNMODIFIED
+``ServeClient`` — the router speaks the serve dialect exactly, so the
+single-node load generator runs against the fleet as-is.
+
+* **shard sweep** — fixed offered load through the router at each
+  shard count: goodput, p99, typed-shed accounting.  Every submitted
+  op must resolve ack-or-typed-reject (``unresolved == 0``): the
+  router converts even downstream connection deaths into typed
+  ``ShardUnavailable`` rejects, never silence.  (On a CPU-starved CI
+  box the CURVE, not monotone scaling, is the commitment — shard
+  processes contend for the same cores.)
+* **kill leg** — a ledgered add-only workload: submit part of the
+  keyspace, SIGKILL one shard MID-STREAM, keep submitting.  During the
+  outage the dead shard's keyspace must reject TYPED (breaker-gated
+  ``REJECT_UNAVAILABLE``) while surviving shards' keyspaces keep
+  acking.  Restart the shard (same port + durable dir →
+  ``restore_durable``), resubmit everything un-acked, and adjudicate
+  the §14 contract at fleet scope: every ACKED op is in the final
+  router MEMBERS union (zero acked-op loss across the SIGKILL) and
+  every member was submitted (no phantoms).
+
+Output: SHARD_CURVE.json next to the other curves.
+
+Usage:
+    python tools/fleet_serve_soak.py            # full sweep
+    python tools/fleet_serve_soak.py --quick    # CI-sized (slow-marked
+                                                # pytest wraps this)
+    python tools/fleet_serve_soak.py --out P    # default SHARD_CURVE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Set
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import serve_soak  # noqa: E402  (tools/serve_soak.py: the load legs)
+
+from go_crdt_playground_tpu.serve import protocol  # noqa: E402
+from go_crdt_playground_tpu.serve.client import ServeClient  # noqa: E402
+from go_crdt_playground_tpu.shard.fleet import (FleetSpec,  # noqa: E402
+                                                ShardFleet)
+
+
+def sweep_leg(root: str, n_shards: int, elements: int, rate: float,
+              duration_s: float, seed: int) -> Dict[str, object]:
+    """One shard count's open-loop point, driven through the router."""
+    spec = FleetSpec(n_shards=n_shards, elements=elements, seed=seed)
+    fleet = ShardFleet(REPO, os.path.join(root, f"sweep-{n_shards}"), spec)
+    try:
+        addr = fleet.start()
+        leg = serve_soak.open_loop_leg(addr, rate, duration_s, elements)
+        leg["shards"] = n_shards
+        return leg
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# kill leg
+# ---------------------------------------------------------------------------
+
+
+def kill_leg(root: str, n_shards: int, elements: int,
+             seed: int) -> Dict[str, object]:
+    """Ledgered workload across a SIGKILL+restart of one shard (module
+    docstring).  Returns the adjudication."""
+    import random
+
+    rng = random.Random(seed)
+    spec = FleetSpec(n_shards=n_shards, elements=elements, seed=seed)
+    fleet = ShardFleet(REPO, os.path.join(root, "kill"), spec)
+    acked: Set[int] = set()
+    submitted: Set[int] = set()
+    outage = {"acked_survivor": 0, "typed_unavailable": 0,
+              "typed_other": 0, "unresolved": 0}
+    victim = 1 % n_shards
+    try:
+        addr = fleet.start()
+        victim_owned = set(fleet.owned_elements(victim))
+        todo = list(range(elements))
+        rng.shuffle(todo)
+        # phase 1: ~40% of the keyspace lands before the kill, so the
+        # ledger holds acks the victim must NOT lose across SIGKILL
+        n_pre = int(0.4 * len(todo))
+        kill_at = n_pre + 1 + rng.randrange(max(1, len(todo) // 10))
+        client = ServeClient(addr, timeout=30.0)
+        killed = False
+        try:
+            for n, e in enumerate(todo):
+                if n == kill_at:
+                    fleet.kill_shard(victim)
+                    killed = True
+                submitted.add(e)
+                try:
+                    client.add(e, deadline_s=5.0)
+                    acked.add(e)
+                    if killed:
+                        outage["acked_survivor"] += 1
+                except protocol.ShardUnavailable:
+                    outage["typed_unavailable"] += 1
+                except protocol.ServeError:
+                    outage["typed_other"] += 1
+                except (OSError, ConnectionError, socket.timeout):
+                    # through the router this must not happen (it
+                    # relays typed rejects even for in-flight deaths);
+                    # counted, adjudicated to zero
+                    outage["unresolved"] += 1
+        finally:
+            client.close()
+        victim_acked_before_kill = sorted(acked & victim_owned)
+
+        # restart the victim on its original port/durable dir, then
+        # resubmit everything un-acked until the whole keyspace is in
+        fleet.restart_shard(victim)
+        retry_deadline = time.monotonic() + 60.0
+        remaining = [e for e in todo if e not in acked]
+        retries = 0
+        while remaining and time.monotonic() < retry_deadline:
+            client = ServeClient(addr, timeout=30.0)
+            try:
+                still: List[int] = []
+                for e in remaining:
+                    try:
+                        client.add(e, deadline_s=5.0)
+                        acked.add(e)
+                    except (protocol.ServeError, OSError, ConnectionError,
+                            socket.timeout):
+                        still.append(e)
+                remaining = still
+            finally:
+                client.close()
+            if remaining:
+                retries += 1
+                time.sleep(0.25)  # breaker half-open probe cadence
+
+        # final read: the fleet union through the router
+        with ServeClient(addr, timeout=60.0) as c:
+            members, vv = c.members()
+        members_set = set(members)
+        return {
+            "shards": n_shards,
+            "elements": elements,
+            "victim": fleet.sid(victim),
+            "victim_keyspace": len(victim_owned),
+            "victim_acked_before_kill": len(victim_acked_before_kill),
+            "outage": outage,
+            "resubmit_rounds": retries,
+            "acked_ops": len(acked),
+            "submitted_ops": len(submitted),
+            "final_members": len(members_set),
+            # MUST be []: an op acked (fsync'd on its shard) vanished —
+            # acked ⊇ the pre-restart ledger, so this covers the kill
+            "lost_acked_ops": sorted(acked - members_set),
+            # MUST be []: a member nobody submitted
+            "phantom_members": sorted(members_set - submitted),
+            "unfinished": sorted(set(todo) - acked),
+        }
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# sweep
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized sweep (the slow-marked pytest wrapper)")
+    ap.add_argument("--out", default=os.path.join(REPO, "SHARD_CURVE.json"))
+    ap.add_argument("--seed", type=int, default=29)
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        elements = 144
+        shard_counts = [1, 3]
+        rate, duration_s = 600.0, 3.0
+        kill_shards = 3
+    else:
+        elements = 288
+        shard_counts = [1, 2, 3, 4]
+        rate, duration_s = 1200.0, 6.0
+        kill_shards = 3
+
+    t0 = time.time()
+    root = tempfile.mkdtemp(prefix="fleet-serve-soak-")
+    curve: List[Dict] = []
+    try:
+        for n in shard_counts:
+            leg = sweep_leg(root, n, elements, rate, duration_s,
+                            args.seed)
+            curve.append(leg)
+            print(json.dumps(leg), flush=True)
+        kill = kill_leg(root, kill_shards, elements, args.seed)
+        print(json.dumps({"kill": {k: kill[k] for k in
+                                   ("outage", "acked_ops",
+                                    "lost_acked_ops", "phantom_members",
+                                    "resubmit_rounds")}}), flush=True)
+    finally:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+    peak = max((leg["goodput"] for leg in curve), default=0.0)
+    artifact = {
+        "metric": ("sharded serving fleet: goodput/p99 vs shard count at "
+                   "fixed offered load through the consistent-hash router "
+                   "(real subprocesses, unmodified ServeClient), plus the "
+                   "SIGKILL-one-shard leg: typed ShardUnavailable rejects "
+                   "for the dead keyspace, surviving keyspaces keep "
+                   "serving, zero acked-op loss across restart"),
+        "value": peak,
+        "unit": "acked ops/s (peak goodput through the router)",
+        "fleet": {"elements": elements, "offered_rate": rate,
+                  "duration_s": duration_s, "seed": args.seed,
+                  "quick": bool(args.quick)},
+        "shard_curve": curve,
+        "kill_leg": kill,
+        "elapsed_s": round(time.time() - t0, 1),
+        "platform": "cpu",
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"wrote {args.out}")
+
+    # honest exit — the acceptance shape, adjudicated:
+    # (a) every submitted op in every leg resolved ack-or-typed-reject
+    ok = all(leg["unresolved"] == 0 for leg in curve)
+    ok = ok and all(leg["goodput"] > 0 for leg in curve)
+    # (b) the kill leg: the outage was OBSERVED (typed rejects for the
+    # dead keyspace, survivor acks during it), nothing acked was lost,
+    # nothing phantom appeared, the whole keyspace finished
+    ok = ok and kill["outage"]["typed_unavailable"] > 0
+    ok = ok and kill["outage"]["acked_survivor"] > 0
+    ok = ok and kill["outage"]["unresolved"] == 0
+    ok = ok and kill["victim_acked_before_kill"] > 0
+    ok = ok and kill["lost_acked_ops"] == []
+    ok = ok and kill["phantom_members"] == []
+    ok = ok and kill["unfinished"] == []
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

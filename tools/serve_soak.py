@@ -24,6 +24,12 @@ under load and its durability contract under SIGKILL:
   final adjudication is the §14 contract extended to ingest: every
   ACKED op is in the final membership (zero acked-op loss) and every
   member was actually submitted (no phantom applies).
+* **chaos** — the same ledgered adjudication under WIRE faults: a
+  ``net/faults.ChaosProxy`` on the ingest port tears OP frames
+  mid-byte, delays acks, drops dials, and opens a client-side
+  partition window, while the generator resubmits every ambiguous
+  outcome idempotently.  Proves the durable-ack claim against what
+  networks do, not just what SIGKILL does.
 
 Output: SERVE_CURVE.json next to the other curves.
 
@@ -39,10 +45,8 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import signal
 import socket
-import subprocess
 import sys
 import tempfile
 import threading
@@ -54,15 +58,10 @@ sys.path.insert(0, REPO)
 
 from go_crdt_playground_tpu.serve import protocol  # noqa: E402
 from go_crdt_playground_tpu.serve.client import ServeClient  # noqa: E402
+from go_crdt_playground_tpu.shard.fleet import (FleetSpec,  # noqa: E402
+                                                ShardProc, free_port)
 
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+_free_port = free_port  # shared impl (shard/fleet.py); old name kept
 
 
 def _pctl(values: List[float], q: float) -> Optional[float]:
@@ -72,36 +71,26 @@ def _pctl(values: List[float], q: float) -> Optional[float]:
     return xs[min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))]
 
 
-class Worker:
-    """One ``serve --ingest`` subprocess (the REAL CLI, not an import)."""
+class Worker(ShardProc):
+    """One ``serve --ingest`` subprocess (the REAL CLI, not an import).
+    A single-shard ``shard/fleet.ShardProc`` — one subprocess-handshake
+    implementation for every soak — that additionally awaits the
+    address at construction (this soak's call sites treat a Worker as
+    ready-or-raised)."""
 
     def __init__(self, dirpath: str, port: int, elements: int, *,
                  queue_depth: int, max_batch: int, flush_ms: float,
                  crash_after_batches: Optional[int] = None):
-        self.dirpath = dirpath
-        self.port = port
-        os.makedirs(dirpath, exist_ok=True)
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
-        if crash_after_batches is not None:
-            env["CRDT_SERVE_CRASH_AFTER_BATCHES"] = str(crash_after_batches)
-        else:
-            env.pop("CRDT_SERVE_CRASH_AFTER_BATCHES", None)
-        self.log = open(os.path.join(dirpath, "worker.log"), "ab")
-        self.proc = subprocess.Popen(
-            [sys.executable, "-m", "go_crdt_playground_tpu", "serve",
-             "--ingest", "--port", str(port),
-             "--elements", str(elements), "--actors", "4",
-             "--durable-dir", os.path.join(dirpath, "state"),
-             "--queue-depth", str(queue_depth),
-             "--max-batch", str(max_batch),
-             "--flush-ms", str(flush_ms), "--checkpoint-every", "0"],
-            env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=self.log)
-        # the pump thread inside _await_address keeps draining stdout
-        # afterwards, so the drain summary can't block the pipe.  On a
-        # failed start, contain the orphan: a still-running worker would
-        # hold the (reused) crash-leg port and a CPU core past the soak.
+        spec = FleetSpec(n_shards=1, elements=elements, actors=4,
+                         queue_depth=queue_depth, max_batch=max_batch,
+                         flush_ms=flush_ms)
+        super().__init__(REPO, dirpath, spec, 0, port,
+                         crash_after_batches=crash_after_batches)
+        # On a failed start, contain the orphan: a still-running worker
+        # would hold the (reused) crash-leg port and a CPU core past
+        # the soak.
         try:
-            self.addr = self._await_address()
+            self.await_address()
         except Exception:
             if self.proc.poll() is None:
                 self.proc.kill()
@@ -109,55 +98,8 @@ class Worker:
             self.log.close()
             raise
 
-    def _await_address(self) -> Tuple[str, int]:
-        # readline() through a thread + queue: a worker wedged BEFORE
-        # printing (import deadlock, warmup stall) keeps the pipe open
-        # without writing, and a bare readline would block past any
-        # deadline check — the tests/test_cli.py pattern
-        import queue as queue_mod
-
-        lines: "queue_mod.Queue[bytes]" = queue_mod.Queue()
-
-        def pump() -> None:
-            while True:
-                line = self.proc.stdout.readline()
-                lines.put(line)
-                if not line:
-                    return
-
-        threading.Thread(target=pump, daemon=True).start()
-        deadline = time.time() + 120
-        while True:
-            try:
-                line = lines.get(timeout=max(0.1, deadline - time.time()))
-            except queue_mod.Empty:
-                raise RuntimeError("worker printed no address within 120s")
-            if not line:
-                raise RuntimeError(
-                    f"worker exited before address (rc={self.proc.poll()})")
-            m = re.search(rb"listening on ([\d.]+):(\d+)", line)
-            if m:
-                return m.group(1).decode(), int(m.group(2))
-            if time.time() > deadline:
-                raise RuntimeError(f"no address line within 120s: {line!r}")
-
-    def sigkill(self) -> None:
-        if self.proc.poll() is None:
-            os.kill(self.proc.pid, signal.SIGKILL)
-        self.proc.wait()
-
     def wait_dead(self, timeout: float = 120.0) -> int:
         return self.proc.wait(timeout=timeout)
-
-    def terminate(self) -> int:
-        if self.proc.poll() is None:
-            self.proc.terminate()
-            try:
-                return self.proc.wait(timeout=30)
-            except subprocess.TimeoutExpired:
-                self.proc.kill()
-                return self.proc.wait()
-        return self.proc.returncode
 
     def close_log(self) -> None:
         self.log.close()
@@ -443,6 +385,143 @@ def crash_leg(root: str, elements: int, *, queue_depth: int,
 
 
 # ---------------------------------------------------------------------------
+# chaos leg (wire faults on the INGEST port)
+# ---------------------------------------------------------------------------
+
+
+def chaos_leg(root: str, elements: int, *, queue_depth: int,
+              max_batch: int, flush_ms: float, seed: int,
+              reconnect_every: int = 8) -> Dict[str, object]:
+    """Durable-ack claims under WIRE faults, not just SIGKILL: a
+    ``net/faults.ChaosProxy`` sits on the ingest port injecting torn OP
+    frames (mid-frame truncation), delayed acks, dropped dials, and a
+    client-side partition window, while a ledgered add-only workload
+    submits through it.  Every transport failure is an AMBIGUOUS
+    outcome — the op may or may not have applied — and the generator
+    resolves it the protocol way: idempotent resubmit.  Adjudication:
+    every ACKED element is in the final membership read DIRECTLY from
+    the worker (no proxy), every member was submitted, and the proxy
+    counters prove the faults actually fired.  ``reconnect_every``
+    bounds ops per connection so the per-connection fault draws keep
+    landing."""
+    import random
+
+    from go_crdt_playground_tpu.net.faults import ChaosProxy, ChaosScenario
+
+    rng = random.Random(seed)
+    port = _free_port()
+    dirpath = os.path.join(root, "chaos")
+    w = Worker(dirpath, port, elements, queue_depth=queue_depth,
+               max_batch=max_batch, flush_ms=flush_ms)
+    scenario = ChaosScenario(
+        drop_rate=0.15, truncate_rate=0.2, truncate_window=(1, 48),
+        delay_rate=0.3, delay_s=0.01)
+    proxy = ChaosProxy(w.addr, seed=seed, scenario=scenario)
+    addr = ("127.0.0.1", proxy.port)
+    acked: Set[int] = set()
+    submitted: Set[int] = set()
+    transport_failures = 0
+    typed_rejects = 0
+    partition_refusals = 0
+    give_ups: List[int] = []
+    client: Optional[ServeClient] = None
+    ops_on_conn = 0
+    worker_done = False
+    try:
+        todo = list(range(elements))
+        rng.shuffle(todo)
+        partition_at = len(todo) // 2
+        partitioned = False
+        for n, e in enumerate(todo):
+            if n == partition_at:
+                # client-side partition: all NEW dials refused until
+                # heal.  Closing the live client forces the stream
+                # through a redial, so the window is always OBSERVED
+                # (the proxy accepts-then-drops, which the client sees
+                # as a dead connection on first use); once the refusal
+                # registers in the proxy counters the partition heals
+                # and the stream must resume with no loss.
+                proxy.partition()
+                partitioned = True
+                if client is not None:
+                    client.close()
+                    client = None
+            submitted.add(e)
+            done = False
+            for _ in range(50):
+                if partitioned and proxy.counters()["refused"] >= 1:
+                    partition_refusals = proxy.counters()["refused"]
+                    proxy.heal()
+                    partitioned = False
+                if client is None or ops_on_conn >= reconnect_every:
+                    if client is not None:
+                        client.close()
+                        client = None
+                    try:
+                        client = ServeClient(addr, timeout=10.0)
+                        ops_on_conn = 0
+                    except (OSError, ConnectionError):
+                        transport_failures += 1
+                        time.sleep(0.01)
+                        continue
+                try:
+                    client.add(e, deadline_s=5.0)
+                    acked.add(e)
+                    ops_on_conn += 1
+                    done = True
+                    break
+                except protocol.ServeError:
+                    typed_rejects += 1
+                    ops_on_conn += 1
+                    time.sleep(0.01)
+                except (OSError, ConnectionError, socket.timeout):
+                    # ambiguous: torn frame/dead conn — resubmit
+                    transport_failures += 1
+                    client.close()
+                    client = None
+                    time.sleep(0.01)
+            if not done:
+                give_ups.append(e)
+        # final read DIRECTLY from the worker — the adjudication must
+        # not ride the faulty wire it is judging
+        with ServeClient(w.addr, timeout=60.0) as direct:
+            members, _vv = direct.members()
+        w.terminate()
+        w.close_log()
+        worker_done = True
+    finally:
+        if client is not None:
+            client.close()
+        proxy.close()
+        if not worker_done:
+            # an exception anywhere above must not orphan the worker
+            # subprocess (it would hold its port + a core past the soak)
+            w.terminate()
+            w.close_log()
+    members_set = set(members)
+    counters = proxy.counters()
+    return {
+        "elements": elements,
+        # derived from the ACTUAL scenario object, so the committed
+        # artifact can never misreport the injected rates
+        "scenario": {"drop_rate": scenario.drop_rate,
+                     "truncate_rate": scenario.truncate_rate,
+                     "delay_rate": scenario.delay_rate,
+                     "delay_s": scenario.delay_s,
+                     "partition_window": True},
+        "proxy_counters": counters,
+        "transport_failures": transport_failures,
+        "typed_rejects": typed_rejects,
+        "partition_refusals": partition_refusals,
+        "acked_ops": len(acked),
+        "final_members": len(members_set),
+        "lost_acked_ops": sorted(acked - members_set),  # MUST be []
+        "phantom_members": sorted(members_set - submitted),  # MUST be []
+        "gave_up": give_ups,  # MUST be [] — retries always land
+    }
+
+
+# ---------------------------------------------------------------------------
 # sweep
 # ---------------------------------------------------------------------------
 
@@ -501,6 +580,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                                     ("kills", "acked_ops",
                                      "lost_acked_ops",
                                      "phantom_members")}}), flush=True)
+        chaos = chaos_leg(root, elements, queue_depth=queue_depth,
+                          max_batch=max_batch, flush_ms=flush_ms,
+                          seed=args.seed)
+        print(json.dumps({"chaos": {k: chaos[k] for k in
+                                    ("proxy_counters", "acked_ops",
+                                     "lost_acked_ops", "phantom_members",
+                                     "gave_up")}}), flush=True)
     finally:
         import shutil
 
@@ -522,6 +608,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "open_loop": open_curve,
         "closed_loop": closed_curve,
         "crash": crash,
+        "chaos": chaos,
         "elapsed_s": round(time.time() - t0, 1),
         "platform": "cpu",
     }
@@ -555,6 +642,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     ok = ok and crash["kills"]["window_hook"] >= 1
     ok = ok and crash["kills"]["parent_sigkill"] >= 1
     ok = ok and crash["unfinished"] == []
+    # (d) the chaos leg: the wire faults FIRED (a green chaos leg with
+    # zero injected faults proves nothing) and the durable-ack claim
+    # held under them — nothing acked lost, nothing phantom, every
+    # element eventually landed through idempotent resubmits
+    pc = chaos["proxy_counters"]
+    ok = ok and pc["dropped"] + pc["truncated"] >= 1
+    ok = ok and pc["delayed"] >= 1
+    ok = ok and pc["refused"] >= 1
+    ok = ok and chaos["lost_acked_ops"] == []
+    ok = ok and chaos["phantom_members"] == []
+    ok = ok and chaos["gave_up"] == []
     return 0 if ok else 1
 
 
