@@ -26,6 +26,13 @@ a fleet-scale taste:
                                               # owner-map digest and exit
                                               # (cross-process routing
                                               # determinism probe)
+  python -m go_crdt_playground_tpu reshard --router H:P --join s9=H:P
+                                              # live ring membership change
+                                              # (DESIGN.md §18): fence the
+                                              # moved slice, transfer it,
+                                              # swap the ring atomically;
+                                              # --leave ID drains a shard
+                                              # out instead
 """
 
 from __future__ import annotations
@@ -172,6 +179,27 @@ def _cmd_router(args) -> int:
         return 2
     shards = dict(args.shard)
     if not args.serve:
+        # the dry-run must probe the ring a SERVING router would use:
+        # with --state-dir that is the last committed membership, not
+        # the flags (else the determinism probe falsely mismatches any
+        # router that ever resharded)
+        source = "flags"
+        if args.state_dir:
+            from go_crdt_playground_tpu.shard.handoff import (
+                PHASE_COMMITTED, load_ring_file)
+
+            rec = load_ring_file(args.state_dir)
+            if rec is not None and rec.get("phase") == PHASE_COMMITTED:
+                if (int(rec.get("elements", args.elements))
+                        != args.elements
+                        or int(rec.get("seed", args.seed)) != args.seed):
+                    print("error: persisted ring disagrees with the "
+                          "(E, seed) flags — delete ring.json to reset",
+                          file=sys.stderr, flush=True)
+                    return 2
+                shards = {s: (a[0], int(a[1]))
+                          for s, a in rec["shards"].items()}
+                source = "state-dir"
         ring = HashRing(list(shards), seed=args.seed)
         # ONE owner-map sweep shared by the load split and the digest
         # (it is the dry-run's dominant cost: E x shards blake2b)
@@ -179,7 +207,8 @@ def _cmd_router(args) -> int:
         stats = load_stats(owners, len(ring.shards))
         print(f"owner-map digest {ring.digest(args.elements, owners)} "
               f"(shards={list(ring.shards)} seed={args.seed} "
-              f"E={args.elements}) loads={stats['loads']} "
+              f"E={args.elements} ring from {source}) "
+              f"loads={stats['loads']} "
               f"max/mean={stats['max_over_mean']:.3f}", flush=True)
         return 0
 
@@ -188,15 +217,20 @@ def _cmd_router(args) -> int:
 
     from go_crdt_playground_tpu.shard.router import ShardRouter
 
-    router = ShardRouter(shards, args.elements, seed=args.seed)
+    router = ShardRouter(shards, args.elements, seed=args.seed,
+                         state_dir=args.state_dir,
+                         transfer_timeout_s=args.transfer_timeout)
     # the banner's load split reuses the router's OWN precomputed owner
     # map — recomputing it here would double the O(E x shards) blake2b
     # startup cost for a log line
     stats = load_stats(router._owner, len(router.ring.shards))
+    rinfo = router.route().info()
     host, bound = router.serve(port=args.port)
     print(f"Shard router listening on {host}:{bound} "
           f"(E={args.elements} shards={list(router.ring.shards)} "
-          f"seed={args.seed} loads={stats['loads']})", flush=True)
+          f"seed={args.seed} loads={stats['loads']} "
+          f"ring gen={rinfo['generation']} digest={rinfo['digest']})",
+          flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     try:
@@ -209,6 +243,29 @@ def _cmd_router(args) -> int:
     acks = snap["counters"].get("router.acks.relayed", 0)
     print(f"drained: {fwd} ops forwarded, {acks} acks relayed", flush=True)
     return 0
+
+
+def _cmd_reshard(args) -> int:
+    """The live-resharding admin verb (DESIGN.md §18), from the shell:
+    one RESHARD frame to the router, block for the whole handoff, print
+    the accounting JSON.  Exit 0 on commit; nonzero on abort — with the
+    old ring still serving, so a failed resize is retryable, not an
+    outage."""
+    import json
+
+    from go_crdt_playground_tpu.serve import protocol
+    from go_crdt_playground_tpu.serve.client import ServeClient
+
+    if args.join is not None:
+        mode, sid, addr = protocol.RESHARD_JOIN, args.join[0], args.join[1]
+    else:
+        mode, sid, addr = protocol.RESHARD_LEAVE, args.leave, None
+    with ServeClient(tuple(args.router), timeout=args.timeout) as c:
+        ok, detail = c.reshard(mode, sid, addr, timeout=args.timeout)
+    verb = "join" if mode == protocol.RESHARD_JOIN else "leave"
+    print(json.dumps({"ok": ok, "mode": verb, "sid": sid,
+                      "detail": detail}, indent=2), flush=True)
+    return 0 if ok else 1
 
 
 def main(argv=None) -> int:
@@ -307,6 +364,37 @@ def main(argv=None) -> int:
                    type=_shard_spec, metavar="ID=HOST:PORT", required=True,
                    help="one shard frontend (repeatable; order does not "
                         "affect routing)")
+    r.add_argument("--state-dir", dest="state_dir", default=None,
+                   help="persist committed ring swaps here (live "
+                        "resharding, DESIGN.md §18): a restarted router "
+                        "adopts the last committed ring over --shard "
+                        "flags; a kill mid-handoff restarts on the old "
+                        "ring")
+    r.add_argument("--transfer-timeout", dest="transfer_timeout",
+                   type=float, default=30.0,
+                   help="keyspace-handoff transfer deadline in seconds "
+                        "(size to the slice: past it the handoff aborts "
+                        "and the old ring keeps serving)")
+
+    rs = sub.add_parser(
+        "reshard",
+        help="live ring membership change against a running router "
+             "(DESIGN.md §18): --join adds a shard (its keyspace slice "
+             "is fenced, transferred, then the ring swaps atomically), "
+             "--leave drains one out; a failed handoff leaves the old "
+             "ring serving and exits nonzero")
+    rs.add_argument("--router", required=True, metavar="HOST:PORT",
+                    type=_peer_addr, help="the router's client address")
+    grp = rs.add_mutually_exclusive_group(required=True)
+    grp.add_argument("--join", default=None, type=_shard_spec,
+                     metavar="ID=HOST:PORT",
+                     help="add this serve --ingest frontend to the ring")
+    grp.add_argument("--leave", default=None, metavar="ID",
+                     help="remove this shard id from the ring (its "
+                          "keyspace transfers to the survivors; the "
+                          "shard process itself keeps running)")
+    rs.add_argument("--timeout", type=float, default=120.0,
+                    help="whole-handoff reply budget in seconds")
     args = p.parse_args(argv)
     if args.platform != "auto":
         import jax
@@ -329,6 +417,8 @@ def main(argv=None) -> int:
         return _cmd_serve(args.port)
     if args.cmd == "router":
         return _cmd_router(args)
+    if args.cmd == "reshard":
+        return _cmd_reshard(args)
     return 2
 
 

@@ -44,13 +44,15 @@ PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOCK_TARGETS = ["net/peer.py", "net/antientropy.py", "utils/wal.py",
                 "serve/admission.py", "serve/session.py",
                 "serve/batcher.py", "serve/frontend.py",
-                "serve/client.py", "obs/metrics.py",
-                "shard/ring.py", "shard/router.py", "shard/fleet.py"]
+                "serve/client.py", "serve/host.py", "obs/metrics.py",
+                "shard/ring.py", "shard/router.py", "shard/fleet.py",
+                "shard/handoff.py"]
 # extra files that participate in the lock-ORDER graph (their locks can
 # nest under the runtime's)
 LOCK_ORDER_EXTRA = ["utils/checkpoint.py"]
 DURABILITY_TARGETS = ["utils/wal.py", "utils/checkpoint.py",
-                      "utils/checkpoint_sharded.py", "utils/fsutil.py"]
+                      "utils/checkpoint_sharded.py", "utils/fsutil.py",
+                      "shard/handoff.py"]
 PURITY_TARGETS = ["ops/merge.py", "ops/delta.py", "ops/lattices.py",
                   "ops/vv.py", "ops/compact.py", "ops/pallas_merge.py",
                   "ops/pallas_delta.py", "ops/ingest.py"]
@@ -61,7 +63,9 @@ ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "session": "Session", "batcher": "MicroBatcher",
                 "supervisor": "SyncSupervisor", "target": "Node",
                 "ring": "HashRing", "router": "ShardRouter",
-                "relay": "_Relay", "_client": "ServeClient"}
+                "relay": "_Relay", "_client": "ServeClient",
+                "host": "ConnHost", "handoff": "HandoffCoordinator",
+                "_route": "RouteState"}
 
 
 def _paths(rel: List[str], root: str) -> List[str]:

@@ -33,6 +33,13 @@ MSG_ERROR = 3
 
 MODE_DELTA = 0
 MODE_FULL = 1
+# keyspace-handoff slice transfer (DESIGN.md §18): the payload is the
+# donor's complete FENCED state for the lanes it names, applied by
+# OVERWRITE (ops/delta.slice_apply), never by vv arbitration — the
+# recipient's vv may legitimately cover dots it never received (prior
+# slice pushes join donor vvs), and arbitration would drop exactly
+# those lanes
+MODE_SLICE = 2
 
 _MAX_BODY = 1 << 30
 
@@ -121,14 +128,17 @@ def send_frame(sock: socket.socket, msg_type: int, body: bytes) -> int:
 
 
 def recv_frame(sock: socket.socket, timeout: Optional[float] = None,
-               max_body: int = _MAX_BODY) -> Tuple[int, bytes]:
+               max_body=_MAX_BODY) -> Tuple[int, bytes]:
     """Receive one frame.  ``timeout`` bounds the WHOLE frame (absolute
     deadline semantics), not each recv, and the socket's own timeout
     configuration is restored afterwards; on None it applies per recv
     as usual.  ``max_body`` caps the declared body size BEFORE any body
     byte is buffered — the default fits peer FULL-state payloads;
     dialects facing untrusted clients (serve/) pass a far smaller cap
-    so a hostile length header cannot balloon per-connection memory."""
+    so a hostile length header cannot balloon per-connection memory.
+    It may be a callable ``msg_type -> int`` for dialects whose legal
+    frame sizes differ by verb (the serve frontend's keyspace-handoff
+    SLICE_PUSH scales with the universe; its op frames stay tiny)."""
     if timeout is None:
         return _recv_frame(sock, None, max_body)
     saved = sock.gettimeout()
@@ -139,13 +149,14 @@ def recv_frame(sock: socket.socket, timeout: Optional[float] = None,
 
 
 def _recv_frame(sock: socket.socket, deadline: Optional[float],
-                max_body: int = _MAX_BODY) -> Tuple[int, bytes]:
+                max_body=_MAX_BODY) -> Tuple[int, bytes]:
     magic = _recv_exact(sock, 2, deadline)
     if magic != MAGIC:
         raise ProtocolError(f"bad magic {magic!r}")
     msg_type = _recv_exact(sock, 1, deadline)[0]
     n = _recv_varint(sock, deadline)
-    if n > min(max_body, _MAX_BODY):
+    limit = max_body(msg_type) if callable(max_body) else max_body
+    if n > min(limit, _MAX_BODY):
         raise ProtocolError(f"oversized frame ({n} bytes)")
     body = _recv_exact(sock, n, deadline)
     if msg_type == MSG_ERROR:
@@ -203,7 +214,7 @@ def decode_payload_msg(body: bytes, num_elements: int, num_actors: int):
     if not body:
         raise ProtocolError("empty PAYLOAD body")
     mode = body[0]
-    if mode not in (MODE_DELTA, MODE_FULL):
+    if mode not in (MODE_DELTA, MODE_FULL, MODE_SLICE):
         raise ProtocolError(f"unknown payload mode {mode}")
     try:
         src_actor, pos = wire._get_varint(body, 1)

@@ -62,8 +62,8 @@ import numpy as np
 
 from go_crdt_playground_tpu.net import framing
 from go_crdt_playground_tpu.net.framing import (MODE_DELTA, MODE_FULL,
-                                                MSG_HELLO, MSG_PAYLOAD,
-                                                ProtocolError)
+                                                MODE_SLICE, MSG_HELLO,
+                                                MSG_PAYLOAD, ProtocolError)
 
 
 class SyncError(Exception):
@@ -360,6 +360,11 @@ class Node:
                 processed=payload.src_processed,
             )
             merged = delta_ops.full_merge_delta(me, src, self.delta_semantics)
+        elif mode == MODE_SLICE:
+            # keyspace handoff: the fenced donor slice is authoritative
+            # for its lanes — overwrite, never vv-arbitrate (see
+            # extract_slice / ops/delta.slice_apply)
+            merged = delta_ops.slice_apply(me, payload)
         else:
             merged = delta_ops.delta_apply(
                 me, payload, self.delta_semantics,
@@ -396,6 +401,61 @@ class Node:
         body = framing.encode_payload_msg(
             MODE_DELTA, self.actor, np.asarray(me.processed), payload)
         self.wal.append(self._guard_bytes(pre_vv) + body)
+
+    # -- keyspace handoff (live resharding, DESIGN.md §18) ------------------
+
+    def extract_slice(self, element_mask: np.ndarray) -> bytes:
+        """Build the keyspace-handoff transfer payload: this replica's
+        COMPLETE state for the masked elements (live entries with their
+        dots, un-resurrected deletion records with theirs, plus our full
+        vv/processed vectors), encoded as a ``MODE_SLICE`` anti-entropy
+        PAYLOAD frame body.
+
+        MODE_SLICE applies by OVERWRITE of the payload's lanes
+        (ops/delta.slice_apply), never by vv arbitration: slice pushes
+        join donor vvs into the recipient, so its vv comes to cover
+        donor dots it never received (vvs are per-lane, slices are
+        per-element), and an arbitrated apply would drop exactly those
+        dots when a LATER handoff moves them here — a silently lost
+        acked op.  Overwrite is sound because the router fences the
+        slice for the whole transfer: the donor is the unique
+        authority for these elements (ownership lineage always moves
+        state forward whole, so a lane this donor has no state for was
+        never acked anywhere), lanes outside the payload are
+        untouched, and a retried push is idempotent."""
+        import jax
+        import jax.numpy as jnp
+
+        from go_crdt_playground_tpu.ops import delta as delta_ops
+
+        mask = np.asarray(element_mask, bool)
+        if mask.shape != (self.num_elements,):
+            raise ValueError(f"slice mask shape {mask.shape} does not "
+                             f"match universe ({self.num_elements},)")
+        m = jnp.asarray(mask)
+        with self._lock:
+            me = jax.tree.map(lambda x: x[0], self._state)
+            p = delta_ops.delta_extract(
+                me, jnp.zeros(self.num_actors, jnp.uint32))
+            p = p._replace(
+                changed=p.changed & m,
+                ch_da=jnp.where(m, p.ch_da, 0),
+                ch_dc=jnp.where(m, p.ch_dc, 0),
+                deleted=p.deleted & m,
+                del_da=jnp.where(m, p.del_da, 0),
+                del_dc=jnp.where(m, p.del_dc, 0))
+            return framing.encode_payload_msg(
+                MODE_SLICE, self.actor, np.asarray(me.processed), p)
+
+    def apply_payload_body(self, body: bytes) -> None:
+        """Apply one anti-entropy PAYLOAD frame body (the recipient
+        half of a keyspace handoff push — and any other out-of-band
+        payload delivery).  Rides ``_apply_msg`` unchanged, so the body
+        is WAL-logged with its replay guard BEFORE the state mutates:
+        once the caller acks, the slice survives a SIGKILL exactly like
+        any client op (restore_durable replays it)."""
+        with self._lock:
+            self._apply_msg(body)
 
     def replay_wal(self, wal) -> dict:
         """Apply every intact, CAUSALLY-SAFE WAL record (oldest-first)

@@ -214,6 +214,42 @@ def _delta_apply_impl(
     ), trace
 
 
+def slice_apply(dst: AWSetDeltaState, p: DeltaPayload) -> AWSetDeltaState:
+    """Keyspace-handoff apply (DESIGN.md §18): the payload is the
+    donor's complete FENCED state for the lanes it names
+    (``changed | deleted``), so those lanes are OVERWRITTEN — present
+    bit, live dot, deletion record — never vv-arbitrated.
+
+    Why not ``delta_apply``: slice payloads join donor vvs into the
+    recipient, so after one handoff the recipient's vv covers donor
+    dots it never received (a vv is per-LANE, a slice is per-ELEMENT —
+    no single vv can scope the claim).  A later slice moving one of
+    those dots here would then read as already-seen and be dropped by
+    phase 1's arbitration: a silently lost acked op.  Overwrite is
+    sound because the router fences the slice for the whole transfer —
+    the donor state is the unique authority for those elements, and
+    re-applying the same payload (the retry path) is idempotent.
+    Lanes outside the payload are untouched; the vv/processed joins
+    keep the recipient's clocks monotone for its own extraction
+    paths."""
+    in_slice = p.changed | p.deleted
+    present = jnp.where(in_slice, p.changed, dst.present)
+    da = jnp.where(in_slice, p.ch_da, dst.dot_actor)
+    dc = jnp.where(in_slice, p.ch_dc, dst.dot_counter)
+    deleted = jnp.where(in_slice, p.deleted, dst.deleted)
+    del_da = jnp.where(in_slice, p.del_da, dst.del_dot_actor)
+    del_dc = jnp.where(in_slice, p.del_dc, dst.del_dot_counter)
+    vv = vv_join(dst.vv, p.src_vv)
+    processed = jnp.maximum(dst.processed, p.src_processed)
+    idx = p.src_actor.astype(jnp.int32)
+    processed = processed.at[idx].max(p.src_vv[idx])
+    return AWSetDeltaState(
+        vv=vv, present=present, dot_actor=da, dot_counter=dc,
+        actor=dst.actor, deleted=deleted, del_dot_actor=del_da,
+        del_dot_counter=del_dc, processed=processed,
+    )
+
+
 def full_merge_delta(dst: AWSetDeltaState, src: AWSetDeltaState,
                      delta_semantics: str) -> AWSetDeltaState:
     """First-contact branch (awset-delta_test.go:53-56): plain full-state

@@ -12,13 +12,16 @@ runtime for dissemination.  Admission is bounded and sheds with typed
 
 from go_crdt_playground_tpu.serve.admission import (AdmissionQueue,  # noqa: F401
                                                     OpRequest)
-from go_crdt_playground_tpu.serve.apply import ApplyTarget  # noqa: F401
+from go_crdt_playground_tpu.serve.apply import (ApplyTarget,  # noqa: F401
+                                                HandoffTarget)
 from go_crdt_playground_tpu.serve.batcher import MicroBatcher  # noqa: F401
 from go_crdt_playground_tpu.serve.client import (PendingOp,  # noqa: F401
                                                  ServeClient)
 from go_crdt_playground_tpu.serve.frontend import ServeFrontend  # noqa: F401
+from go_crdt_playground_tpu.serve.host import ConnHost  # noqa: F401
 from go_crdt_playground_tpu.serve.protocol import (DeadlineExceeded,  # noqa: F401
                                                    Draining, InvalidOp,
+                                                   KeyspaceMoving,
                                                    Overloaded, ServeError,
                                                    ShardUnavailable)
 from go_crdt_playground_tpu.serve.session import Session  # noqa: F401

@@ -40,3 +40,24 @@ class ApplyTarget(Protocol):
         durable-on-return: the batcher acks the batch's ops the moment
         this returns."""
         ...
+
+
+@runtime_checkable
+class HandoffTarget(ApplyTarget, Protocol):
+    """The live-resharding seam (DESIGN.md §18): what a replica must
+    additionally offer for its frontend to serve keyspace-handoff
+    SLICE_PULL/SLICE_PUSH requests.  ``net/peer.Node`` satisfies it
+    as-is; a mesh-sharded or remote replica plugs in here exactly like
+    it plugs into the batcher."""
+
+    def extract_slice(self, element_mask: np.ndarray) -> bytes:
+        """The donor half: the replica's complete state for the masked
+        elements as an anti-entropy PAYLOAD body (delta-framed — the
+        recipient's apply must be additive outside the slice)."""
+        ...
+
+    def apply_payload_body(self, body: bytes) -> None:
+        """The recipient half.  durable-on-return, like
+        ``ingest_batch``: the frontend acks the push the moment this
+        returns, and the handoff's ring swap trusts that ack."""
+        ...

@@ -98,6 +98,16 @@ class ServeClient:
             target=self._read_loop, name="serve-client-reader", daemon=True)
         self._reader.start()
 
+    @property
+    def closed(self) -> bool:
+        """True once the reader exited (server gone, idle timeout, or
+        ``close()``): every later submit fails fast.  A connection POOL
+        (shard/router._ShardLink) polls this to sweep-and-redial a
+        client that died of read-idle instead of paying one doomed
+        request to find out."""
+        with self._lock:
+            return self._closed
+
     # -- submit path --------------------------------------------------------
 
     def submit_async(self, kind: int, elements: Sequence[int],
@@ -140,7 +150,8 @@ class ServeClient:
         return self.submit_async(protocol.OP_DEL, elements,
                                  deadline_s).wait(self.timeout)
 
-    def _request_reply(self, msg_type: int, encode) -> object:
+    def _request_reply(self, msg_type: int, encode,
+                       timeout: Optional[float] = None) -> object:
         with self._lock:
             if self._closed:
                 raise ConnectionError("client closed")
@@ -162,7 +173,7 @@ class ServeClient:
                 op._resolve(ConnectionError("send failed"), None)
             raise
         try:
-            op.wait(self.timeout)
+            op.wait(self.timeout if timeout is None else timeout)
         except BaseException:
             # abandoned waiter: drop our entries so a LATE reply can't
             # strand a decoded snapshot in _replies forever (_finish
@@ -172,7 +183,9 @@ class ServeClient:
                 self._replies.pop(req_id, None)
             raise
         with self._lock:
-            return self._replies.pop(req_id)
+            # None for ack-only replies (e.g. a SLICE_PUSH answered by
+            # a plain ACK): resolution without a stored body
+            return self._replies.pop(req_id, None)
 
     def members(self) -> Tuple[List[int], np.ndarray]:
         """Read back the replica's live element ids + vv."""
@@ -186,6 +199,49 @@ class ServeClient:
         both consume."""
         return self._request_reply(protocol.MSG_STATS,
                                    protocol.encode_stats)
+
+    # -- live resharding (DESIGN.md §18) ------------------------------------
+
+    def slice_pull(self, elements: Sequence[int]) -> bytes:
+        """Handoff donor read: the shard's complete state for
+        ``elements`` as an opaque anti-entropy payload body."""
+        return self._request_reply(
+            protocol.MSG_SLICE_PULL,
+            lambda rid: protocol.encode_slice_pull(rid, elements))
+
+    def slice_push(self, payload: bytes) -> None:
+        """Handoff recipient write: hand a pulled slice payload to its
+        new owner; returns once the shard has durably applied it."""
+        self._request_reply(
+            protocol.MSG_SLICE_PUSH,
+            lambda rid: protocol.encode_slice_push(rid, payload))
+
+    def reshard(self, mode: int, sid: str,
+                addr: Optional[Tuple[str, int]] = None,
+                timeout: Optional[float] = None) -> Tuple[bool, dict]:
+        """The router admin verb: drive a live join
+        (``protocol.RESHARD_JOIN``, ``addr`` = the new frontend) or
+        leave (``protocol.RESHARD_LEAVE``).  Blocks for the WHOLE
+        handoff (fence → transfer → swap), so ``timeout`` must be
+        sized to the keyspace — and it cannot exceed the client's own
+        ``timeout`` (the CONNECTION read deadline: past it the reader
+        thread times the idle admin connection out and resolves this
+        call as ConnectionError even though the handoff may later
+        commit — construct the client with the larger timeout
+        instead; refused loudly rather than silently mis-reported).
+        Returns ``(ok, detail)``: the handoff accounting on commit,
+        the abort reason on failure (the old ring is still serving in
+        that case)."""
+        if timeout is not None and timeout > self.timeout:
+            raise ValueError(
+                f"reshard timeout {timeout}s exceeds this client's "
+                f"connection timeout {self.timeout}s — the reader "
+                "would time the connection out first; construct "
+                f"ServeClient(addr, timeout={timeout}) instead")
+        return self._request_reply(
+            protocol.MSG_RESHARD,
+            lambda rid: protocol.encode_reshard(rid, mode, sid, addr),
+            timeout=timeout)
 
     # -- reader -------------------------------------------------------------
 
@@ -211,6 +267,16 @@ class ServeClient:
                     req_id, snapshot = protocol.decode_stats_reply(body)
                     with self._lock:
                         self._replies[req_id] = snapshot
+                    self._finish(req_id, None, now)
+                elif msg_type == protocol.MSG_SLICE_STATE:
+                    req_id, payload = protocol.decode_slice_state(body)
+                    with self._lock:
+                        self._replies[req_id] = payload
+                    self._finish(req_id, None, now)
+                elif msg_type == protocol.MSG_RESHARD_REPLY:
+                    req_id, ok, detail = protocol.decode_reshard_reply(body)
+                    with self._lock:
+                        self._replies[req_id] = (ok, detail)
                     self._finish(req_id, None, now)
                 else:
                     err = framing.ProtocolError(

@@ -28,7 +28,6 @@ the batcher adds the latency/occupancy streams.
 from __future__ import annotations
 
 import os
-import socket
 import threading
 import time
 from typing import Optional, Sequence, Tuple
@@ -38,30 +37,21 @@ from go_crdt_playground_tpu.net.peer import Node
 from go_crdt_playground_tpu.serve import protocol
 from go_crdt_playground_tpu.serve.admission import AdmissionQueue, OpRequest
 from go_crdt_playground_tpu.serve.batcher import MicroBatcher
+from go_crdt_playground_tpu.serve.host import ConnHost
 from go_crdt_playground_tpu.serve.session import Session
 
 Addr = Tuple[str, int]
 
+# reshard-soak crash hook: "pull" SIGKILLs the process on the next
+# SLICE_PULL (the donor dying mid-handoff), "push" on the next
+# SLICE_PUSH before it applies (the recipient dying mid-handoff) — the
+# two windows the fleet soak's kill-mid-handoff leg adjudicates (a
+# failed handoff must leave the OLD ring fully serving)
+_SLICE_CRASH_ENV = "CRDT_SERVE_CRASH_ON_SLICE"
+
 
 class ServeFrontend:
     """TCP op-ingest frontend over one durable AWSet replica."""
-
-    # a client that connects and sends nothing must release its reader
-    # thread eventually; ops themselves are admitted in microseconds.
-    # Replies ride the session's OWN bounded write half (serve/session.
-    # py), so a client that stops reading can never head-of-line-block
-    # the batcher for this long.
-    IDLE_TIMEOUT_S = 60.0
-    # every legal serve frame is tiny (an OP is a few varints per key);
-    # cap the declared body size far below framing's peer-payload limit
-    # so an untrusted length header cannot balloon per-connection memory
-    MAX_FRAME_BODY = 1 << 20
-
-    # client-connection cap (the net/peer.py _conn_slots pattern): at
-    # capacity new dials are shed, not queued — unbounded reader-thread
-    # growth is how a slow-loris client kills the process, and an op
-    # client retries idempotently
-    MAX_CONNS = 256
 
     def __init__(self, num_elements: int, num_actors: int, *,
                  actor: int = 0, durable_dir: Optional[str] = None,
@@ -102,16 +92,29 @@ class ServeFrontend:
                 checkpoint_every=checkpoint_every,
                 interval_s=sync_interval_s, wal_fsync=wal_fsync,
                 recorder=self.recorder, seed=seed)
-        self._conn_slots = threading.BoundedSemaphore(
-            self.MAX_CONNS if max_conns is None else max_conns)
-        self._lock = threading.Lock()
-        self._sessions: set = set()  # guarded-by: _lock
-        self._draining = threading.Event()
+        # the listener/reader/conn-slot plumbing is the shared host
+        # (serve/host.py) — the router tier runs the identical stack,
+        # so accept-path fixes land once.  Frame caps are PER VERB: the
+        # keyspace-handoff verbs scale with the universe (a SLICE_PUSH
+        # body is two dense E-lane sections + ~6 bytes per entry, a
+        # SLICE_PULL request one varint per moved element) — without
+        # that a large-keyspace reshard could never transfer — while
+        # every other frame keeps the tiny cap that bounds what an
+        # untrusted length header can make one connection buffer.
+        slice_cap = max(ConnHost.MAX_FRAME_BODY,
+                        16 * num_elements + 4096)
+        slice_verbs = (protocol.MSG_SLICE_PUSH, protocol.MSG_SLICE_PULL)
+        self.host = ConnHost(
+            self._dispatch, recorder=self.recorder,
+            counter_prefix="serve", thread_name="serve",
+            max_conns=max_conns,
+            max_frame_body=lambda t: (slice_cap if t in slice_verbs
+                                      else ConnHost.MAX_FRAME_BODY))
         self._closed = threading.Event()
-        # race-ok: serve()/close() owner thread; accept loop snapshots
-        self._listener: Optional[socket.socket] = None
-        # race-ok: serve()/close() owner thread only
-        self._accept_thread: Optional[threading.Thread] = None
+        # race-ok: serve() owner thread sets it before any reader runs
+        self.addr: Optional[Addr] = None
+        # race-ok: read-only after __init__ (reshard-soak crash hook)
+        self._slice_crash = os.environ.get(_SLICE_CRASH_ENV) or None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -120,11 +123,10 @@ class ServeFrontend:
         """Start serving client ops; returns the bound (host, port).
         With ``peer_port`` (or any registered peers) the node also
         starts its anti-entropy server / supervisor loop."""
-        if self._listener is not None:
+        if self.host.listening:
             raise RuntimeError("already serving")
         self._warmup()
-        sock = socket.create_server((host, port))
-        self._listener = sock
+        self.addr = self.host.listen(host, port)
         self.batcher.start()
         if peer_port is not None:
             self.node.serve(host, peer_port)
@@ -132,10 +134,7 @@ class ServeFrontend:
                                             or self.supervisor.
                                             checkpoint_every > 0):
             self.supervisor.start()
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="serve-accept", daemon=True)
-        self._accept_thread.start()
-        return sock.getsockname()[:2]
+        return self.addr
 
     def _warmup(self) -> None:
         """Run one full throwaway ingest (batch apply + δ extraction +
@@ -161,6 +160,13 @@ class ServeFrontend:
             add[0, 0] = True  # one live lane: the δ-extract path runs
             scratch.ingest_batch(add, np.zeros((B, E), bool),
                                  np.asarray([True] + [False] * (B - 1)))
+            # warm the keyspace-handoff transfer path too (slice
+            # extract + payload apply): the fence window of a live
+            # reshard must pay the flush-scale transfer, not a
+            # multi-second first-compile of delta_apply
+            mask = np.zeros(E, bool)
+            mask[0] = True
+            scratch.apply_payload_body(scratch.extract_slice(mask))
             with scratch._lock:
                 scratch.wal.close()
 
@@ -169,24 +175,10 @@ class ServeFrontend:
         the process lets go of them."""
         if self._closed.is_set():
             return
-        self._draining.set()
-        listener = self._listener
-        if listener is not None:
-            # shutdown BEFORE close (the session.py lesson, for the
-            # LISTENER): a bare close does not reliably wake the accept
-            # loop blocked in accept(), and until it wakes the kernel
-            # keeps completing new dials into the backlog — "stop
-            # accepting dials" must mean refused, not accepted-then-
-            # Draining
-            try:
-                listener.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                listener.close()
-            except OSError:
-                pass
-            self._listener = None
+        # stop accepting dials FIRST (the host does the shutdown-
+        # before-close listener dance); in-flight connections get typed
+        # Draining rejects for new ops from here on
+        self.host.stop_accepting()
         self.batcher.drain(timeout=drain_timeout_s)
         if self.supervisor is not None:
             self.supervisor.stop()
@@ -208,18 +200,10 @@ class ServeFrontend:
             wal = self.node.wal
         if wal is not None:
             wal.close()
-        with self._lock:
-            sessions = list(self._sessions)
-            self._sessions.clear()
         # flush: the batcher's final acks are in per-session writer
-        # queues (serve/session.py); give the writers ONE shared
-        # bounded window to get them onto the wire before teardown — a
-        # shared deadline, not per-session, so a herd of stalled
-        # clients costs ~2s total, never sessions x 2s
-        flush_deadline = time.monotonic() + 2.0
-        for s in sessions:
-            s.close(flush_timeout_s=max(
-                0.0, flush_deadline - time.monotonic()))
+        # queues (serve/session.py); the host gives the writers ONE
+        # shared bounded window to get them onto the wire
+        self.host.close_sessions(flush_timeout_s=2.0)
         self._closed.set()
 
     def __enter__(self) -> "ServeFrontend":
@@ -228,69 +212,25 @@ class ServeFrontend:
     def __exit__(self, *exc) -> None:
         self.close()
 
-    # -- accept / per-connection reader -------------------------------------
+    # -- request dispatch (runs on the host's reader threads) ---------------
 
-    def _accept_loop(self) -> None:
-        sock = self._listener  # snapshot: close() may null the field
-        assert sock is not None
-        while not self._draining.is_set():
-            try:
-                conn, addr = sock.accept()
-            except OSError:
-                return  # listener closed
-            if not self._conn_slots.acquire(blocking=False):
-                self._count("serve.shed.connections")
-                conn.close()  # at capacity: shed the dial, not queue it
-                continue
-            self._count("serve.connections")
-            session = Session(conn, peer=f"{addr[0]}:{addr[1]}")
-            with self._lock:
-                self._sessions.add(session)
-            # finally-shaped slot handoff (the net/peer.py lesson): ANY
-            # failure to start the reader must shed the dial AND return
-            # the slot, else capacity decays one leak at a time
-            handed_off = False
-            try:
-                threading.Thread(
-                    target=self._reader, args=(conn, session),
-                    daemon=True).start()
-                handed_off = True
-            except RuntimeError:
-                pass  # OS thread exhaustion: shed, keep accepting
-            finally:
-                if not handed_off:
-                    with self._lock:
-                        self._sessions.discard(session)
-                    session.close()
-                    self._conn_slots.release()
-
-    def _reader(self, conn: socket.socket, session: Session) -> None:
-        try:
-            conn.settimeout(self.IDLE_TIMEOUT_S)
-            while not session.closed:
-                try:
-                    msg_type, body = framing.recv_frame(
-                        conn, timeout=self.IDLE_TIMEOUT_S,
-                        max_body=self.MAX_FRAME_BODY)
-                except (framing.ProtocolError, OSError):
-                    return  # torn/idle/garbled connection: drop it
-                if msg_type == protocol.MSG_OP:
-                    if not self._handle_op(session, body):
-                        return
-                elif msg_type == protocol.MSG_QUERY:
-                    self._handle_query(session, body)
-                elif msg_type == protocol.MSG_STATS:
-                    self._handle_stats(session, body)
-                else:
-                    session.send(framing.MSG_ERROR,
-                                 f"unexpected frame type {msg_type}"
-                                 .encode())
-                    return
-        finally:
-            with self._lock:
-                self._sessions.discard(session)
-            session.close()
-            self._conn_slots.release()
+    def _dispatch(self, session: Session, msg_type: int,
+                  body: bytes) -> bool:
+        if msg_type == protocol.MSG_OP:
+            return self._handle_op(session, body)
+        if msg_type == protocol.MSG_QUERY:
+            self._handle_query(session, body)
+            return True
+        if msg_type == protocol.MSG_STATS:
+            self._handle_stats(session, body)
+            return True
+        if msg_type == protocol.MSG_SLICE_PULL:
+            return self._handle_slice_pull(session, body)
+        if msg_type == protocol.MSG_SLICE_PUSH:
+            return self._handle_slice_push(session, body)
+        session.send(framing.MSG_ERROR,
+                     f"unexpected frame type {msg_type}".encode())
+        return False
 
     def _handle_op(self, session: Session, body: bytes) -> bool:
         """Admit one OP frame; False ends the connection (undecodable
@@ -316,7 +256,7 @@ class ServeFrontend:
                 req_id, protocol.REJECT_INVALID,
                 "duplicate element ids in one op"))
             return True
-        if self._draining.is_set():
+        if self.host.draining:
             self._count("serve.shed.draining")
             session.send(protocol.MSG_REJECT, protocol.encode_reject(
                 req_id, protocol.REJECT_DRAINING, "frontend draining"))
@@ -365,6 +305,88 @@ class ServeFrontend:
             return
         session.send(protocol.MSG_STATS_REPLY, protocol.encode_stats_reply(
             req_id, self.recorder.snapshot()))
+
+    # -- keyspace handoff (live resharding, DESIGN.md §18) ------------------
+
+    def _crash_if_armed(self, which: str) -> None:
+        """The reshard soak's kill-mid-handoff hook: SIGKILL the whole
+        process at the named slice verb — donor death ("pull") before
+        any state leaves, recipient death ("push") before any state
+        lands, so the aborted handoff provably transferred nothing."""
+        if self._slice_crash == which:
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def _handle_slice_pull(self, session: Session, body: bytes) -> bool:
+        """Serve the donor half of a keyspace handoff: the complete
+        slice state as an anti-entropy payload body (opaque bytes the
+        router shuttles to the new owner)."""
+        try:
+            req_id, elements = protocol.decode_slice_pull(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return False
+        E = self.node.num_elements
+        if any(not 0 <= e < E for e in elements):
+            self._count("serve.rejects.invalid")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_INVALID,
+                f"slice element outside universe E={E}"))
+            return True
+        if self.host.draining:
+            self._count("serve.shed.draining")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_DRAINING, "frontend draining"))
+            return True
+        self._crash_if_armed("pull")
+        import numpy as np
+
+        mask = np.zeros(E, bool)
+        mask[elements] = True
+        payload = self.node.extract_slice(mask)
+        self._count("serve.slice.pulls")
+        session.send(protocol.MSG_SLICE_STATE,
+                     protocol.encode_slice_state(req_id, payload))
+        return True
+
+    def _handle_slice_push(self, session: Session, body: bytes) -> bool:
+        """Serve the recipient half: apply the pushed slice through the
+        WAL-logged payload path and ack only once it is durable — the
+        ring swap that follows this ack trusts it exactly like a client
+        trusts an op ack."""
+        try:
+            req_id, payload = protocol.decode_slice_push(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return False
+        if self.host.draining:
+            self._count("serve.shed.draining")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_DRAINING, "frontend draining"))
+            return True
+        self._crash_if_armed("push")
+        try:
+            self.node.apply_payload_body(payload)
+        except framing.ProtocolError as e:
+            # malformed/incompatible payload: deterministic — the
+            # router must abort the handoff, not retry the same bytes
+            self._count("serve.rejects.invalid")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_INVALID,
+                f"slice payload refused: {e}"))
+            return True
+        except ValueError as e:
+            # transient server trouble (e.g. a closing WAL refusing the
+            # append): retryable, like a poison batch
+            self._count("serve.slice.push_failures")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_OVERLOADED,
+                f"slice apply failed (retry): {e}"))
+            return True
+        self._count("serve.slice.pushes")
+        session.send(protocol.MSG_ACK, protocol.encode_ack(req_id))
+        return True
 
     def _count(self, name: str, n: int = 1) -> None:
         if self.recorder is not None:

@@ -16,6 +16,13 @@ codec; there is no new byte format below the body layouts here.
              | varint A | A x varint vv
     STATS    varint req_id
     STATS_REPLY  varint req_id | utf-8 JSON (obs.Recorder.snapshot())
+    RESHARD  varint req_id | mode(1: 0=join 1=leave) | str sid
+             | [join only: str host | varint port]     (str = varint
+             len + utf-8)
+    RESHARD_REPLY  varint req_id | ok(1) | utf-8 JSON detail
+    SLICE_PULL     varint req_id | varint k | k x varint element_id
+    SLICE_STATE    varint req_id | anti-entropy PAYLOAD body (opaque)
+    SLICE_PUSH     varint req_id | anti-entropy PAYLOAD body (opaque)
 
 ``deadline_us`` is the client's remaining latency budget in
 MICROSECONDS at send time (0 = none); the server converts it to an
@@ -26,8 +33,10 @@ drop): ``REJECT_OVERLOADED`` (admission queue full), ``REJECT_EXPIRED``
 (deadline passed before apply), ``REJECT_DRAINING`` (shutdown in
 progress), ``REJECT_INVALID`` (element id outside the universe),
 ``REJECT_UNAVAILABLE`` (the routed shard owning the keyspace is
-unreachable — shard/router.py degradation, DESIGN.md §17).  Each maps
-to a typed client-side exception below.
+unreachable — shard/router.py degradation, DESIGN.md §17),
+``REJECT_MOVING`` (the element's slice is fenced for a live-reshard
+handoff — brief, retryable, DESIGN.md §18).  Each maps to a typed
+client-side exception below.
 
 An ``ACK`` is only ever sent AFTER the op's effects are fsync'd in the
 replica's delta WAL (``Node.ingest_batch`` group commit) — the same
@@ -36,7 +45,7 @@ durable-before-ack contract as DESIGN.md §14.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -51,15 +60,33 @@ MSG_QUERY = 19
 MSG_MEMBERS = 20
 MSG_STATS = 21
 MSG_STATS_REPLY = 22
+# live-resharding verbs (DESIGN.md §18).  RESHARD is the router-side
+# admin verb (join/leave a shard); SLICE_PULL/SLICE_STATE/SLICE_PUSH are
+# the keyspace-handoff transfer the router drives against shard
+# frontends: PULL asks the donor for the moved slice as an anti-entropy
+# payload body (net/framing's MODE_SLICE wire form: authoritative for
+# the lanes it names, applied by overwrite — ops/delta.slice_apply —
+# with everything outside the slice untouched), PUSH hands that body
+# to the new owner, which applies it through the normal WAL-logged
+# payload path and acks only once it is as durable as any client op.
+MSG_RESHARD = 23
+MSG_RESHARD_REPLY = 24
+MSG_SLICE_PULL = 25
+MSG_SLICE_STATE = 26
+MSG_SLICE_PUSH = 27
 
 OP_ADD = 0
 OP_DEL = 1
+
+RESHARD_JOIN = 0
+RESHARD_LEAVE = 1
 
 REJECT_OVERLOADED = 1
 REJECT_EXPIRED = 2
 REJECT_DRAINING = 3
 REJECT_INVALID = 4
 REJECT_UNAVAILABLE = 5
+REJECT_MOVING = 6
 
 _MAX_REASON = 1 << 16
 
@@ -99,12 +126,24 @@ class ShardUnavailable(ServeError):
     retry with backoff; other shards' keyspaces keep serving."""
 
 
+class KeyspaceMoving(ServeError):
+    """The op named an element inside a keyspace slice currently FENCED
+    for a live-reshard handoff (shard/handoff.py): the router refused it
+    TYPED rather than risk landing it on a donor whose slice snapshot
+    has already been taken (a silent acked-op loss at ring swap).  The
+    op was NOT applied anywhere.  Transient and brief — the fence lasts
+    one slice transfer; retry with backoff and the op lands on whichever
+    shard owns the key when the ring settles (old owner on abort, new
+    owner on commit)."""
+
+
 REJECT_EXCEPTIONS = {
     REJECT_OVERLOADED: Overloaded,
     REJECT_EXPIRED: DeadlineExceeded,
     REJECT_DRAINING: Draining,
     REJECT_INVALID: InvalidOp,
     REJECT_UNAVAILABLE: ShardUnavailable,
+    REJECT_MOVING: KeyspaceMoving,
 }
 
 # exception class -> wire code (the ROUTER's relay direction: a typed
@@ -265,6 +304,153 @@ def encode_members(req_id: int, members: Sequence[int],
     for c in vv:
         wire._put_varint(out, int(c))
     return bytes(out)
+
+
+def _put_str(out: bytearray, s: str) -> None:
+    raw = s.encode("utf-8")
+    wire._put_varint(out, len(raw))
+    out.extend(raw)
+
+
+def _get_str(body: bytes, pos: int) -> Tuple[str, int]:
+    n, pos = wire._get_varint(body, pos)
+    if pos + n > len(body):
+        raise ProtocolError("truncated string")
+    return body[pos:pos + n].decode("utf-8"), pos + n
+
+
+def encode_reshard(req_id: int, mode: int, sid: str,
+                   addr: Optional[Tuple[str, int]] = None) -> bytes:
+    """The admin verb: stage a ring change and drive the handoff.
+    ``mode`` is RESHARD_JOIN (``addr`` required: the joining frontend's
+    serve address) or RESHARD_LEAVE (``addr`` must be None)."""
+    if mode not in (RESHARD_JOIN, RESHARD_LEAVE):
+        raise ValueError(f"unknown reshard mode {mode}")
+    if (addr is None) == (mode == RESHARD_JOIN):
+        raise ValueError("join requires addr; leave forbids it")
+    if not sid:
+        raise ValueError("empty shard id")
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    out.append(mode)
+    _put_str(out, sid)
+    if addr is not None:
+        _put_str(out, addr[0])
+        wire._put_varint(out, int(addr[1]))
+    return bytes(out)
+
+
+def decode_reshard(body: bytes
+                   ) -> Tuple[int, int, str, Optional[Tuple[str, int]]]:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+        if pos >= len(body):
+            raise ProtocolError("truncated RESHARD body")
+        mode = body[pos]
+        pos += 1
+        if mode not in (RESHARD_JOIN, RESHARD_LEAVE):
+            raise ProtocolError(f"unknown reshard mode {mode}")
+        sid, pos = _get_str(body, pos)
+        if not sid:
+            raise ProtocolError("empty shard id in RESHARD")
+        addr = None
+        if mode == RESHARD_JOIN:
+            host, pos = _get_str(body, pos)
+            port, pos = wire._get_varint(body, pos)
+            addr = (host, port)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos != len(body):
+        raise ProtocolError("trailing bytes after RESHARD")
+    return req_id, mode, sid, addr
+
+
+def encode_reshard_reply(req_id: int, ok: bool, detail: dict) -> bytes:
+    """``detail`` is the handoff's accounting (moved counts, epoch,
+    fence window, old/new digests — or the abort reason), JSON so the
+    soak and operators read the same record."""
+    import json
+
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    out.append(1 if ok else 0)
+    return bytes(out) + json.dumps(detail).encode("utf-8")
+
+
+def decode_reshard_reply(body: bytes) -> Tuple[int, bool, dict]:
+    import json
+
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+        if pos >= len(body):
+            raise ProtocolError("truncated RESHARD_REPLY body")
+        ok = body[pos] != 0
+        detail = json.loads(body[pos + 1:].decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as err:
+        raise ProtocolError(str(err)) from err
+    return req_id, ok, detail
+
+
+def encode_slice_pull(req_id: int, elements: Sequence[int]) -> bytes:
+    if not elements:
+        raise ValueError("a slice pull must name at least one element")
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    wire._put_varint(out, len(elements))
+    for e in elements:
+        wire._put_varint(out, int(e))
+    return bytes(out)
+
+
+def decode_slice_pull(body: bytes) -> Tuple[int, List[int]]:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+        k, pos = wire._get_varint(body, pos)
+        if k == 0:
+            raise ProtocolError("empty slice pull")
+        elements = []
+        for _ in range(k):
+            e, pos = wire._get_varint(body, pos)
+            elements.append(e)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos != len(body):
+        raise ProtocolError("trailing bytes after SLICE_PULL")
+    return req_id, elements
+
+
+def _encode_slice_body(req_id: int, payload: bytes) -> bytes:
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    return bytes(out) + payload
+
+
+def _decode_slice_body(body: bytes, what: str) -> Tuple[int, bytes]:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos >= len(body):
+        raise ProtocolError(f"empty {what} payload")
+    return req_id, body[pos:]
+
+
+def encode_slice_state(req_id: int, payload: bytes) -> bytes:
+    """``payload`` is an anti-entropy PAYLOAD frame body (opaque to the
+    router: it shuttles the bytes donor→recipient unparsed)."""
+    return _encode_slice_body(req_id, payload)
+
+
+def decode_slice_state(body: bytes) -> Tuple[int, bytes]:
+    return _decode_slice_body(body, "SLICE_STATE")
+
+
+def encode_slice_push(req_id: int, payload: bytes) -> bytes:
+    return _encode_slice_body(req_id, payload)
+
+
+def decode_slice_push(body: bytes) -> Tuple[int, bytes]:
+    return _decode_slice_body(body, "SLICE_PUSH")
 
 
 def decode_members(body: bytes) -> Tuple[int, List[int], np.ndarray]:

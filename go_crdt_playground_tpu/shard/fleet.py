@@ -153,7 +153,8 @@ class ShardProc(_Proc):
 
     def __init__(self, repo: str, dirpath: str, spec: FleetSpec,
                  index: int, port: int,
-                 crash_after_batches: Optional[int] = None):
+                 crash_after_batches: Optional[int] = None,
+                 crash_on_slice: Optional[str] = None):
         self.index = index
         self.port = port
         self.dirpath = dirpath
@@ -161,6 +162,10 @@ class ShardProc(_Proc):
         env = {}
         if crash_after_batches is not None:
             env["CRDT_SERVE_CRASH_AFTER_BATCHES"] = str(crash_after_batches)
+        if crash_on_slice is not None:
+            # "pull" = die as handoff donor, "push" = die as recipient
+            # (serve/frontend.py kill-mid-handoff hook)
+            env["CRDT_SERVE_CRASH_ON_SLICE"] = crash_on_slice
         argv = [sys.executable, "-m", "go_crdt_playground_tpu", "serve",
                 "--ingest", "--port", str(port),
                 "--elements", str(spec.elements),
@@ -173,22 +178,30 @@ class ShardProc(_Proc):
         super().__init__(argv, cwd=repo,
                          log_path=os.path.join(dirpath, "shard.log"),
                          env=env,
-                         env_drop=("CRDT_SERVE_CRASH_AFTER_BATCHES",))
+                         env_drop=("CRDT_SERVE_CRASH_AFTER_BATCHES",
+                                   "CRDT_SERVE_CRASH_ON_SLICE"))
 
 
 class RouterProc(_Proc):
-    """One ``router --serve`` subprocess over a fixed shard map."""
+    """One ``router --serve`` subprocess over a shard map (the INITIAL
+    fleet — live resharding grows/shrinks it; with ``state_dir`` the
+    committed ring survives router restarts)."""
 
     def __init__(self, repo: str, dirpath: str, spec: FleetSpec,
-                 shard_addrs: Dict[str, Addr], port: int):
+                 shard_addrs: Dict[str, Addr], port: int,
+                 state_dir: Optional[str] = None,
+                 transfer_timeout_s: float = 10.0):
         os.makedirs(dirpath, exist_ok=True)
         argv = [sys.executable, "-m", "go_crdt_playground_tpu", "router",
                 "--serve", "--port", str(port),
                 "--elements", str(spec.elements),
-                "--seed", str(spec.seed)]
+                "--seed", str(spec.seed),
+                "--transfer-timeout", str(transfer_timeout_s)]
         for sid in sorted(shard_addrs):
             host, p = shard_addrs[sid]
             argv += ["--shard", f"{sid}={host}:{p}"]
+        if state_dir is not None:
+            argv += ["--state-dir", state_dir]
         super().__init__(argv, cwd=repo,
                          log_path=os.path.join(dirpath, "router.log"))
 
@@ -207,6 +220,8 @@ class ShardFleet:
     shards: List[Optional[ShardProc]] = field(default_factory=list)
     shard_ports: List[int] = field(default_factory=list)
     router: Optional[RouterProc] = None
+    # pass a directory to persist committed ring swaps (live resharding)
+    router_state_dir: Optional[str] = None
 
     @staticmethod
     def sid(index: int) -> str:
@@ -230,7 +245,8 @@ class ShardFleet:
         addrs = {self.sid(i): ("127.0.0.1", self.shard_ports[i])
                  for i in range(self.spec.n_shards)}
         self.router = RouterProc(self.repo, os.path.join(self.root, "router"),
-                                 self.spec, addrs, router_port)
+                                 self.spec, addrs, router_port,
+                                 state_dir=self.router_state_dir)
         return self.router.await_address()
 
     def kill_shard(self, index: int) -> None:
@@ -242,16 +258,43 @@ class ShardFleet:
         shard.log.close()
         self.shards[index] = None
 
-    def restart_shard(self, index: int) -> None:
+    def restart_shard(self, index: int,
+                      crash_on_slice: Optional[str] = None) -> None:
         """Restart a killed shard on ITS ORIGINAL port and durable dir
         (``Node.restore_durable``: checkpoint ⊔ WAL tail) — the router
         config is static, so recovery is invisible to it beyond the
-        breaker's probe."""
+        breaker's probe.  ``crash_on_slice`` re-arms the kill-mid-
+        handoff hook (the reshard soak's donor-death leg restarts an
+        EXISTING shard armed to die on the next slice pull)."""
         assert self.shards[index] is None, "shard still running"
         self.shards[index] = ShardProc(
             self.repo, os.path.join(self.root, self.sid(index)),
-            self.spec, index, self.shard_ports[index])
+            self.spec, index, self.shard_ports[index],
+            crash_on_slice=crash_on_slice)
         self.shards[index].await_address()
+
+    def launch_shard(self, index: int,
+                     crash_on_slice: Optional[str] = None) -> Addr:
+        """Launch a shard BEYOND the initial set (the reshard joiner):
+        allocates its port/slot, starts the subprocess, returns its
+        serve address.  It owns no keyspace until a RESHARD join
+        commits; ``spec.actors`` must cover its actor lane."""
+        if index < self.spec.n_shards:
+            raise ValueError(f"shard {index} is part of the initial "
+                             "fleet; use restart_shard")
+        if index >= self.spec.actors:
+            raise ValueError(f"shard {index} has no actor lane "
+                             f"(actors={self.spec.actors})")
+        while len(self.shard_ports) <= index:
+            self.shard_ports.append(free_port())
+        while len(self.shards) <= index:
+            self.shards.append(None)
+        assert self.shards[index] is None, "shard already running"
+        self.shards[index] = ShardProc(
+            self.repo, os.path.join(self.root, self.sid(index)),
+            self.spec, index, self.shard_ports[index],
+            crash_on_slice=crash_on_slice)
+        return self.shards[index].await_address()
 
     def owned_elements(self, index: int) -> List[int]:
         """The element ids shard ``index`` owns under the fleet ring

@@ -135,6 +135,27 @@ def load_stats(owners: np.ndarray, num_shards: int) -> Dict[str, float]:
     }
 
 
+def handoff_plan(before: np.ndarray, after: np.ndarray,
+                 shards_before: Sequence[str],
+                 shards_after: Sequence[str]
+                 ) -> List[Tuple[str, str, List[int]]]:
+    """The keyspace-handoff work list between two owner maps: one
+    ``(donor_sid, recipient_sid, element_ids)`` entry per directed pair
+    whose ownership changed — exactly the slices a live reshard must
+    transfer before the ring swap (shard/handoff.py).  Sorted for
+    deterministic transfer order; under HRW minimal remap a join's
+    recipients are all the joiner and a leave's donors all the
+    leaver."""
+    pairs: Dict[Tuple[str, str], List[int]] = {}
+    for e in range(len(before)):
+        src = shards_before[before[e]]
+        dst = shards_after[after[e]]
+        if src != dst:
+            pairs.setdefault((src, dst), []).append(e)
+    return [(src, dst, elems)
+            for (src, dst), elems in sorted(pairs.items())]
+
+
 def remap_fraction(before: np.ndarray, after: np.ndarray,
                    shards_before: Sequence[str],
                    shards_after: Sequence[str]) -> Dict[str, object]:

@@ -6,22 +6,35 @@ it is indistinguishable from a ``ServeFrontend`` — an unmodified
 back by req_id.  Downstream it holds one pipelined ``ServeClient`` per
 shard frontend and forwards:
 
-* **OP** — elements are grouped by the ring's owner
-  (``shard/ring.HashRing``; the owner map is precomputed once, so the
-  hot path is one array lookup per element).  An op whose keys span
+* **OP** — elements are grouped by the ACTIVE route's owner map (a
+  ``shard/handoff.RouteState`` snapshot: ring + precomputed owner map +
+  fence, swapped atomically by live resharding — the hot path reads one
+  snapshot and one array lookup per element).  An op whose keys span
   shards fans out as one sub-op per owner; the upstream reply is ONE
   frame: ACK when every sub-op acked, else the first reject (relayed
   with the downstream's own code — the client sees what the shard
   said).  Sub-ops on reachable shards may have applied when another
   shard rejects; that is the protocol's at-least-once shape — CRDT ops
-  are idempotent, the client resubmits the whole op.
-* **QUERY** — fan-out to every shard, MEMBERS replies joined by set
-  union and vv joined element-wise (shards tick disjoint actor lanes).
-  Unreachable shards are EXCLUDED and counted: the union is a correct
-  CRDT lower bound (membership only inflates), not an error.
+  are idempotent, the client resubmits the whole op.  An op naming a
+  FENCED element (a slice mid-handoff) gets the typed retryable
+  ``REJECT_MOVING`` — never applied anywhere, resubmit lands it on the
+  post-swap owner.
+* **QUERY** — fan-out to every shard; each shard's members are
+  FILTERED BY OWNERSHIP before the union (a donor's stale copy of a
+  moved slice must not shadow the new owner — the no-double-serve half
+  of DESIGN.md §18), vv joined element-wise (shards tick disjoint actor
+  lanes).  Unreachable shards are EXCLUDED and counted: the union is a
+  correct CRDT lower bound (membership only inflates), not an error.
 * **STATS** — fan-out; the JSON reply carries ``router`` (this tier's
   recorder), ``shards`` (per-shard snapshots, ``null`` for unreachable
-  ones) and ``aggregate`` (summed shard counters).
+  ones), ``aggregate`` (summed shard counters) and ``ring`` (the
+  ACTIVE route's generation + owner-map digest + member list — how an
+  operator or the fleet soak asserts which ring a router is actually
+  serving; before this, a swapped ring was observationally invisible).
+* **RESHARD** — the admin verb: stage a candidate ring, drive the
+  keyspace handoff, swap atomically (``shard/handoff.py`` owns the
+  state machine; a failed handoff replies typed failure with the old
+  ring still serving).
 
 **Degradation ladder** (the per-shard half of DESIGN.md §13's):
 each shard link carries the EXISTING ``net/antientropy.CircuitBreaker``
@@ -35,7 +48,9 @@ typed reject, so THROUGH the router every submitted op resolves
 ack-or-typed-reject even across a shard SIGKILL (the fleet soak's
 ``unresolved == 0`` adjudication).
 
-Relay threads write upstream through the per-session writer queues
+The listener/reader/conn-slot plumbing is the shared ``serve/host.py``
+``ConnHost`` (the frontend runs the identical stack); relay threads
+write upstream through the per-session writer queues
 (serve/session.py), so one read-stalled client never blocks a shard
 link's reply stream.
 """
@@ -53,7 +68,12 @@ from go_crdt_playground_tpu.net import framing
 from go_crdt_playground_tpu.net.antientropy import CircuitBreaker
 from go_crdt_playground_tpu.serve import protocol
 from go_crdt_playground_tpu.serve.client import ServeClient
+from go_crdt_playground_tpu.serve.host import ConnHost
 from go_crdt_playground_tpu.serve.session import Session
+from go_crdt_playground_tpu.shard.handoff import (PHASE_COMMITTED,
+                                                  HandoffCoordinator,
+                                                  HandoffError, RouteState,
+                                                  load_ring_file)
 from go_crdt_playground_tpu.shard.ring import HashRing
 from go_crdt_playground_tpu.utils.backoff import Backoff, BackoffPolicy
 
@@ -100,7 +120,9 @@ class _Relay:
 class _ShardLink:
     """Router-side state for ONE shard frontend: a lazily-dialed
     pipelined ServeClient, the breaker/backoff gate, and the
-    downstream-req-id -> _Relay map."""
+    downstream-req-id -> (_Relay, elements) map (the element list rides
+    along so a reshard fence can count in-flight sub-ops touching the
+    moving slice)."""
 
     # bound on the DIAL alone: a blackholed shard (SYN silently
     # dropped, no RST) must cost its keyspace at most this per breaker
@@ -125,7 +147,8 @@ class _ShardLink:
         # generation: a dead client's sweep can only ever resolve its
         # own generation's entries, never a successor's
         self._gen = 0  # guarded-by: _lock
-        self._pending: Dict[Tuple[int, int], _Relay] = {}  # guarded-by: _lock
+        self._pending: Dict[Tuple[int, int],
+                            Tuple[_Relay, Tuple[int, ...]]] = {}  # guarded-by: _lock
         self.breaker = CircuitBreaker(
             failure_threshold=breaker_threshold,
             cooldown_s=breaker_cooldown_s)
@@ -133,6 +156,21 @@ class _ShardLink:
         self._earliest_redial = 0.0  # guarded-by: _lock
 
     # -- dialing ------------------------------------------------------------
+
+    # requires-lock: _lock
+    def _sweep_dead_client_locked(self) -> Optional[ServeClient]:
+        """Detach a client whose reader already exited (read-idle
+        timeout on a quiet link, or the server went away between
+        requests) so the caller redials instead of paying one doomed
+        request to find out.  NOT a breaker failure: an idle-reaped
+        connection says nothing about the shard's health.  The caller
+        must close the returned client OUTSIDE the lock (its reader is
+        already dead, but a racing death-sweep callback takes this
+        lock)."""
+        if self._client is not None and self._client.closed:
+            client, self._client = self._client, None
+            return client
+        return None
 
     # requires-lock: _lock
     def _ensure_client_locked(self) -> ServeClient:
@@ -184,9 +222,10 @@ class _ShardLink:
         race back (submit + register share the lock the reply callback
         takes).  Raises ``_Unreachable`` — the caller owes the relay a
         typed resolve_one."""
-        retired = None
+        retired: List[Optional[ServeClient]] = []
         try:
             with self._lock:
+                retired.append(self._sweep_dead_client_locked())
                 client = self._ensure_client_locked()
                 gen = self._gen
                 try:
@@ -197,19 +236,28 @@ class _ShardLink:
                     # (closed below, outside the lock) so the next op
                     # redials through the breaker; its in-flight ops
                     # resolve via its own sweep -> _downstream_result.
-                    retired = self._retire_client_locked(gen)
+                    retired.append(self._retire_client_locked(gen))
                     raise _Unreachable(
                         f"shard {self.sid} send failed: {e}") from e
-                self._pending[(gen, op.req_id)] = relay
+                self._pending[(gen, op.req_id)] = (relay, tuple(elements))
         finally:
-            if retired is not None:
-                retired.close()
+            for r in retired:
+                if r is not None:
+                    r.close()
+
+    def pending_touching(self, mask: np.ndarray) -> int:
+        """In-flight sub-ops naming any masked element — the reshard
+        fence waits this to zero before snapshotting the donor slice
+        (every resolution is a durable donor ack or a typed reject)."""
+        with self._lock:
+            return sum(1 for _, elems in self._pending.values()
+                       if any(mask[e] for e in elems))
 
     # -- reply path (runs on the downstream client's reader thread) ---------
 
     def _downstream_result(self, gen: int, op) -> None:
         with self._lock:
-            relay = self._pending.pop((gen, op.req_id), None)
+            entry = self._pending.pop((gen, op.req_id), None)
             if op.error is not None and not isinstance(
                     op.error, protocol.ServeError):
                 # transport death: every pending op on this client is
@@ -217,8 +265,9 @@ class _ShardLink:
                 # retire a successor client).  No close() here — the
                 # sweep IS the client's own teardown path.
                 self._retire_client_locked(gen)
-        if relay is None:
+        if entry is None:
             return
+        relay, _ = entry
         if op.error is None:
             reject = None
         elif isinstance(op.error, protocol.ServeError):
@@ -231,34 +280,46 @@ class _ShardLink:
                       f"shard {self.sid} went away (retry): {op.error}")
         self._on_reply(relay, reject)
 
-    # -- fan-out reads ------------------------------------------------------
+    # -- fan-out reads + handoff transfer -----------------------------------
 
-    def members(self) -> Tuple[List[int], np.ndarray]:
-        with self._lock:
-            client = self._ensure_client_locked()
-            gen = self._gen
+    def _request(self, call: str, *args):
+        """One synchronous request/reply on the link's client with the
+        drop-on-failure treatment members()/stats() pioneered."""
+        stale = None
         try:
-            return client.members()
+            with self._lock:
+                stale = self._sweep_dead_client_locked()
+                client = self._ensure_client_locked()
+                gen = self._gen
+        finally:
+            if stale is not None:
+                stale.close()
+        try:
+            return getattr(client, call)(*args)
         except (OSError, ConnectionError, socket.timeout,
                 framing.RemoteError) as e:
             # RemoteError too: a shard answering MSG_ERROR (e.g. a
             # --shard flag pointed at the wrong dialect's port) must
-            # count as unreachable, not kill the fan-out thread
+            # count as unreachable, not kill the calling thread
             self._drop_client(gen)
             raise _Unreachable(
-                f"shard {self.sid} members failed: {e}") from e
+                f"shard {self.sid} {call} failed: {e}") from e
+
+    def members(self) -> Tuple[List[int], np.ndarray]:
+        return self._request("members")
 
     def stats(self) -> dict:
-        with self._lock:
-            client = self._ensure_client_locked()
-            gen = self._gen
-        try:
-            return client.stats()
-        except (OSError, ConnectionError, socket.timeout,
-                framing.RemoteError) as e:
-            self._drop_client(gen)
-            raise _Unreachable(
-                f"shard {self.sid} stats failed: {e}") from e
+        return self._request("stats")
+
+    def slice_pull(self, elements: Sequence[int]) -> bytes:
+        """Handoff donor read (typed ServeError rejects propagate — the
+        coordinator decides retry-vs-abort per class)."""
+        return self._request("slice_pull", elements)
+
+    def slice_push(self, payload: bytes) -> None:
+        """Handoff recipient write; returns once the shard durably
+        applied the slice."""
+        self._request("slice_push", payload)
 
     def _drop_client(self, gen: int) -> None:
         """Retire after a fan-out failure and CLOSE the retired client
@@ -278,12 +339,17 @@ class _ShardLink:
 
 
 class ShardRouter:
-    """Serve-dialect TCP router over a static shard fleet.
+    """Serve-dialect TCP router over a dynamic shard fleet.
 
     ``shards`` maps shard id -> (host, port) of a ``serve --ingest``
-    frontend.  ``num_elements`` is the fleet-wide element universe the
-    owner map is built over (every shard runs the same E; each owns the
-    ring's slice of it).
+    frontend — the INITIAL fleet; live resharding (the RESHARD admin
+    verb) grows and shrinks it at runtime.  ``num_elements`` is the
+    fleet-wide element universe the owner map is built over (every
+    shard runs the same E; each owns the active ring's slice of it).
+    With ``state_dir``, committed ring swaps persist (fsync-then-rename
+    ``ring.json``) and a restarted router adopts the last COMMITTED
+    ring over its CLI flags — a kill mid-handoff therefore restarts on
+    the old ring (staged-but-uncommitted epochs read as aborted).
     """
 
     IDLE_TIMEOUT_S = 60.0
@@ -296,7 +362,10 @@ class ShardRouter:
                  breaker_threshold: int = 1,
                  breaker_cooldown_s: float = 0.5,
                  backoff: Optional[BackoffPolicy] = None,
-                 max_conns: Optional[int] = None):
+                 max_conns: Optional[int] = None,
+                 state_dir: Optional[str] = None,
+                 fence_timeout_s: float = 10.0,
+                 transfer_timeout_s: float = 30.0):
         from go_crdt_playground_tpu.obs import Recorder
 
         if not shards:
@@ -304,75 +373,199 @@ class ShardRouter:
         self.recorder = recorder if recorder is not None else Recorder()
         self.num_elements = int(num_elements)
         self._downstream_timeout_s = downstream_timeout_s
-        self.ring = HashRing(list(shards), seed=seed)
-        # the hot path: element id -> owner index, one lookup per key
-        self._owner = self.ring.owner_map(self.num_elements)
-        policy = backoff if backoff is not None else BackoffPolicy(
+        self._breaker_threshold = breaker_threshold
+        self._breaker_cooldown_s = breaker_cooldown_s
+        self._policy = backoff if backoff is not None else BackoffPolicy(
             base_s=0.05, multiplier=2.0, cap_s=2.0, jitter=0.1,
             max_retries=4)
-        self._links: Dict[str, _ShardLink] = {
-            sid: _ShardLink(
-                sid, shards[sid], timeout_s=downstream_timeout_s,
-                breaker_threshold=breaker_threshold,
-                breaker_cooldown_s=breaker_cooldown_s, policy=policy,
-                seed=seed * 1000 + i, on_reply=self._relay_reply)
-            for i, sid in enumerate(self.ring.shards)}
-        self._conn_slots = threading.BoundedSemaphore(
-            self.MAX_CONNS if max_conns is None else max_conns)
+        self._seed = seed
+
+        shard_map = {sid: (a[0], int(a[1])) for sid, a in shards.items()}
+        generation = 0
+        if state_dir is not None:
+            rec = load_ring_file(state_dir)
+            if rec is not None and rec.get("phase") == PHASE_COMMITTED:
+                if (int(rec.get("elements", num_elements))
+                        != int(num_elements)
+                        or int(rec.get("seed", seed)) != int(seed)):
+                    raise ValueError(
+                        f"persisted ring in {state_dir!r} was committed "
+                        f"under different (E, seed) than the flags — "
+                        "delete ring.json to reset membership from flags")
+                # the committed membership wins over the CLI flags: the
+                # flags describe the fleet at FIRST launch, the record
+                # describes it after every reshard since
+                shard_map = {s: (a[0], int(a[1]))
+                             for s, a in rec["shards"].items()}
+                generation = int(rec.get("generation", 0))
+                self._count("router.ring.restored")
+
+        ring = HashRing(list(shard_map), seed=seed)
+        owner = ring.owner_map(self.num_elements)
         self._lock = threading.Lock()
-        self._sessions: set = set()  # guarded-by: _lock
-        self._draining = threading.Event()
+        # the hot path's atomic snapshot: ring + owner map + fence,
+        # swapped whole by commit_route (immutable, so readers are
+        # lock-free-consistent after one locked fetch)
+        self._route = RouteState(  # guarded-by: _lock
+            ring, owner, generation,
+            ring.digest(self.num_elements, owner))
+        self._links: Dict[str, _ShardLink] = {}  # guarded-by: _lock
+        self._link_seq = 0  # guarded-by: _lock
+        with self._lock:
+            for sid in ring.shards:
+                self._links[sid] = self._new_link(sid, shard_map[sid])
+        # op handlers between their fence check and their last submit,
+        # counted PER FENCE EPOCH (set_fence bumps the epoch): the
+        # reshard fence waits only for handlers that entered BEFORE it
+        # went up — they might carry moved-slice ops it never rejected
+        # — while post-fence handlers (which provably saw the fence)
+        # can dial dead shards for seconds without wedging a handoff
+        self._op_epoch = 0  # guarded-by: _lock
+        self._inflight_by_epoch: Dict[int, int] = {}  # guarded-by: _lock
         self._closed = threading.Event()
-        # race-ok: serve()/close() owner thread; accept loop snapshots
-        self._listener: Optional[socket.socket] = None
-        # race-ok: serve()/close() owner thread only
-        self._accept_thread: Optional[threading.Thread] = None
+        self.host = ConnHost(self._dispatch, recorder=self.recorder,
+                             counter_prefix="router", thread_name="router",
+                             max_conns=(self.MAX_CONNS if max_conns is None
+                                        else max_conns),
+                             idle_timeout_s=self.IDLE_TIMEOUT_S,
+                             max_frame_body=self.MAX_FRAME_BODY)
+        self.handoff = HandoffCoordinator(
+            self, state_dir=state_dir, recorder=self.recorder,
+            fence_timeout_s=fence_timeout_s,
+            transfer_timeout_s=transfer_timeout_s, seed=seed)
+
+    # -- route / link registry (the handoff seam) ---------------------------
+
+    def route(self) -> RouteState:
+        """The ACTIVE routing snapshot — take one per request and use
+        it throughout; never mix fields from two takes."""
+        with self._lock:
+            return self._route
+
+    @property
+    def ring(self) -> HashRing:
+        return self.route().ring
+
+    @property
+    def _owner(self) -> np.ndarray:
+        # legacy read (tests/CLI banner): the active owner map
+        return self.route().owner
+
+    # requires-lock: _lock
+    def _new_link(self, sid: str, addr: Addr) -> _ShardLink:
+        self._link_seq += 1
+        return _ShardLink(
+            sid, addr, timeout_s=self._downstream_timeout_s,
+            breaker_threshold=self._breaker_threshold,
+            breaker_cooldown_s=self._breaker_cooldown_s,
+            policy=self._policy, seed=self._seed * 1000 + self._link_seq,
+            on_reply=self._relay_reply)
+
+    def make_link(self, sid: str, addr: Addr) -> _ShardLink:
+        """A STAGED link for a joining shard: full breaker/backoff
+        machinery, but not in the routing registry — no client op can
+        reach it until ``commit_route`` installs it."""
+        with self._lock:
+            return self._new_link(sid, addr)
+
+    def link(self, sid: str) -> Optional[_ShardLink]:
+        with self._lock:
+            return self._links.get(sid)
+
+    def links_snapshot(self) -> Dict[str, _ShardLink]:
+        with self._lock:
+            return dict(self._links)
+
+    def shard_addr(self, sid: str) -> Addr:
+        link = self.link(sid)
+        if link is None:
+            raise KeyError(sid)
+        return link.addr
+
+    def set_fence(self, fence: np.ndarray) -> None:
+        with self._lock:
+            self._route = self._route.with_fence(fence)
+            # epoch bump under the SAME lock hold: any handler entering
+            # after this observes the fenced route (one lock orders
+            # its epoch read after ours and its route read after the
+            # swap), so await_ops_settled need not wait for it
+            self._op_epoch += 1
+
+    def clear_fence(self) -> None:
+        with self._lock:
+            self._route = self._route.with_fence(None)
+
+    def await_ops_settled(self, deadline: float) -> None:
+        """Wait until every op handler that entered BEFORE the fence
+        went up has left its fence-check-to-last-submit window — after
+        this, every in-flight moved-slice sub-op is visible in some
+        link's pending map, and every later op saw the fence.  Scoped
+        to PRE-fence handlers on purpose: post-fence ops can be stuck
+        a full DIAL_TIMEOUT_S against an unreachable (and unrelated)
+        shard, and waiting for global quiescence would make resharding
+        unavailable exactly when an operator is resizing around a
+        failure."""
+        with self._lock:
+            fence_epoch = self._op_epoch
+        while True:
+            with self._lock:
+                stale = sum(n for ep, n in self._inflight_by_epoch.items()
+                            if ep < fence_epoch)
+            if stale == 0:
+                return
+            if time.monotonic() > deadline:
+                raise HandoffError(
+                    f"{stale} pre-fence op handlers still in flight")
+            time.sleep(0.002)
+
+    def commit_route(self, ring: HashRing, owner: np.ndarray, digest: str,
+                     *, add_sid: Optional[str] = None,
+                     add_link: Optional[_ShardLink] = None,
+                     drop_sid: Optional[str] = None) -> int:
+        """The atomic swap: new ring + owner map under one lock hold,
+        fence cleared, generation bumped; a leave's retired link closes
+        OUTSIDE the lock (close joins its reader thread)."""
+        retired = None
+        with self._lock:
+            if self._closed.is_set():
+                # shutdown raced the commit: refuse rather than install
+                # a live link into a swept registry.  The committed
+                # ring record may already be persisted — harmless: a
+                # restart adopts it, and its slices are already durable
+                # on their recipients.
+                raise HandoffError("router closed during commit")
+            gen = self._route.generation + 1
+            self._route = RouteState(ring, owner, gen, digest, None)
+            if add_sid is not None and add_link is not None:
+                self._links[add_sid] = add_link
+            if drop_sid is not None:
+                retired = self._links.pop(drop_sid, None)
+        if retired is not None:
+            retired.close()
+        return gen
 
     # -- lifecycle ----------------------------------------------------------
 
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
-        if self._listener is not None:
-            raise RuntimeError("already serving")
-        sock = socket.create_server((host, port))
-        self._listener = sock
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="router-accept", daemon=True)
-        self._accept_thread.start()
-        return sock.getsockname()[:2]
+        return self.host.listen(host, port)
 
     def close(self) -> None:
         if self._closed.is_set():
             return
-        self._draining.set()
-        listener = self._listener
-        if listener is not None:
-            # shutdown BEFORE close: a bare close does not reliably
-            # wake the blocked accept loop, and until it wakes the
-            # kernel keeps completing new dials into the backlog
-            try:
-                listener.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                listener.close()
-            except OSError:
-                pass
-            self._listener = None
+        # set FIRST (under the route lock): from here commit_route
+        # refuses, so a handoff racing shutdown can never install a
+        # live link into the registry this method is about to sweep
+        with self._lock:
+            self._closed.set()
+        self.host.stop_accepting()
         # downstream first: closing a link resolves its in-flight ops as
         # connection errors, which relay typed rejects through sessions
         # that are still open
-        for link in self._links.values():
+        for link in self.links_snapshot().values():
             link.close()
-        with self._lock:
-            sessions = list(self._sessions)
-            self._sessions.clear()
         # one SHARED flush window across all sessions (the frontend's
         # drain shape): stalled clients cost ~1s total, not each
-        flush_deadline = time.monotonic() + 1.0
-        for s in sessions:
-            s.close(flush_timeout_s=max(
-                0.0, flush_deadline - time.monotonic()))
-        self._closed.set()
+        self.host.close_sessions(flush_timeout_s=1.0)
 
     def __enter__(self) -> "ShardRouter":
         return self
@@ -380,66 +573,23 @@ class ShardRouter:
     def __exit__(self, *exc) -> None:
         self.close()
 
-    # -- accept / per-connection reader (the ServeFrontend shape) -----------
+    # -- request dispatch (runs on the host's reader threads) ---------------
 
-    def _accept_loop(self) -> None:
-        sock = self._listener  # snapshot: close() may null the field
-        assert sock is not None
-        while not self._draining.is_set():
-            try:
-                conn, addr = sock.accept()
-            except OSError:
-                return  # listener closed
-            if not self._conn_slots.acquire(blocking=False):
-                self._count("router.shed.connections")
-                conn.close()
-                continue
-            self._count("router.connections")
-            session = Session(conn, peer=f"{addr[0]}:{addr[1]}")
-            with self._lock:
-                self._sessions.add(session)
-            handed_off = False
-            try:
-                threading.Thread(
-                    target=self._reader, args=(conn, session),
-                    daemon=True).start()
-                handed_off = True
-            except RuntimeError:
-                pass  # OS thread exhaustion: shed, keep accepting
-            finally:
-                if not handed_off:
-                    with self._lock:
-                        self._sessions.discard(session)
-                    session.close()
-                    self._conn_slots.release()
-
-    def _reader(self, conn: socket.socket, session: Session) -> None:
-        try:
-            conn.settimeout(self.IDLE_TIMEOUT_S)
-            while not session.closed:
-                try:
-                    msg_type, body = framing.recv_frame(
-                        conn, timeout=self.IDLE_TIMEOUT_S,
-                        max_body=self.MAX_FRAME_BODY)
-                except (framing.ProtocolError, OSError):
-                    return  # torn/idle/garbled connection: drop it
-                if msg_type == protocol.MSG_OP:
-                    if not self._handle_op(session, body):
-                        return
-                elif msg_type == protocol.MSG_QUERY:
-                    self._handle_query(session, body)
-                elif msg_type == protocol.MSG_STATS:
-                    self._handle_stats(session, body)
-                else:
-                    session.send(framing.MSG_ERROR,
-                                 f"unexpected frame type {msg_type}"
-                                 .encode())
-                    return
-        finally:
-            with self._lock:
-                self._sessions.discard(session)
-            session.close()
-            self._conn_slots.release()
+    def _dispatch(self, session: Session, msg_type: int,
+                  body: bytes) -> bool:
+        if msg_type == protocol.MSG_OP:
+            return self._handle_op(session, body)
+        if msg_type == protocol.MSG_QUERY:
+            self._handle_query(session, body)
+            return True
+        if msg_type == protocol.MSG_STATS:
+            self._handle_stats(session, body)
+            return True
+        if msg_type == protocol.MSG_RESHARD:
+            return self._handle_reshard(session, body)
+        session.send(framing.MSG_ERROR,
+                     f"unexpected frame type {msg_type}".encode())
+        return False
 
     # -- OP forwarding ------------------------------------------------------
 
@@ -462,31 +612,63 @@ class ShardRouter:
                 req_id, protocol.REJECT_INVALID,
                 "duplicate element ids in one op"))
             return True
-        if self._draining.is_set():
+        if self.host.draining:
             self._count("router.shed.draining")
             session.send(protocol.MSG_REJECT, protocol.encode_reject(
                 req_id, protocol.REJECT_DRAINING, "router draining"))
             return True
-        # group by owner, preserving client key order within each group
-        groups: Dict[str, List[int]] = {}
-        for e in elements:
-            sid = self.ring.shards[self._owner[e]]
-            groups.setdefault(sid, []).append(e)
-        self._count("router.ops.forwarded")
-        if len(groups) > 1:
-            self._count("router.ops.split")
-        # deadline: forward the client's remaining budget unchanged —
-        # grouping costs microseconds, and the shard re-anchors it at
-        # its own admission (propagation, not re-guessing)
-        deadline_s = deadline_us / 1e6 if deadline_us > 0 else None
-        relay = _Relay(session, req_id, len(groups))
-        for sid, elems in groups.items():
-            try:
-                self._links[sid].submit(relay, kind, elems, deadline_s)
-            except _Unreachable as e:
-                self._count("router.shed.unavailable")
-                self._relay_reply(
-                    relay, (protocol.REJECT_UNAVAILABLE, str(e)))
+        # the in-flight window the reshard fence synchronizes with:
+        # from BEFORE the fence check to AFTER the last sub-op is
+        # registered in its link's pending map — an op can never both
+        # miss the fence and be invisible to the fence's drain.
+        # Epoch-tagged: set_fence bumps the epoch, so the fence only
+        # waits for handlers that entered before it existed.
+        with self._lock:
+            op_epoch = self._op_epoch
+            self._inflight_by_epoch[op_epoch] = (
+                self._inflight_by_epoch.get(op_epoch, 0) + 1)
+        try:
+            rt = self.route()
+            if rt.fenced(elements):
+                self._count("router.shed.moving")
+                session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                    req_id, protocol.REJECT_MOVING,
+                    "keyspace slice mid-handoff (retry)"))
+                return True
+            # group by owner, preserving client key order per group
+            groups: Dict[str, List[int]] = {}
+            for e in elements:
+                groups.setdefault(rt.owner_sid(e), []).append(e)
+            self._count("router.ops.forwarded")
+            if len(groups) > 1:
+                self._count("router.ops.split")
+            # deadline: forward the client's remaining budget unchanged
+            # — grouping costs microseconds, and the shard re-anchors
+            # it at its own admission (propagation, not re-guessing)
+            deadline_s = deadline_us / 1e6 if deadline_us > 0 else None
+            relay = _Relay(session, req_id, len(groups))
+            for sid, elems in groups.items():
+                # per-group lookup, not a dict copy per op: the common
+                # single-shard op pays one lock hold, no allocation
+                link = self.link(sid)
+                try:
+                    if link is None:
+                        # a ring/links transition blink (the snapshot
+                        # straddled a commit): typed retry, the resubmit
+                        # routes by the settled ring
+                        raise _Unreachable(f"shard {sid} not linked")
+                    link.submit(relay, kind, elems, deadline_s)
+                except _Unreachable as e:
+                    self._count("router.shed.unavailable")
+                    self._relay_reply(
+                        relay, (protocol.REJECT_UNAVAILABLE, str(e)))
+        finally:
+            with self._lock:
+                n = self._inflight_by_epoch.get(op_epoch, 0) - 1
+                if n <= 0:
+                    self._inflight_by_epoch.pop(op_epoch, None)
+                else:
+                    self._inflight_by_epoch[op_epoch] = n
         return True
 
     def _relay_reply(self, relay: _Relay,
@@ -520,13 +702,14 @@ class ShardRouter:
         QUERY plumbing through ServeClient or long-lived fan-out
         workers) buys nothing until read fan-out is a measured cost —
         revisit if dashboards ever poll hot."""
+        links = self.links_snapshot()
         # pre-seeded: a worker that dies unexpectedly or outlives the
         # join bound leaves its sentinel in place, so the shard reads
         # as unreachable-and-counted — NEVER silently absent from the
         # union (indistinguishable from a smaller healthy fleet)
         results: Dict[str, object] = {
             sid: _Unreachable(f"shard {sid} fan-out timed out")
-            for sid in self._links}
+            for sid in links}
         lock = threading.Lock()
 
         def one(sid: str, link: _ShardLink) -> None:
@@ -542,7 +725,7 @@ class ShardRouter:
 
         threads = [threading.Thread(target=one, args=(sid, link),
                                     daemon=True)
-                   for sid, link in self._links.items()]
+                   for sid, link in links.items()]
         for t in threads:
             t.start()
         for t in threads:
@@ -557,7 +740,17 @@ class ShardRouter:
             session.send(framing.MSG_ERROR, str(e).encode())
             return
         self._count("router.queries")
+        # route snapshot BEFORE the fan-out: the filter must pair with
+        # the ring the replies were served under — a commit landing
+        # mid-fan-out would otherwise filter a donor's reply by the NEW
+        # owner map while the recipient's reply predates its slice (one
+        # query transiently missing the whole moved slice)
+        rt = self.route()
         results = self._fan_out("members")
+        # ownership filter (no-double-serve): each shard contributes
+        # ONLY the elements the active ring assigns it — a donor's
+        # stale copy of a moved slice must not shadow the new owner
+        # (e.g. a post-handoff delete applied there)
         members: set = set()
         vvs: List[np.ndarray] = []
         unreachable = 0
@@ -565,8 +758,16 @@ class ShardRouter:
             if isinstance(r, _Unreachable):
                 unreachable += 1
                 continue
+            try:
+                idx = rt.ring.shards.index(sid)
+            except ValueError:
+                # left the ring between fan-out and reply: its whole
+                # keyspace is served by the post-swap owners
+                continue
             m, vv = r
-            members.update(m)
+            members.update(
+                int(e) for e in m
+                if 0 <= e < self.num_elements and rt.owner[e] == idx)
             vvs.append(np.asarray(vv, np.uint32))
         if unreachable:
             # the union over reachable shards is a valid CRDT lower
@@ -614,7 +815,41 @@ class ShardRouter:
                      "gauges": snap.get("gauges", {}),
                      "router": snap,
                      "shards": shards,
-                     "aggregate": {"counters": aggregate}}))
+                     "aggregate": {"counters": aggregate},
+                     # which ring this router is ACTUALLY serving —
+                     # generation + owner-map digest (the soak asserts
+                     # a failed handoff left these untouched)
+                     "ring": self.route().info()}))
+
+    # -- the admin verb -----------------------------------------------------
+
+    def _handle_reshard(self, session: Session, body: bytes) -> bool:
+        """Run one live join/leave SYNCHRONOUSLY on this admin
+        connection's reader thread (the handoff is seconds-scale and
+        the admin client holds the connection open for the verdict);
+        client ops ride other connections' readers, unaffected."""
+        try:
+            req_id, mode_code, sid, addr = protocol.decode_reshard(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return False
+        if self.host.draining:
+            session.send(protocol.MSG_RESHARD_REPLY,
+                         protocol.encode_reshard_reply(
+                             req_id, False, {"reason": "router draining"}))
+            return True
+        mode = ("join" if mode_code == protocol.RESHARD_JOIN else "leave")
+        self._count("router.reshard.requests")
+        try:
+            detail = self.handoff.reshard(mode, sid, addr)
+        except HandoffError as e:
+            session.send(protocol.MSG_RESHARD_REPLY,
+                         protocol.encode_reshard_reply(
+                             req_id, False, {"reason": str(e)}))
+            return True
+        session.send(protocol.MSG_RESHARD_REPLY,
+                     protocol.encode_reshard_reply(req_id, True, detail))
+        return True
 
     def _count(self, name: str, n: int = 1) -> None:
         if self.recorder is not None:

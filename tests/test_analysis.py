@@ -721,6 +721,12 @@ def test_gate_fast(tmp_path):
     # multi-threaded shared state inside the same sweep
     assert {"HashRing", "ShardRouter", "_ShardLink", "_Relay",
             "ShardFleet", "ShardProc", "RouterProc"} <= covered, covered
+    # ... and the live-resharding machinery (the dynamic-ring ISSUE):
+    # the handoff coordinator + route snapshots, and the shared conn
+    # host both endpoints now ride — all handoff state is lock- or
+    # race-ok-annotated and swept
+    assert {"HandoffCoordinator", "RouteState", "ConnHost"} <= covered, \
+        covered
 
 
 def test_report_shape_roundtrips(tmp_path):

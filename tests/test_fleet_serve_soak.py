@@ -53,3 +53,28 @@ def test_fleet_serve_soak_quick_mode(tmp_path):
     assert kill["phantom_members"] == []
     assert kill["unfinished"] == []
     assert kill["final_members"] == kill["elements"]
+
+    # the live-resharding leg (DESIGN.md §18): kill-mid-handoff aborts
+    # typed with the old ring (generation + owner-map digest) still
+    # served, the committed join moves exactly the remap_fraction-
+    # predicted slice inside a bounded fence window, the leave restores
+    # the original digest, and across ALL of it: every op resolved
+    # ack-or-typed-reject, zero acked-op loss, zero phantoms
+    reshard = artifact["reshard_leg"]
+    events = {e["event"]: e for e in reshard["events"]}
+    aborted = events["join_recipient_killed_mid_handoff"]
+    assert not aborted["ok"] and aborted["joiner_died"], aborted
+    assert aborted["ring_unchanged"], aborted
+    joined = events["join_committed_via_cli"]
+    assert joined["ok"] and joined["cli_rc"] == 0, joined
+    assert joined["moved"] > 0 and joined["digest_changed"], joined
+    assert joined["observed_fraction"] == pytest.approx(
+        joined["predicted_fraction"]), joined
+    assert joined["fence_s"] < 15.0, joined
+    left = events["leave_committed"]
+    assert left["ok"] and left["digest_restored"], left
+    assert reshard["finished"] and reshard["unfinished"] == []
+    assert reshard["traffic"]["unresolved"] == 0, reshard["traffic"]
+    assert reshard["lost_acked_ops"] == []
+    assert reshard["phantom_members"] == []
+    assert reshard["final_members"] == reshard["elements"]

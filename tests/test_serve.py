@@ -64,7 +64,7 @@ def test_protocol_ack_reject_members_roundtrip():
     assert set(protocol.REJECT_EXCEPTIONS) == {
         protocol.REJECT_OVERLOADED, protocol.REJECT_EXPIRED,
         protocol.REJECT_DRAINING, protocol.REJECT_INVALID,
-        protocol.REJECT_UNAVAILABLE}
+        protocol.REJECT_UNAVAILABLE, protocol.REJECT_MOVING}
     for code, exc in protocol.REJECT_EXCEPTIONS.items():
         assert protocol.REJECT_CODES[exc] == code
 
@@ -135,7 +135,7 @@ def frontend(tmp_path):
 
 
 def _addr(fe):
-    return fe._listener.getsockname()[:2]
+    return fe.addr
 
 
 def test_ingest_end_to_end_and_query(frontend):
@@ -321,7 +321,7 @@ def test_graceful_drain_acks_admitted_ops(tmp_path):
         # reject, the queued ones ack before close() returns
         closer = threading.Thread(target=fe.close, daemon=True)
         closer.start()
-        while not fe._draining.is_set():
+        while not fe.host.draining:
             time.sleep(0.005)
         with pytest.raises(protocol.Draining):
             c.submit_async(protocol.OP_ADD, [9]).wait(5.0)
@@ -594,3 +594,113 @@ def test_close_is_idempotent_and_queryable_metrics(tmp_path):
     fe.close()
     fe.close()  # second close is a no-op, not an error
     assert os.path.isdir(str(tmp_path / "n0"))
+
+
+# ---------------------------------------------------------------------------
+# live-resharding wire verbs (serve/protocol.py + frontend slice handlers)
+# ---------------------------------------------------------------------------
+
+
+def test_reshard_and_slice_protocol_roundtrips():
+    body = protocol.encode_reshard(7, protocol.RESHARD_JOIN, "s9",
+                                   ("10.0.0.1", 4242))
+    assert protocol.decode_reshard(body) == (
+        7, protocol.RESHARD_JOIN, "s9", ("10.0.0.1", 4242))
+    body = protocol.encode_reshard(8, protocol.RESHARD_LEAVE, "s1")
+    assert protocol.decode_reshard(body) == (
+        8, protocol.RESHARD_LEAVE, "s1", None)
+    with pytest.raises(ValueError):
+        protocol.encode_reshard(1, protocol.RESHARD_JOIN, "x")  # no addr
+    with pytest.raises(ValueError):
+        protocol.encode_reshard(1, protocol.RESHARD_LEAVE, "x",
+                                ("h", 1))  # addr forbidden
+    with pytest.raises(ValueError):
+        protocol.encode_reshard(1, 9, "x")  # unknown mode
+    with pytest.raises(ProtocolError):
+        protocol.decode_reshard(body + b"\x00")  # trailing bytes
+
+    body = protocol.encode_reshard_reply(3, True, {"moved": 5})
+    assert protocol.decode_reshard_reply(body) == (3, True, {"moved": 5})
+    body = protocol.encode_reshard_reply(4, False, {"reason": "nope"})
+    assert protocol.decode_reshard_reply(body) == (
+        4, False, {"reason": "nope"})
+
+    body = protocol.encode_slice_pull(11, [4, 9, 60])
+    assert protocol.decode_slice_pull(body) == (11, [4, 9, 60])
+    with pytest.raises(ValueError):
+        protocol.encode_slice_pull(1, [])
+    payload = b"\x01opaque-payload-bytes"
+    body = protocol.encode_slice_state(12, payload)
+    assert protocol.decode_slice_state(body) == (12, payload)
+    body = protocol.encode_slice_push(13, payload)
+    assert protocol.decode_slice_push(body) == (13, payload)
+    with pytest.raises(ProtocolError):
+        protocol.decode_slice_push(b"")
+
+
+def test_slice_pull_push_transfers_state(frontend, tmp_path):
+    """The handoff transfer verbs end to end: pull a slice off one
+    frontend, push it into another — the recipient serves the moved
+    elements (incl. a deletion's absence), its other keys untouched,
+    and the push is durable (WAL-logged) by ack time."""
+    recipient = ServeFrontend(E, A, actor=1,
+                              durable_dir=str(tmp_path / "recipient"),
+                              max_batch=8, flush_ms=1.0)
+    recipient.serve()
+    try:
+        with ServeClient(_addr(frontend)) as c:
+            c.add(1, 2, 3, 9)
+            c.delete(2)
+            with pytest.raises(protocol.InvalidOp):
+                c.slice_pull([E + 1])
+            payload = c.slice_pull([1, 2, 3])
+        with ServeClient(_addr(recipient)) as c:
+            c.add(50)
+            c.slice_push(payload)
+            members, _ = c.members()
+        # moved slice present (2 stays deleted), other keys untouched,
+        # un-pulled donor keys (9) did not leak over
+        assert members == [1, 3, 50]
+        snap = frontend.recorder.snapshot()
+        assert snap["counters"]["serve.slice.pulls"] == 1
+        rsnap = recipient.recorder.snapshot()
+        assert rsnap["counters"]["serve.slice.pushes"] == 1
+    finally:
+        recipient.close()
+
+
+def test_slice_transfer_survives_vv_inflation():
+    """Review-found acked-op-loss regression: slice pushes join the
+    donor's FULL vv into the recipient, so after one handoff the
+    recipient's vv covers donor dots it never received.  A LATER slice
+    moving one of those dots here must still land — MODE_SLICE applies
+    by overwrite (ops/delta.slice_apply), not vv arbitration, which
+    would read the lane as already-seen and silently drop it."""
+    import numpy as np
+
+    from go_crdt_playground_tpu.net.peer import Node
+
+    donor = Node(1, 32, 4)
+    donor.add(5, 9)  # two dots in lane 1
+    recip = Node(2, 32, 4)
+    m = np.zeros(32, bool)
+    m[5] = True
+    recip.apply_payload_body(donor.extract_slice(m))  # move 5 only
+    assert list(recip.members()) == [5]
+    m = np.zeros(32, bool)
+    m[9] = True
+    later = donor.extract_slice(m)
+    recip.apply_payload_body(later)  # 9's dot is already vv-covered
+    assert list(recip.members()) == [5, 9], \
+        "later slice dropped by inflated-vv arbitration"
+    # retry idempotence (the push retry path): same payload, same state
+    recip.apply_payload_body(later)
+    assert list(recip.members()) == [5, 9]
+    # authoritative overwrite: a deletion in the slice erases the
+    # recipient's stale present copy even though the deletion dot is
+    # long vv-covered (the leave-returns-a-deleted-element path)
+    donor.delete(5)
+    m = np.zeros(32, bool)
+    m[5] = True
+    recip.apply_payload_body(donor.extract_slice(m))
+    assert list(recip.members()) == [9]

@@ -106,3 +106,88 @@ def test_ring_determinism_across_processes():
         env=dict(os.environ, JAX_PLATFORMS="cpu"))
     assert out.returncode == 0, out.stderr
     assert f"owner-map digest {ring.digest(E)} " in out.stdout, out.stdout
+
+
+# ---------------------------------------------------------------------------
+# edge cases (the live-resharding ISSUE's satellite: the ring math the
+# handoff plan leans on must behave at the boundaries)
+# ---------------------------------------------------------------------------
+
+
+def test_with_shard_duplicate_id_refused():
+    r = HashRing(["a", "b"])
+    with pytest.raises(ValueError):
+        r.with_shard("a")
+
+
+def test_without_shard_down_to_one_then_refuses():
+    r = HashRing(["a", "b", "c"], seed=1)
+    r = r.without_shard("b").without_shard("c")
+    assert r.shards == ("a",)
+    assert all(r.owner(e) == "a" for e in range(16))
+    with pytest.raises(ValueError):
+        r.without_shard("a")
+
+
+def test_remap_fraction_identical_rings_is_zero():
+    r = HashRing(["a", "b", "c"], seed=7)
+    owners = r.owner_map(128)
+    rm = remap_fraction(owners, owners, r.shards, r.shards)
+    assert rm == {"moved": 0, "fraction": 0.0, "gratuitous": []}
+
+
+def test_remap_fraction_disjoint_rings_moves_everything():
+    """A full fleet replacement moves every key, and every move is
+    FORCED (out of a leaver, into a joiner) — gratuitous stays []."""
+    before = HashRing(["a", "b"], seed=7)
+    after = HashRing(["x", "y"], seed=7)
+    rm = remap_fraction(before.owner_map(64), after.owner_map(64),
+                        before.shards, after.shards)
+    assert rm["moved"] == 64
+    assert rm["fraction"] == 1.0
+    assert rm["gratuitous"] == []
+
+
+def test_load_stats_small_universes():
+    # E=1: one shard owns the lone element, the rest own nothing
+    r = HashRing(["a", "b", "c"], seed=0)
+    owners = r.owner_map(1)
+    stats = load_stats(owners, 3)
+    assert sorted(stats["loads"]) == [0, 0, 1]
+    assert stats["max_over_mean"] == pytest.approx(3.0)
+    assert stats["min_over_mean"] == 0.0
+    # E < n: loads still sum to E and the helper never divides by zero
+    owners = r.owner_map(2)
+    stats = load_stats(owners, 3)
+    assert sum(stats["loads"]) == 2
+    # single shard: trivially perfectly balanced
+    solo = HashRing(["only"]).owner_map(8)
+    stats = load_stats(solo, 1)
+    assert stats["loads"] == [8]
+    assert stats["max_over_mean"] == stats["min_over_mean"] == 1.0
+
+
+def test_handoff_plan_covers_exactly_the_forced_moves():
+    """The transfer work list is the remap, grouped by directed pair:
+    a join's recipients are all the joiner, a leave's donors all the
+    leaver, and the union of the plan's slices is exactly the moved
+    set."""
+    from go_crdt_playground_tpu.shard.ring import handoff_plan
+
+    E = 256
+    before = HashRing(["s0", "s1", "s2"], seed=11)
+    after = before.with_shard("s3")
+    ob, oa = before.owner_map(E), after.owner_map(E)
+    plan = handoff_plan(ob, oa, before.shards, after.shards)
+    assert plan, "a join must move a nonzero slice (E >> n)"
+    assert all(dst == "s3" for _, dst, _ in plan)
+    moved_in_plan = sorted(e for _, _, elems in plan for e in elems)
+    rm = remap_fraction(ob, oa, before.shards, after.shards)
+    assert len(moved_in_plan) == rm["moved"]
+    assert moved_in_plan == sorted(
+        e for e in range(E) if before.shards[ob[e]] != after.shards[oa[e]])
+    # leave: same, reversed — every donor is the leaver
+    plan_back = handoff_plan(oa, ob, after.shards, before.shards)
+    assert all(src == "s3" for src, _, _ in plan_back)
+    assert sorted(e for _, _, elems in plan_back
+                  for e in elems) == moved_in_plan

@@ -224,7 +224,7 @@ def test_router_stats_fan_out_shapes(fleet):
 def test_router_draining_rejects_typed(fleet):
     with ServeClient(fleet.addr) as c:
         c.add(1)
-        fleet.router._draining.set()
+        fleet.router.host._draining.set()
         with pytest.raises(protocol.Draining):
             c.add(2)
 
@@ -271,3 +271,219 @@ def test_router_concurrent_clients_converge(fleet):
     want = sorted({(w * per_client + i) % E
                    for w in range(n_clients) for i in range(per_client)})
     assert members == want
+
+
+# ---------------------------------------------------------------------------
+# live resharding (shard/handoff.py, DESIGN.md §18)
+# ---------------------------------------------------------------------------
+
+
+def test_live_join_and_leave_zero_loss(tmp_path, capsys):
+    """The tentpole round trip, in-process: populate the keyspace,
+    JOIN a third shard live (fence → slice transfer → atomic swap),
+    then LEAVE it again via the CLI admin verb.  Zero membership loss
+    at every step, the moved count matches remap_fraction's prediction
+    exactly, the joiner's replica really holds the moved slice, and a
+    delete applied at the new owner is never shadowed by the donor's
+    stale copy (no double-serve) nor resurrected by the leave."""
+    from go_crdt_playground_tpu.__main__ import main as cli_main
+    from go_crdt_playground_tpu.shard.ring import remap_fraction
+
+    fleet = _Fleet(tmp_path, n_shards=2)
+    joiner = ServeFrontend(E, A, actor=2,
+                           durable_dir=str(tmp_path / "joiner"),
+                           max_batch=8, flush_ms=1.0, queue_depth=32)
+    joiner_addr = joiner.serve()
+    try:
+        with ServeClient(fleet.addr, timeout=60.0) as c:
+            c.add(*range(0, E, 2))
+            c.add(*range(1, E, 2))
+            c.delete(3)
+            before, _ = c.members()
+            ring0 = c.stats()["ring"]
+
+            ok, detail = c.reshard(protocol.RESHARD_JOIN, "s2",
+                                   joiner_addr, timeout=60.0)
+            assert ok, detail
+            after_join, _ = c.members()
+            assert after_join == before, "join lost/invented members"
+
+            # the router's accounting == the ring math, cross-checked
+            r0 = fleet.router.route().ring.without_shard("s2")
+            r1 = fleet.router.route().ring
+            rm = remap_fraction(r0.owner_map(E), r1.owner_map(E),
+                                r0.shards, r1.shards)
+            assert detail["moved"] == rm["moved"] > 0
+            assert detail["moved_transferred"] == rm["moved"]
+            assert detail["fraction"] == pytest.approx(rm["fraction"])
+            assert detail["gratuitous"] == 0
+            assert detail["generation"] == 1
+            ring1 = c.stats()["ring"]
+            assert ring1["generation"] == 1
+            assert ring1["digest"] != ring0["digest"]
+            assert sorted(ring1["shards"]) == ["s0", "s1", "s2"]
+
+            # the joiner REALLY owns its slice: its replica holds every
+            # moved live element (transferred state, not routing smoke)
+            rt = fleet.router.route()
+            owned = [e for e in range(E) if rt.owner_sid(e) == "s2"]
+            assert len(owned) == detail["moved"]
+            joiner_members = set(int(x) for x in joiner.node.members())
+            assert set(owned) - {3} <= joiner_members
+
+            # no double-serve: a delete at the new owner sticks even
+            # though the donor still holds a stale present copy
+            victim = next(e for e in owned if e != 3)
+            c.delete(victim)
+            m, _ = c.members()
+            assert victim not in m
+
+            # LEAVE via the CLI admin verb (the operator surface)
+            host_, port_ = fleet.addr
+            rc = cli_main(["reshard", "--router", f"{host_}:{port_}",
+                           "--leave", "s2"])
+            assert rc == 0
+            capsys.readouterr()  # swallow the CLI's JSON print
+            m2, _ = c.members()
+            assert m2 == m, "leave lost/invented members"
+            assert victim not in m2, "leave resurrected a deleted element"
+            ring2 = c.stats()["ring"]
+            assert ring2["generation"] == 2
+            assert ring2["digest"] == ring0["digest"], \
+                "leave back to the original membership must restore " \
+                "the original owner-map digest"
+            # ops route normally post-reshard
+            c.add(victim)
+            m3, _ = c.members()
+            assert victim in m3
+    finally:
+        joiner.close()
+        fleet.close()
+
+
+def test_failed_join_leaves_old_ring_serving(tmp_path):
+    """Failure is the main path: a join whose recipient never answers
+    aborts (typed failure reply, bounded by the transfer deadline) and
+    the OLD ring keeps serving — same generation, same digest, ops
+    still ack."""
+    fleet = _Fleet(tmp_path, n_shards=2, transfer_timeout_s=1.5)
+    try:
+        with ServeClient(fleet.addr, timeout=30.0) as c:
+            c.add(1, 2, 3)
+            ring0 = c.stats()["ring"]
+            t0 = time.monotonic()
+            ok, detail = c.reshard(protocol.RESHARD_JOIN, "sX",
+                                   ("127.0.0.1", 1), timeout=30.0)
+            assert not ok
+            assert "reason" in detail
+            assert time.monotonic() - t0 < 15.0, "abort was unbounded"
+            ring1 = c.stats()["ring"]
+            assert ring1["generation"] == ring0["generation"]
+            assert ring1["digest"] == ring0["digest"]
+            c.add(4)  # the old ring is fully serving
+            m, _ = c.members()
+            assert m == [1, 2, 3, 4]
+        snap = fleet.router.recorder.snapshot()
+        assert snap["counters"]["router.reshard.aborts"] == 1
+        assert snap["counters"].get("router.reshard.commits", 0) == 0
+    finally:
+        fleet.close()
+
+
+def test_fence_rejects_typed_moving(fleet):
+    """The fence semantics, deterministically: a fenced element's op
+    gets the typed retryable KeyspaceMoving (never applied anywhere);
+    unfenced keyspace keeps acking; clearing the fence re-admits."""
+    import numpy as np
+
+    fenced_e, free_e = 7, 8
+    fence = np.zeros(E, bool)
+    fence[fenced_e] = True
+    with ServeClient(fleet.addr, timeout=10.0) as c:
+        c.add(free_e)
+        fleet.router.set_fence(fence)
+        with pytest.raises(protocol.KeyspaceMoving):
+            c.add(fenced_e)
+        c.add(free_e)  # unfenced keyspace unaffected
+        # spanning op touching the fence: whole op rejected typed
+        with pytest.raises(protocol.KeyspaceMoving):
+            c.add(fenced_e, free_e)
+        fleet.router.clear_fence()
+        c.add(fenced_e)  # the retry lands after the fence drops
+        m, _ = c.members()
+    assert fenced_e in m
+    snap = fleet.router.recorder.snapshot()
+    assert snap["counters"]["router.shed.moving"] == 2
+    # the fenced op was never applied anywhere: exactly one add of
+    # fenced_e reached a shard (the post-clear one)
+    assert fleet.router.route().fence is None
+
+
+def test_router_restart_adopts_committed_ring(tmp_path):
+    """Ring persistence: a committed swap survives a router restart
+    (the record wins over CLI flags); a staged/aborted epoch does not;
+    mismatched (E, seed) flags are refused loudly."""
+    import json
+    import os
+
+    from go_crdt_playground_tpu.shard.handoff import RING_FILE
+    from go_crdt_playground_tpu.shard.ring import HashRing
+
+    state_dir = str(tmp_path / "router-state")
+    os.makedirs(state_dir)
+    ring = HashRing(["a", "b", "c"], seed=5)
+    owners = ring.owner_map(E)
+    rec = {"epoch": 4, "phase": "committed", "generation": 3,
+           "seed": 5, "elements": E,
+           "shards": {"a": ["127.0.0.1", 1111], "b": ["127.0.0.1", 2222],
+                      "c": ["127.0.0.1", 3333]},
+           "digest": ring.digest(E, owners)}
+    with open(os.path.join(state_dir, RING_FILE), "w") as f:
+        json.dump(rec, f)
+
+    router = ShardRouter({"zz": ("127.0.0.1", 9)}, E, seed=5,
+                         state_dir=state_dir)
+    try:
+        info = router.route().info()
+        assert info["generation"] == 3
+        assert sorted(info["shards"]) == ["a", "b", "c"]
+        assert info["digest"] == rec["digest"]
+        assert router.shard_addr("b") == ("127.0.0.1", 2222)
+        assert router.handoff._epoch == 4  # monotone across restarts
+    finally:
+        router.close()
+
+    # flags disagreeing with the committed record: refuse, don't guess
+    with pytest.raises(ValueError):
+        ShardRouter({"zz": ("127.0.0.1", 9)}, E, seed=6,
+                    state_dir=state_dir)
+
+    # an aborted/staged record is NOT adopted
+    rec["phase"] = "aborted"
+    with open(os.path.join(state_dir, RING_FILE), "w") as f:
+        json.dump(rec, f)
+    router = ShardRouter({"zz": ("127.0.0.1", 9)}, E, seed=5,
+                         state_dir=state_dir)
+    try:
+        assert list(router.route().ring.shards) == ["zz"]
+        assert router.route().generation == 0
+    finally:
+        router.close()
+
+
+def test_reshard_staging_failures_are_typed(fleet):
+    """Verbs that cannot even stage (duplicate join id, unknown leave
+    id) reply typed failure without touching the ring or any shard."""
+    with ServeClient(fleet.addr, timeout=10.0) as c:
+        ring0 = c.stats()["ring"]
+        ok, d = c.reshard(protocol.RESHARD_JOIN, "s0",
+                          ("127.0.0.1", 9), timeout=10.0)
+        assert not ok and "already in the ring" in d["reason"]
+        ok, d = c.reshard(protocol.RESHARD_LEAVE, "nope", timeout=10.0)
+        assert not ok and "not in ring" in d["reason"]
+        # a reshard timeout past the CONNECTION timeout is refused
+        # loudly (the reader would time the idle admin connection out
+        # first and mis-report a commit as ConnectionError)
+        with pytest.raises(ValueError):
+            c.reshard(protocol.RESHARD_LEAVE, "s1", timeout=999.0)
+        assert c.stats()["ring"] == ring0

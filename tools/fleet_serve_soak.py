@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Sharded-fleet soak: goodput/p99 vs shard count + the kill leg.
+"""Sharded-fleet soak: goodput/p99 vs shard count + the kill leg +
+the live-resharding leg.
 
 SERVE_CURVE.json proves ONE ingest frontend holds its SLO and
 durability shape; this tool proves the FLEET does (shard/, DESIGN.md
@@ -24,6 +25,19 @@ single-node load generator runs against the fleet as-is.
   the §14 contract at fleet scope: every ACKED op is in the final
   router MEMBERS union (zero acked-op loss across the SIGKILL) and
   every member was submitted (no phantoms).
+* **reshard leg** (DESIGN.md §18) — live ring membership change under
+  continuous ledgered traffic: (1) a JOIN whose recipient SIGKILLs
+  itself mid-handoff (the ``CRDT_SERVE_CRASH_ON_SLICE=push`` hook)
+  must ABORT typed with the old ring's generation+digest still served
+  by STATS; (2) the relaunched joiner joins for real via the
+  ``reshard`` CLI admin verb — observed remap fraction must equal
+  ``ring.remap_fraction``'s cross-process prediction, fence window
+  bounded; (3) [full sweep only] a donor restarted with the
+  ``pull`` crash hook aborts a second join the same way and its
+  keyspace recovers via ``restore_durable``; (4) a LEAVE drains the
+  joiner back out.  Throughout: every submitted op resolves
+  ack-or-typed-reject (``KeyspaceMoving`` during fences is the typed
+  retryable contract), zero acked-op loss, zero phantoms.
 
 Output: SHARD_CURVE.json next to the other curves.
 
@@ -42,6 +56,7 @@ import os
 import socket
 import sys
 import tempfile
+import threading
 import time
 from typing import Dict, List, Optional, Set
 
@@ -176,6 +191,270 @@ def kill_leg(root: str, n_shards: int, elements: int,
 
 
 # ---------------------------------------------------------------------------
+# reshard leg (live resharding, DESIGN.md §18)
+# ---------------------------------------------------------------------------
+
+
+class _Traffic(threading.Thread):
+    """Ledgered add-only load through the router while the ring
+    reshapes: every element is submitted until acked; typed rejects
+    requeue (the protocol contract), transport errors count as
+    UNRESOLVED (through the router they must never happen) and requeue
+    so the leg still finishes."""
+
+    def __init__(self, addr, elements: int, seed: int):
+        super().__init__(daemon=True)
+        import random
+        from collections import deque
+
+        todo = list(range(elements))
+        random.Random(seed).shuffle(todo)
+        self.addr = addr
+        self.todo = deque(todo)
+        self.acked: Set[int] = set()
+        self.submitted: Set[int] = set()
+        self.counts = {"typed_moving": 0, "typed_unavailable": 0,
+                       "typed_other": 0, "unresolved": 0}
+        self.stop_when_drained = threading.Event()
+
+    def run(self) -> None:
+        client = ServeClient(self.addr, timeout=30.0)
+        try:
+            while True:
+                if not self.todo:
+                    if self.stop_when_drained.is_set():
+                        return
+                    time.sleep(0.01)
+                    continue
+                e = self.todo.popleft()
+                self.submitted.add(e)
+                try:
+                    client.add(e, deadline_s=5.0)
+                    self.acked.add(e)
+                except protocol.KeyspaceMoving:
+                    self.counts["typed_moving"] += 1
+                    self.todo.append(e)
+                    time.sleep(0.01)  # the fence is brief; back off a tick
+                except protocol.ShardUnavailable:
+                    self.counts["typed_unavailable"] += 1
+                    self.todo.append(e)
+                    time.sleep(0.05)
+                except protocol.ServeError:
+                    self.counts["typed_other"] += 1
+                    self.todo.append(e)
+                    time.sleep(0.01)  # never hot-spin a persistent reject
+                except (OSError, ConnectionError, socket.timeout):
+                    self.counts["unresolved"] += 1
+                    self.todo.append(e)
+                    try:
+                        client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    client = ServeClient(self.addr, timeout=30.0)
+        finally:
+            client.close()
+
+    def drain(self, timeout_s: float) -> bool:
+        self.stop_when_drained.set()
+        self.join(timeout=timeout_s)
+        return not self.is_alive() and not self.todo
+
+
+def _ring_info(addr) -> Dict[str, object]:
+    with ServeClient(addr, timeout=30.0) as c:
+        return c.stats()["ring"]
+
+
+def _cli_reshard(repo: str, addr, args: List[str]) -> Dict[str, object]:
+    """Run the OPERATOR surface — the ``reshard`` CLI subprocess — and
+    parse its JSON verdict."""
+    import subprocess
+
+    argv = [sys.executable, "-m", "go_crdt_playground_tpu", "reshard",
+            "--router", f"{addr[0]}:{addr[1]}"] + args
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(argv, cwd=repo, env=env, capture_output=True,
+                          text=True, timeout=300)
+    try:
+        out = json.loads(proc.stdout)
+    except ValueError:
+        out = {"ok": False,
+               "detail": {"reason": f"CLI emitted no JSON "
+                                    f"(rc={proc.returncode}): "
+                                    f"{proc.stdout[:200]!r} "
+                                    f"{proc.stderr[-200:]!r}"}}
+    out["cli_rc"] = proc.returncode
+    return out
+
+
+def reshard_leg(root: str, elements: int, seed: int,
+                quick: bool) -> Dict[str, object]:
+    """Live join/leave under traffic with kill-mid-handoff fault
+    injection (module docstring).  Returns the adjudication."""
+    from go_crdt_playground_tpu.shard.ring import HashRing, remap_fraction
+
+    # actors=4: lanes for the 2 initial shards + the joiner (index 2)
+    spec = FleetSpec(n_shards=2, elements=elements, seed=seed, actors=4)
+    fleet = ShardFleet(REPO, os.path.join(root, "reshard"), spec,
+                       router_state_dir=os.path.join(root, "reshard",
+                                                     "router-state"))
+    events: List[Dict[str, object]] = []
+    try:
+        addr = fleet.start()
+        traffic = _Traffic(addr, elements, seed)
+        traffic.start()
+        # let a baseline land before the first membership change
+        while len(traffic.acked) < elements // 4:
+            time.sleep(0.05)
+        ring0 = _ring_info(addr)
+
+        # (1) kill-mid-handoff: the RECIPIENT dies on the first slice
+        # push -> the join must abort typed and the old ring keep
+        # serving (same generation + digest)
+        fleet.launch_shard(2, crash_on_slice="push")
+        with ServeClient(addr, timeout=120.0) as c:
+            ok, detail = c.reshard(
+                protocol.RESHARD_JOIN, fleet.sid(2),
+                ("127.0.0.1", fleet.shard_ports[2]), timeout=120.0)
+        joiner = fleet.shards[2]
+        joiner.proc.wait(timeout=30)  # the hook SIGKILLed it
+        ring_after_abort = _ring_info(addr)
+        events.append({
+            "event": "join_recipient_killed_mid_handoff",
+            "ok": ok, "detail": detail,
+            "joiner_died": joiner.proc.poll() is not None,
+            "ring_unchanged": (
+                ring_after_abort["generation"] == ring0["generation"]
+                and ring_after_abort["digest"] == ring0["digest"]),
+        })
+        joiner.close()
+        fleet.shards[2] = None
+
+        # (2) the real join, via the CLI admin verb (operator surface);
+        # cross-process remap prediction from the ring math
+        fleet.launch_shard(2)
+        before_ring = HashRing([fleet.sid(i) for i in range(2)], seed=seed)
+        after_ring = before_ring.with_shard(fleet.sid(2))
+        predicted = remap_fraction(
+            before_ring.owner_map(elements), after_ring.owner_map(elements),
+            before_ring.shards, after_ring.shards)["fraction"]
+        verdict = _cli_reshard(
+            REPO, addr,
+            ["--join",
+             f"{fleet.sid(2)}=127.0.0.1:{fleet.shard_ports[2]}"])
+        detail = verdict.get("detail", {})
+        ring1 = _ring_info(addr)
+        events.append({
+            "event": "join_committed_via_cli",
+            "ok": verdict.get("ok", False),
+            "cli_rc": verdict.get("cli_rc"),
+            "observed_fraction": detail.get("fraction"),
+            "predicted_fraction": predicted,
+            "fence_s": detail.get("fence_s"),
+            "moved": detail.get("moved"),
+            "generation": ring1["generation"],
+            "digest_changed": ring1["digest"] != ring0["digest"],
+        })
+
+        if not quick:
+            # (3) donor death mid-handoff: restart shard 0 armed to die
+            # on the next slice pull, attempt a leave of the joiner
+            # (s0 is a recipient then — so arm the DONOR instead: the
+            # joiner leave pulls from s2 only; use a second join/leave
+            # cycle where s0 donates).  Simplest forced-donor case:
+            # leave s0 itself — every transfer pulls FROM s0.
+            ring_before_kill = _ring_info(addr)
+            fleet.kill_shard(0)
+            fleet.restart_shard(0, crash_on_slice="pull")
+            with ServeClient(addr, timeout=120.0) as c:
+                ok, detail = c.reshard(protocol.RESHARD_LEAVE,
+                                       fleet.sid(0), timeout=120.0)
+            donor = fleet.shards[0]
+            donor.proc.wait(timeout=30)
+            ring_after = _ring_info(addr)
+            events.append({
+                "event": "leave_donor_killed_mid_handoff",
+                "ok": ok, "detail": detail,
+                "donor_died": donor.proc.poll() is not None,
+                "ring_unchanged": (
+                    ring_after["generation"]
+                    == ring_before_kill["generation"]
+                    and ring_after["digest"]
+                    == ring_before_kill["digest"]),
+            })
+            donor.close()
+            fleet.shards[0] = None
+            # s0's keyspace recovers from its WAL/checkpoints
+            fleet.restart_shard(0)
+            events.append({"event": "donor_restarted"})
+
+        # (4) leave the joiner again — the slice transfers back
+        with ServeClient(addr, timeout=120.0) as c:
+            ok, detail = c.reshard(protocol.RESHARD_LEAVE, fleet.sid(2),
+                                   timeout=120.0)
+        ring2 = _ring_info(addr)
+        events.append({
+            "event": "leave_committed",
+            "ok": ok, "fence_s": detail.get("fence_s"),
+            "moved": detail.get("moved"),
+            "generation": ring2["generation"],
+            # same membership as birth => same owner map => same digest
+            "digest_restored": ring2["digest"] == ring0["digest"],
+        })
+
+        # drain: every element must end acked through whatever ring
+        finished = traffic.drain(timeout_s=120.0)
+        with ServeClient(addr, timeout=60.0) as c:
+            members, _ = c.members()
+        members_set = set(members)
+        return {
+            "elements": elements,
+            "events": events,
+            "traffic": dict(traffic.counts),
+            "acked_ops": len(traffic.acked),
+            "finished": finished,
+            "final_members": len(members_set),
+            # MUST be []: an op acked (fsync'd on its then-owner)
+            # vanished across a handoff
+            "lost_acked_ops": sorted(traffic.acked - members_set),
+            # MUST be []: a member nobody submitted
+            "phantom_members": sorted(members_set - traffic.submitted),
+            "unfinished": sorted(set(range(elements)) - traffic.acked),
+        }
+    finally:
+        fleet.close()
+
+
+def adjudicate_reshard(leg: Dict[str, object], quick: bool) -> bool:
+    """The acceptance shape of the reshard leg (mirrored by
+    tests/test_fleet_serve_soak.py)."""
+    by_event = {e["event"]: e for e in leg["events"]}
+    kill = by_event["join_recipient_killed_mid_handoff"]
+    ok = not kill["ok"] and kill["joiner_died"] and kill["ring_unchanged"]
+    join = by_event["join_committed_via_cli"]
+    ok = ok and join["ok"] and join["cli_rc"] == 0
+    ok = ok and join["digest_changed"] and join["moved"] > 0
+    ok = ok and abs(join["observed_fraction"]
+                    - join["predicted_fraction"]) < 1e-6
+    # bounded per-keyspace unavailability: the fence window (the only
+    # time the moved slice rejects) stays seconds-scale even on a
+    # contended 2-core CI box
+    ok = ok and join["fence_s"] is not None and join["fence_s"] < 15.0
+    if not quick:
+        donor = by_event["leave_donor_killed_mid_handoff"]
+        ok = ok and not donor["ok"] and donor["donor_died"]
+        ok = ok and donor["ring_unchanged"]
+    leave = by_event["leave_committed"]
+    ok = ok and leave["ok"] and leave["digest_restored"]
+    ok = ok and leave["fence_s"] is not None and leave["fence_s"] < 15.0
+    ok = ok and leg["finished"] and leg["unfinished"] == []
+    ok = ok and leg["traffic"]["unresolved"] == 0
+    ok = ok and leg["lost_acked_ops"] == []
+    ok = ok and leg["phantom_members"] == []
+    return ok
+
+
+# ---------------------------------------------------------------------------
 # sweep
 # ---------------------------------------------------------------------------
 
@@ -215,6 +494,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                                    ("outage", "acked_ops",
                                     "lost_acked_ops", "phantom_members",
                                     "resubmit_rounds")}}), flush=True)
+        reshard = reshard_leg(root, elements, args.seed, args.quick)
+        print(json.dumps({"reshard": {k: reshard[k] for k in
+                                      ("events", "traffic", "acked_ops",
+                                       "lost_acked_ops",
+                                       "phantom_members")}}), flush=True)
     finally:
         import shutil
 
@@ -225,9 +509,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         "metric": ("sharded serving fleet: goodput/p99 vs shard count at "
                    "fixed offered load through the consistent-hash router "
                    "(real subprocesses, unmodified ServeClient), plus the "
-                   "SIGKILL-one-shard leg: typed ShardUnavailable rejects "
+                   "SIGKILL-one-shard leg (typed ShardUnavailable rejects "
                    "for the dead keyspace, surviving keyspaces keep "
-                   "serving, zero acked-op loss across restart"),
+                   "serving, zero acked-op loss across restart) and the "
+                   "live-resharding leg (join/leave under traffic with "
+                   "kill-mid-handoff: aborts leave the old ring serving "
+                   "at the same owner-map digest, commits move exactly "
+                   "the remap_fraction-predicted slice, zero acked-op "
+                   "loss, zero phantoms)"),
         "value": peak,
         "unit": "acked ops/s (peak goodput through the router)",
         "fleet": {"elements": elements, "offered_rate": rate,
@@ -235,6 +524,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "quick": bool(args.quick)},
         "shard_curve": curve,
         "kill_leg": kill,
+        "reshard_leg": reshard,
         "elapsed_s": round(time.time() - t0, 1),
         "platform": "cpu",
     }
@@ -257,6 +547,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ok = ok and kill["lost_acked_ops"] == []
     ok = ok and kill["phantom_members"] == []
     ok = ok and kill["unfinished"] == []
+    # (c) the reshard leg: aborts left the old ring serving, commits
+    # moved exactly the predicted slice, nothing acked was lost
+    ok = ok and adjudicate_reshard(reshard, args.quick)
     return 0 if ok else 1
 
 
