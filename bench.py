@@ -1248,6 +1248,145 @@ def _salvage_headline(errors):
     return True
 
 
+_INGEST_ARTIFACT = "BENCH_INGEST.json"
+
+
+def measure_ingest(num_elements=1024, num_actors=8,
+                   legs=((8, 1), (32, 1), (128, 1), (32, 16)),
+                   repeats=40):
+    """Serve ingest ladder (ISSUE 8): per (batch B, keys/op) leg,
+    measure the seed two-pass path (``ingest_rows`` apply + a second
+    ``delta_extract`` dispatch + dense WAL record encode) against the
+    fused path (``ingest_rows_delta`` — one dispatch returning state,
+    δ, and the fixed-K compact lanes — + compact record encode):
+    dispatches/batch, wall-time/batch, WAL bytes/batch."""
+    import jax
+    import jax.numpy as jnp
+
+    from go_crdt_playground_tpu.models import awset_delta
+    from go_crdt_playground_tpu.net import framing
+    from go_crdt_playground_tpu.ops import delta as delta_ops
+    from go_crdt_playground_tpu.ops import ingest as ingest_ops
+
+    # the SAME backend/K selection Node.ingest_batch runs — the bench
+    # measures the server's actual regime, by construction
+    fused_fn, k = ingest_ops.ingest_delta_regime(num_elements)
+    rng = np.random.default_rng(7)
+    curve = []
+    for batch, keys in legs:
+        st = awset_delta.init(1, num_elements, num_actors,
+                              actors=np.asarray([0], np.uint32))
+        row = jax.tree.map(lambda x: x[0], st)
+        add = np.zeros((batch, num_elements), bool)
+        for b in range(batch):
+            add[b, rng.choice(num_elements, size=keys, replace=False)] = True
+        dl = np.zeros((batch, num_elements), bool)
+        dl[batch // 2, rng.integers(num_elements)] = True
+        live = np.ones(batch, bool)
+        addj, dlj, livej = (jnp.asarray(add), jnp.asarray(dl),
+                            jnp.asarray(live))
+        pre_vv = np.asarray(row.vv)
+
+        # both paths build their record through THE shared policy
+        # (framing.encode_delta_wal_record — exactly what Node appends)
+
+        def seed_once():
+            merged = ingest_ops.ingest_rows(row, addj, dlj, livej)
+            payload = delta_ops.delta_extract(merged, jnp.asarray(pre_vv))
+            jax.block_until_ready(payload)
+            body, _ = framing.encode_delta_wal_record(
+                pre_vv, 0, payload, compact_records=False)
+            return len(body)
+
+        def fused_once():
+            merged, payload, compact = fused_fn(
+                row, addj, dlj, livej, k_changed=k, k_deleted=k)
+            jax.block_until_ready(payload if compact is None else compact)
+            body, _ = framing.encode_delta_wal_record(
+                pre_vv, 0, payload, compact)
+            return len(body)
+
+        def timed(fn):
+            fn()  # warm/compile
+            t0 = time.perf_counter()
+            nbytes = 0
+            for _ in range(repeats):
+                nbytes = fn()
+            return (time.perf_counter() - t0) / repeats, nbytes
+
+        seed_s, seed_bytes = timed(seed_once)
+        fused_s, fused_bytes = timed(fused_once)
+        _, payload, compact = fused_fn(row, addj, dlj, livej,
+                                       k_changed=k, k_deleted=k)
+        curve.append({
+            "batch": batch,
+            "keys_per_op": keys,
+            "changed_lanes": int(np.asarray(payload.changed).sum()),
+            "compact_regime": ("device-K" if compact is not None
+                               else "host"),
+            "compact_overflow": (bool(compact.overflow)
+                                 if compact is not None else None),
+            "seed": {"dispatches_per_batch": 2,
+                     "ms_per_batch": round(seed_s * 1e3, 3),
+                     "wal_bytes_per_batch": seed_bytes},
+            "fused": {"dispatches_per_batch": 1,
+                      "ms_per_batch": round(fused_s * 1e3, 3),
+                      "wal_bytes_per_batch": fused_bytes},
+            "speedup": round(seed_s / fused_s, 2),
+            "wal_bytes_ratio": round(seed_bytes / fused_bytes, 1),
+        })
+    return curve
+
+
+def run_ingest(out=_INGEST_ARTIFACT):
+    """The `--ingest` verb: measure the serve ingest ladder and commit
+    BENCH_INGEST.json.  Backend-guarded: the artifact records the
+    platform it was measured on, and a CPU(-fallback) run REFUSES to
+    overwrite an on-chip artifact (the BENCH_r03/r05 footgun — an
+    unattended retry on a busy TPU silently demoting committed on-chip
+    evidence); it prints the refusal and exits clean instead."""
+    import jax
+
+    platform = jax.default_backend()
+    if os.path.exists(out):
+        try:
+            with open(out) as f:
+                prior = json.load(f)
+        except ValueError:
+            prior = {}
+        if not isinstance(prior, dict):
+            prior = {}  # valid-JSON-but-not-an-object: unknown prior
+        if prior.get("platform") == "tpu" and platform != "tpu":
+            print(json.dumps({
+                "metric": "serve ingest ladder",
+                "skipped": f"existing {out} is an on-chip artifact; "
+                           f"refusing to overwrite it with a "
+                           f"{platform} run (pass --out elsewhere)",
+                "platform": platform,
+            }))
+            return None
+    curve = measure_ingest()
+    artifact = {
+        "metric": ("serve ingest path: dispatches/batch, wall-time/"
+                   "batch, WAL bytes/batch — fused one-dispatch "
+                   "ingest+δ with compact records vs the seed "
+                   "two-dispatch path with dense records"),
+        "value": curve[0]["wal_bytes_ratio"],
+        "unit": "x fewer WAL bytes/batch (sparsest leg)",
+        "elements": 1024,
+        "actors": 8,
+        "platform": platform,
+        "curve": curve,
+    }
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    for leg in curve:
+        print(json.dumps(leg))
+    print(f"wrote {out}")
+    return artifact
+
+
 def run_ladder():
     """Configs 1-5, each persisted to BENCH_LADDER.partial.jsonl the
     moment it completes, so a timeout at config 5 costs config 5 — not
@@ -1439,6 +1578,21 @@ def main():
     if "--roofline" in sys.argv:
         # static traffic model — no device, no supervision needed
         run_roofline()
+        return
+    if "--ingest" in sys.argv:
+        # small in-process ladder (seconds, not minutes): the serve
+        # ingest fused-vs-seed comparison, backend-guarded by
+        # run_ingest against CPU-fallback overwrites; --out PATH
+        # redirects the artifact (the escape hatch the refusal names)
+        out = _INGEST_ARTIFACT
+        if "--out" in sys.argv:
+            try:
+                out = sys.argv[sys.argv.index("--out") + 1]
+            except IndexError:
+                print(json.dumps({"metric": "serve ingest ladder",
+                                  "error": "--out needs a path"}))
+                sys.exit(2)
+        run_ingest(out=out)
         return
     if os.environ.get("CRDT_BENCH_CHILD") == "1":
         _child_main()

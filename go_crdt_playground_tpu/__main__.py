@@ -138,13 +138,24 @@ def _cmd_serve_ingest(args) -> int:
         args.elements, args.actors, actor=args.actor,
         durable_dir=args.durable_dir, peers=args.peer,
         queue_depth=args.queue_depth, max_batch=args.max_batch,
-        flush_ms=args.flush_ms, checkpoint_every=args.checkpoint_every)
+        flush_ms=args.flush_ms, checkpoint_every=args.checkpoint_every,
+        ingest_fused=args.fused_ingest,
+        wal_compact_records=args.fused_ingest,
+        compact_interval_s=args.compact_interval,
+        compact_p99_budget_s=args.compact_p99_budget_ms / 1e3,
+        gc_participants=args.gc_participants)
+    if args.gc_participants is not None and args.compact_interval <= 0:
+        print("WARNING: --gc-participants has no effect without "
+              "--compact-interval > 0 — no compaction scheduler runs, "
+              "deletion records will grow unboundedly", flush=True)
     host, bound = fe.serve(port=args.port, peer_port=args.peer_port)
     print(f"Op-ingest frontend listening on {host}:{bound} "
           f"(E={args.elements} A={args.actors} actor={args.actor} "
           f"batch<={args.max_batch} flush={args.flush_ms}ms "
           f"queue={args.queue_depth} "
-          f"durable={'yes' if args.durable_dir else 'NO'})", flush=True)
+          f"durable={'yes' if args.durable_dir else 'NO'} "
+          f"fused={'yes' if args.fused_ingest else 'NO'} "
+          f"compaction={args.compact_interval or 'off'})", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     try:
@@ -340,6 +351,40 @@ def main(argv=None) -> int:
                    default=50,
                    help="durable checkpoint cadence in supervisor rounds "
                         "(0 = only the final drain checkpoint)")
+    s.add_argument("--compact-interval", dest="compact_interval",
+                   type=float, default=0.0,
+                   help="SLO-aware background compaction cadence in "
+                        "seconds (serve/compaction.py: deletion-record "
+                        "GC + WAL-driven checkpoint rotation when the "
+                        "ingest gauges show headroom; 0 = disabled)")
+    s.add_argument("--compact-p99-budget-ms", dest="compact_p99_budget_ms",
+                   type=float, default=250.0,
+                   help="recent ingest p99 above this means no headroom: "
+                        "compaction backs off instead of running")
+    def _gc_participants(text: str):
+        try:
+            return tuple(int(a) for a in text.split(",") if a.strip())
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"--gc-participants wants comma-separated actor ids, "
+                f"got {text!r}")
+
+    s.add_argument("--gc-participants", dest="gc_participants",
+                   default=None, type=_gc_participants,
+                   metavar="A0,A1,...",
+                   help="replica-actor ids participating in deletion-"
+                        "record GC (REQUIRED for GC progress when this "
+                        "frontend has any peer surface — membership is "
+                        "declared, never inferred; omitted = derived "
+                        "from the peer config: isolated frontends GC "
+                        "freely, peered ones keep GC off; an empty "
+                        "string is the explicit isolated declaration; "
+                        "takes effect only with --compact-interval > 0)")
+    s.add_argument("--no-fused-ingest", dest="fused_ingest",
+                   action="store_false",
+                   help="seed-comparison mode: two dispatches per batch "
+                        "(apply, then delta_extract for the WAL record) "
+                        "and dense WAL records")
 
     def _shard_spec(text: str):
         sid, _, addr = text.partition("=")

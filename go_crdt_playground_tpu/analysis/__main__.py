@@ -44,7 +44,8 @@ PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOCK_TARGETS = ["net/peer.py", "net/antientropy.py", "utils/wal.py",
                 "serve/admission.py", "serve/session.py",
                 "serve/batcher.py", "serve/frontend.py",
-                "serve/client.py", "serve/host.py", "obs/metrics.py",
+                "serve/client.py", "serve/host.py", "serve/compaction.py",
+                "obs/metrics.py",
                 "shard/ring.py", "shard/router.py", "shard/fleet.py",
                 "shard/handoff.py"]
 # extra files that participate in the lock-ORDER graph (their locks can
@@ -55,7 +56,8 @@ DURABILITY_TARGETS = ["utils/wal.py", "utils/checkpoint.py",
                       "shard/handoff.py"]
 PURITY_TARGETS = ["ops/merge.py", "ops/delta.py", "ops/lattices.py",
                   "ops/vv.py", "ops/compact.py", "ops/pallas_merge.py",
-                  "ops/pallas_delta.py", "ops/ingest.py"]
+                  "ops/pallas_delta.py", "ops/ingest.py",
+                  "ops/pallas_ingest.py"]
 # attribute-name -> class hints for cross-class lock-order edges
 ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "recorder": "Recorder", "_store": "CheckpointStore",
@@ -65,7 +67,8 @@ ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "ring": "HashRing", "router": "ShardRouter",
                 "relay": "_Relay", "_client": "ServeClient",
                 "host": "ConnHost", "handoff": "HandoffCoordinator",
-                "_route": "RouteState"}
+                "_route": "RouteState",
+                "compactor": "CompactionScheduler"}
 
 
 def _paths(rel: List[str], root: str) -> List[str]:

@@ -316,6 +316,10 @@ class SyncSupervisor:
         # at a time (run()/sync_round() caller XOR the start() loop)
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
+        # serializes checkpoint() callers: the supervisor loop and the
+        # serve compaction scheduler (serve/compaction.py) may both
+        # rotate checkpoints, and CheckpointStore assumes one writer
+        self._ckpt_lock = threading.Lock()
         self._peers: List[Addr] = []  # guarded-by: _lock
         self._breakers: Dict[Addr, CircuitBreaker] = {}  # guarded-by: _lock
         self._rounds_done = 0  # guarded-by: _lock
@@ -538,15 +542,16 @@ class SyncSupervisor:
         Returns the written path."""
         with self._lock:
             meta = {"supervisor_rounds": self._rounds_done}
-        if self._store is not None:
-            gen = self.node.save_durable(self._store, metadata=meta)
+        with self._ckpt_lock:
+            if self._store is not None:
+                gen = self.node.save_durable(self._store, metadata=meta)
+                self._count("sync.checkpoints")
+                return self._store.path_for(gen)
+            if not self.checkpoint_path:
+                return None
+            path = self.node.save(self.checkpoint_path, metadata=meta)
             self._count("sync.checkpoints")
-            return self._store.path_for(gen)
-        if not self.checkpoint_path:
-            return None
-        path = self.node.save(self.checkpoint_path, metadata=meta)
-        self._count("sync.checkpoints")
-        return path
+            return path
 
     @classmethod
     def restore(cls, checkpoint_path: str, peers: Sequence[Addr],

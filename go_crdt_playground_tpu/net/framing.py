@@ -208,6 +208,64 @@ def encode_payload_msg(mode: int, src_actor: int, processed: np.ndarray,
             + wire.encode_payload(payload))
 
 
+def encode_delta_wal_record(pre_vv: np.ndarray, src_actor: int, payload,
+                            compact=None, *, compact_records: bool = True
+                            ) -> Tuple[bytes, bool]:
+    """THE WAL record-form policy for one δ (serve-path throughput
+    ladder): choose and encode the record body, returning
+    ``(body, is_compact)``.  One implementation serves every producer
+    — ``net/peer.Node``'s batch and local-op loggers and the
+    ``bench.py --ingest`` ladder — so the committed bench artifact can
+    never measure a policy the server no longer runs.
+
+    Selection ladder (DESIGN.md §16): the fixed-K on-device form when
+    ``compact`` (an ``ops/compact.CompactDeltaPayload``) is given and
+    did not overflow → host-side compaction of the dense ``payload``
+    while under the break-even (~3 bytes of index varints per claimed
+    lane vs the dense record's two E/8-byte section bitmasks) → the
+    legacy dense record (guard-vv || PAYLOAD body).  Nothing is ever
+    dropped; ``compact_records=False`` forces the dense form (the
+    seed-comparison mode)."""
+    pre_vv = np.asarray(pre_vv, np.uint32)
+    num_elements = int(payload.changed.shape[-1])
+    if compact_records:
+        if compact is not None:
+            import jax
+
+            # one pull for the whole fixed-K pytree — device_get starts
+            # every leaf's transfer before blocking, vs a sequential
+            # device round-trip per field under the node lock
+            compact = jax.device_get(compact)
+        if compact is not None and not bool(compact.overflow):
+            chv = compact.ch_valid
+            dlv = compact.del_valid
+            return wire.encode_compact_wal_body(
+                pre_vv, src_actor, compact.src_processed,
+                compact.src_vv,
+                compact.ch_idx[chv],
+                compact.ch_da[chv],
+                compact.ch_dc[chv],
+                compact.del_idx[dlv],
+                compact.del_da[dlv],
+                compact.del_dc[dlv], num_elements), True
+        changed = np.asarray(payload.changed)
+        deleted = np.asarray(payload.deleted)
+        lanes = int(changed.sum()) + int(deleted.sum())
+        if lanes * 3 <= max(16, num_elements // 4):
+            ch = np.nonzero(changed)[0]
+            dl = np.nonzero(deleted)[0]
+            return wire.encode_compact_wal_body(
+                pre_vv, src_actor, np.asarray(payload.src_processed),
+                np.asarray(payload.src_vv),
+                ch, np.asarray(payload.ch_da)[ch],
+                np.asarray(payload.ch_dc)[ch],
+                dl, np.asarray(payload.del_da)[dl],
+                np.asarray(payload.del_dc)[dl], num_elements), True
+    body = encode_payload_msg(
+        MODE_DELTA, src_actor, np.asarray(payload.src_processed), payload)
+    return wire._encode_vv_py(pre_vv) + body, False
+
+
 def decode_payload_msg(body: bytes, num_elements: int, num_actors: int):
     """Returns (mode, DeltaPayload) with src_actor and src_processed
     rehydrated from the out-of-band fields."""

@@ -133,7 +133,9 @@ class Node:
                  strict_reference_semantics: bool = True,
                  recorder=None, conn_timeout_s: Optional[float] = None,
                  hello_timeout_s: Optional[float] = None,
-                 max_conns: Optional[int] = None, wal=None):
+                 max_conns: Optional[int] = None, wal=None,
+                 ingest_fused: bool = True,
+                 wal_compact_records: bool = True):
         """recorder: optional obs.Recorder; when given, every exchange
         counts sync.exchanges / sync.bytes_sent / sync.bytes_received /
         sync.full_payloads on it (served and initiated alike).
@@ -143,13 +145,35 @@ class Node:
         local mutation's δ is durably logged BEFORE the state mutation
         is acknowledged, so a kill between checkpoints loses at most the
         in-flight record (the documented WAL-tail window) — see
-        ``replay_wal`` / ``restore_durable`` for the recovery half."""
+        ``replay_wal`` / ``restore_durable`` for the recovery half.
+
+        ingest_fused: ``ingest_batch`` uses the one-dispatch fused
+        ingest+δ kernel (ops/ingest.ingest_rows_delta; the Pallas twin
+        on TPU backends).  False restores the seed two-dispatch path
+        (apply, then a separate delta_extract for the WAL record) —
+        kept for the serve soak's fused-vs-seed comparison.
+
+        wal_compact_records: sparse δs are WAL-logged in the compact
+        index-lane record form (utils/wire.encode_compact_wal_body —
+        O(changed) fsync bytes instead of O(E)); dense records remain
+        the overflow fallback and both forms replay (``replay_wal``)."""
         from go_crdt_playground_tpu.models import awset_delta
 
         if not 0 <= actor < num_actors:
             raise ValueError(f"actor {actor} outside actor axis {num_actors}")
         self.recorder = recorder
         self.wal = wal  # guarded-by: _lock
+        # race-ok: read-only configuration after __init__
+        self.ingest_fused = ingest_fused
+        # (fused_fn, k) resolved on first fused batch — backend and E
+        # are fixed for the node's lifetime
+        self._fused_regime = None  # guarded-by: _lock
+        # race-ok: read-only configuration after __init__
+        self.wal_compact_records = wal_compact_records
+        # freshest causal-stability vector each peer actor advertised
+        # in an applied payload — the provable deletion-GC frontier's
+        # peer half (deletion_frontier)
+        self._peer_processed: dict = {}  # guarded-by: _lock
         # last durably-restored/saved store generation
         self.generation = 0  # guarded-by: _lock
         # regressed-restore healing epoch (see restore_durable): while
@@ -242,12 +266,21 @@ class Node:
     def ingest_batch(self, add_rows: np.ndarray, del_rows: np.ndarray,
                      live: Optional[np.ndarray] = None) -> None:
         """Apply one packed ``(B, E)`` micro-batch of client op-rows in a
-        single compiled dispatch (ops/ingest.ingest_rows: row b's add
-        selector is one Add(k...) call, its del selector one Del(k...)
-        call, ``live`` masks padding rows), WAL-logging the batch's
-        resulting δ BEFORE returning — the group-commit durability point
-        the serve frontend acks against: one fsync covers the whole
-        batch (DESIGN.md §16)."""
+        single compiled dispatch (row b's add selector is one Add(k...)
+        call, its del selector one Del(k...) call, ``live`` masks
+        padding rows), WAL-logging the batch's resulting δ BEFORE
+        returning — the group-commit durability point the serve
+        frontend acks against: one fsync covers the whole batch
+        (DESIGN.md §16).
+
+        The fused path (``ingest_fused``, the default) gets state AND
+        the WAL record's δ — routed through the fixed-K compact lanes —
+        from ONE dispatch of ``ops/ingest.ingest_rows_delta`` (the
+        Pallas twin on TPU backends), so the host pulls O(changed)
+        lanes for the record instead of re-extracting a dense O(E)
+        payload in a second dispatch.  ``ingest.dispatches`` counts the
+        compiled applies per batch (fused: 1; seed path: 2 when a WAL
+        is attached)."""
         import jax
         import jax.numpy as jnp
 
@@ -270,13 +303,33 @@ class Node:
             pre_vv = (np.asarray(self._state.vv[0]).copy()
                       if self.wal is not None else None)
             row = jax.tree.map(lambda x: x[0], self._state)
-            merged = ingest_ops.ingest_rows(
-                row, jnp.asarray(add_rows), jnp.asarray(del_rows),
-                jnp.asarray(live))
-            self._state = jax.tree.map(
-                lambda full, r: full.at[0].set(r), self._state, merged)
-            if pre_vv is not None:
-                self._log_local_delta(pre_vv)
+            if self.ingest_fused and pre_vv is not None:
+                # (without a WAL there is no record to build — the δ
+                # half of the fused dispatch would be computed and
+                # discarded, so the plain apply below is the fast path)
+                if self._fused_regime is None:
+                    self._fused_regime = ingest_ops.ingest_delta_regime(
+                        self.num_elements)
+                fused_fn, k = self._fused_regime
+                merged, payload, compact = fused_fn(
+                    row, jnp.asarray(add_rows), jnp.asarray(del_rows),
+                    jnp.asarray(live), k_changed=k, k_deleted=k)
+                self._state = jax.tree.map(
+                    lambda full, r: full.at[0].set(r), self._state,
+                    merged)
+                self._count("ingest.dispatches")
+                self._append_delta_record(pre_vv, payload, compact)
+            else:
+                merged = ingest_ops.ingest_rows(
+                    row, jnp.asarray(add_rows), jnp.asarray(del_rows),
+                    jnp.asarray(live))
+                self._state = jax.tree.map(
+                    lambda full, r: full.at[0].set(r), self._state,
+                    merged)
+                self._count("ingest.dispatches")
+                if pre_vv is not None:
+                    self._count("ingest.dispatches")  # delta_extract
+                    self._log_local_delta(pre_vv)
 
     def members(self) -> np.ndarray:
         """Sorted live element ids (SortedValues, awset.go:61-70, on ids)."""
@@ -330,11 +383,6 @@ class Node:
     # requires-lock: _lock
     def _apply_msg(self, body: bytes) -> int:
         """Decode + apply a PAYLOAD frame body.  Caller holds the lock."""
-        import jax
-
-        from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
-        from go_crdt_playground_tpu.ops import delta as delta_ops
-
         mode, payload = framing.decode_payload_msg(
             body, self.num_elements, self.num_actors)
         # write-AHEAD: the decoded-valid body hits the log before the
@@ -344,9 +392,26 @@ class Node:
         # harmless.  The record is prefixed with a replay GUARD — our
         # pre-apply vv, the causal context the delta's compression
         # assumed — so recovery can refuse records that outrun a
-        # regressed base (see replay_wal).
+        # regressed base (see replay_wal).  Applied peer bodies are
+        # logged as-received (dense): re-compacting a payload that
+        # already crossed the wire would cost a host decode for bytes
+        # the batch path never pays.
         if self.wal is not None:
             self.wal.append(self._guard_bytes() + body)
+            self._count("wal.dense_records")
+        self._apply_payload(mode, payload)
+        return mode
+
+    # requires-lock: _lock
+    def _apply_payload(self, mode: int, payload) -> None:
+        """Apply one decoded payload (no WAL side effects — the two
+        producers log in their own record form first).  Caller holds
+        the lock."""
+        import jax
+
+        from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
+        from go_crdt_playground_tpu.ops import delta as delta_ops
+
         me = jax.tree.map(lambda x: x[0], self._state)
         if mode == MODE_FULL:
             src = AWSetDeltaState(
@@ -371,7 +436,16 @@ class Node:
                 self.strict_reference_semantics)
         self._state = jax.tree.map(
             lambda full, row: full.at[0].set(row), self._state, merged)
-        return mode
+        # deletion-GC bookkeeping (serve/compaction.py): remember the
+        # freshest causal-stability vector this origin actor advertised
+        # — the peer half of the provable frontier (deletion_frontier).
+        # Monotone join, so stale/replayed payloads only under-claim.
+        src_actor = int(payload.src_actor)
+        if src_actor != self.actor:
+            proc = np.asarray(payload.src_processed, np.uint32)
+            prev = self._peer_processed.get(src_actor)
+            self._peer_processed[src_actor] = (
+                proc.copy() if prev is None else np.maximum(prev, proc))
 
     # requires-lock: _lock
     def _guard_bytes(self, vv: Optional[np.ndarray] = None) -> bytes:
@@ -386,11 +460,13 @@ class Node:
 
     # requires-lock: _lock
     def _log_local_delta(self, pre_vv: np.ndarray) -> None:
-        """WAL a local mutation as the δ it produced vs the pre-op VV —
-        the same PAYLOAD-body wire form merged deltas are logged in, so
-        one replay path serves both.  The guard is the pre-op vv (the
-        δ contains exactly the changes since it).  Caller holds the
-        lock."""
+        """WAL a local mutation as the δ it produced vs the pre-op VV.
+        Sparse δs are written in the compact index-lane record form
+        (``wal_compact_records``; O(changed) bytes), δs past the
+        compact break-even in the dense PAYLOAD-body form merged deltas
+        are logged in — both replay through ``replay_wal``.  The guard
+        is the pre-op vv (the δ contains exactly the changes since
+        it).  Caller holds the lock."""
         import jax
         import jax.numpy as jnp
 
@@ -398,9 +474,27 @@ class Node:
 
         me = jax.tree.map(lambda x: x[0], self._state)
         payload = delta_ops.delta_extract(me, jnp.asarray(pre_vv))
-        body = framing.encode_payload_msg(
-            MODE_DELTA, self.actor, np.asarray(me.processed), payload)
-        self.wal.append(self._guard_bytes(pre_vv) + body)
+        self._append_delta_record(pre_vv, payload)
+
+    # requires-lock: _lock
+    def _append_delta_record(self, pre_vv: np.ndarray, payload,
+                             compact=None) -> None:
+        """Append one δ WAL record in whatever form the shared policy
+        picks (``framing.encode_delta_wal_record`` — the single
+        implementation the bench measures too).  ``compact`` is the
+        fused batch path's on-device fixed-K form (TPU regime: the
+        host pulls O(K) index lanes, fsyncs O(changed) bytes);
+        ``compact=None`` (CPU regime) or overflow compacts host-side
+        from the dense payload under the break-even rule, and an
+        oversized δ falls back to the dense record — O(E) bytes for
+        that batch, nothing is ever dropped.  Caller holds the
+        lock."""
+        body, is_compact = framing.encode_delta_wal_record(
+            pre_vv, self.actor, payload, compact,
+            compact_records=self.wal_compact_records)
+        self.wal.append(body)
+        self._count("wal.compact_records" if is_compact
+                    else "wal.dense_records")
 
     # -- keyspace handoff (live resharding, DESIGN.md §18) ------------------
 
@@ -457,6 +551,78 @@ class Node:
         with self._lock:
             self._apply_msg(body)
 
+    # -- deletion-record GC (serve-path compaction, DESIGN.md §16) ----------
+
+    def deletion_frontier(self, participants=None) -> np.ndarray:
+        """The causal-stability frontier this node can PROVE: the
+        elementwise min of its own ``processed`` vector and the
+        freshest ``processed`` vector each PARTICIPATING replica actor
+        has advertised in an applied payload (``_apply_payload``
+        bookkeeping).  A deletion record ``(k, (a, c))`` is stable —
+        droppable — iff ``c <= frontier[a]``.
+
+        ``participants`` is the deployment's declared replica-actor
+        set (self excluded implicitly).  It must cover every replica
+        that could hold our elements live — gossip is TRANSITIVE, so a
+        replica we never synced directly can still have learned an add
+        via a relay, advertise a nonzero vv for us on its eventual
+        first direct exchange (skipping the FULL-merge branch that
+        would heal it), and keep a deleted element forever if its
+        deletion record was dropped early.  A participant we have no
+        advertised vector for therefore contributes ZEROS (no GC for
+        its lanes), never "nothing".
+
+        Membership is DECLARED, never inferred: ``participants=None``
+        (undeclared) always yields the all-zeros frontier — GC
+        disabled — because any runtime heuristic ("have I heard a
+        peer?") is forgotten across a restart while the fleet is not;
+        an EMPTY participant set is the explicit isolated declaration
+        (this replica is the whole deployment) and yields our own
+        vector.  Wrong declarations are operator error of the same
+        class as a wrong peer list."""
+        if participants is None:
+            # before the lock: an undeclared-membership scheduler polls
+            # this every wake and must not contend with the batcher
+            return np.zeros(self.num_actors, np.uint32)
+        with self._lock:
+            own = np.asarray(self._state.processed[0], np.uint32).copy()
+            heard = dict(self._peer_processed)
+        out = own
+        zeros = np.zeros_like(own)
+        for a in participants:
+            a = int(a)
+            if a == self.actor:
+                continue
+            out = np.minimum(out, heard.get(a, zeros))
+        return out
+
+    def gc_deletions(self, frontier: Optional[np.ndarray] = None,
+                     participants=None) -> dict:
+        """Drop causally-stable deletion records
+        (``ops/delta.gc_frontier``/``gc_apply`` wired to a live node —
+        the schedulable half the kernels always had).  v2 semantics
+        only: the reference mode never absorbs records, so there is
+        nothing provably stable to drop.  GC is pure compaction — no
+        WAL record: a crash-replay may resurrect dropped records from
+        pre-GC log entries and the next cycle re-drops them.  The
+        frontier defaults to ``deletion_frontier(participants)`` —
+        see its membership contract."""
+        import jax.numpy as jnp
+
+        from go_crdt_playground_tpu.ops import delta as delta_ops
+
+        if self.delta_semantics != "v2":
+            raise ValueError("deletion GC requires v2 (record-absorbing) "
+                             "delta semantics")
+        if frontier is None:
+            frontier = self.deletion_frontier(participants)
+        f = jnp.asarray(np.asarray(frontier, np.uint32))
+        with self._lock:
+            before = int(np.asarray(self._state.deleted[0]).sum())
+            self._state = delta_ops.gc_apply(self._state, f)
+            after = int(np.asarray(self._state.deleted[0]).sum())
+        return {"dropped": before - after, "remaining": after}
+
     def replay_wal(self, wal) -> dict:
         """Apply every intact, CAUSALLY-SAFE WAL record (oldest-first)
         through the normal payload-apply path — the recovery half of
@@ -477,25 +643,44 @@ class Node:
           the state causally consistent; anti-entropy re-ships the gap.
 
         Idempotent: records whose effects the checkpoint already
-        contains merge to no-ops.  Counts ``wal.records`` (replayed) on
-        the recorder.  Detaches ``self.wal`` for the duration so replay
-        never re-logs its own records."""
+        contains merge to no-ops.  Counts ``wal.records`` (replayed,
+        with a ``wal.replayed_compact`` / ``wal.replayed_dense`` mode
+        breakdown) on the recorder.  Both record forms — legacy dense
+        (guard-vv || PAYLOAD body) and compact index-lane
+        (utils/wire.py, tag byte 0x00) — replay in segment order under
+        the same guard check; a mixed segment is the normal case for a
+        store that upgraded mid-history.  Detaches ``self.wal`` for the
+        duration so replay never re-logs its own records."""
+        from go_crdt_playground_tpu.net.framing import MODE_DELTA as _DELTA
         from go_crdt_playground_tpu.utils import wire
 
         replayed = bad = future = 0
+        compact_n = dense_n = 0
         with self._lock:
             saved, self.wal = self.wal, None
         try:
             for body in wal.records():
                 try:
-                    guard, pos = wire._decode_vv_py(body, 0,
-                                                    self.num_actors)
-                    with self._lock:
-                        if np.any(np.asarray(guard, np.uint32)
-                                  > np.asarray(self._state.vv[0])):
-                            future += 1
-                            break
-                        self._apply_msg(body[pos:])
+                    if body[:1] == bytes((wire.WAL_COMPACT_TAG,)):
+                        guard, payload = wire.decode_compact_wal_body(
+                            body, self.num_elements, self.num_actors)
+                        with self._lock:
+                            if np.any(np.asarray(guard, np.uint32)
+                                      > np.asarray(self._state.vv[0])):
+                                future += 1
+                                break
+                            self._apply_payload(_DELTA, payload)
+                        compact_n += 1
+                    else:
+                        guard, pos = wire._decode_vv_py(body, 0,
+                                                        self.num_actors)
+                        with self._lock:
+                            if np.any(np.asarray(guard, np.uint32)
+                                      > np.asarray(self._state.vv[0])):
+                                future += 1
+                                break
+                            self._apply_msg(body[pos:])
+                        dense_n += 1
                 except (ProtocolError, ValueError):
                     # CRC-clean but semantically unreadable (e.g. a
                     # dimension change since the log was written): same
@@ -509,11 +694,16 @@ class Node:
         if self.recorder is not None:
             if replayed:
                 self.recorder.count("wal.records", replayed)
+            if compact_n:
+                self.recorder.count("wal.replayed_compact", compact_n)
+            if dense_n:
+                self.recorder.count("wal.replayed_dense", dense_n)
             if bad:
                 self.recorder.count("wal.bad_records", bad)
             if future:
                 self.recorder.count("wal.future_records", future)
-        return {"replayed": replayed, "bad": bad, "future": future}
+        return {"replayed": replayed, "bad": bad, "future": future,
+                "compact": compact_n, "dense": dense_n}
 
     # -- server -------------------------------------------------------------
 
@@ -944,6 +1134,10 @@ class Node:
         self._record(mode_sent, bytes_sent=sent, bytes_received=recv)
         return SyncStats(bytes_sent=sent, bytes_received=recv,
                          mode_sent=mode_sent, mode_received=mode_recv)
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.recorder is not None:
+            self.recorder.count(name, n)
 
     def _record(self, mode_sent: int, bytes_sent: int,
                 bytes_received: int) -> None:

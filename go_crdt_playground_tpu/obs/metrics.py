@@ -14,7 +14,7 @@ import math
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, List
+from typing import Dict, List, Optional, Sequence
 
 # Bounded log-spaced histogram backing observe()/percentile().  Bucket i
 # covers (BASE·G^(i-1), BASE·G^i]; index 0 is the underflow bucket
@@ -146,6 +146,27 @@ class Recorder:
         with self._lock:
             self._gauges[name] = value
 
+    def gauge(self, name: str, default: float = 0.0) -> float:
+        """Read one gauge without paying a full snapshot()."""
+        with self._lock:
+            return self._gauges.get(name, default)
+
+    def counter(self, name: str, default: int = 0) -> int:
+        """Read one counter without paying a full snapshot()."""
+        with self._lock:
+            return self._counters.get(name, default)
+
+    def histogram(self, name: str) -> Optional[List[int]]:
+        """Copy of a stream's bucket counts (CUMULATIVE since process
+        start), or None if never observed.  Pollers that need a RECENT
+        quantile — e.g. the compaction scheduler's headroom check,
+        serve/compaction.py — diff two copies and feed the window to
+        ``percentile_of_counts``; the cumulative histogram alone would
+        let an hour of idle history mask a current latency spike."""
+        with self._lock:
+            h = self._histograms.get(name)
+            return None if h is None else list(h)
+
     def snapshot(self) -> Dict[str, object]:
         """Point-in-time copy: {"counters": {...}, "observations": {...},
         "gauges": {...}} with per-stream mean and histogram-derived
@@ -160,6 +181,27 @@ class Recorder:
             }
             return {"counters": dict(self._counters), "observations": obs,
                     "gauges": dict(self._gauges)}
+
+
+def percentile_of_counts(hist: Sequence[int], q: float) -> Optional[float]:
+    """Quantile estimate over a raw bucket-count vector (the same
+    log-spaced buckets ``Recorder.observe`` fills) — for WINDOWED
+    quantiles built by diffing two ``Recorder.histogram`` copies.
+    Returns the covering bucket's nominal upper bound (no exact min/max
+    is known for a window), or None for an empty window ("no recent
+    data" must stay distinguishable from "zero latency")."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    n = sum(hist)
+    if n <= 0:
+        return None
+    rank = max(1, math.ceil(q * n))
+    cum = 0
+    for i, c in enumerate(hist):
+        cum += c
+        if cum >= rank:
+            return _bucket_upper(i)
+    return _bucket_upper(_HIST_BUCKETS - 1)  # unreachable
 
 
 def payload_metrics(payload, wire: bool = True) -> Dict[str, int]:

@@ -30,14 +30,51 @@ through ``add_elements``/``del_elements`` one dispatch each (pinned by
 tests/test_serve.py); dissemination of the batch's δ rides the existing
 kernel path (``ops/delta.delta_extract`` via ``Node._log_local_delta``
 and the anti-entropy exchange).
+
+Fused ingest+δ (the serve-path throughput ladder, DESIGN.md §16):
+``ingest_rows_delta`` returns the merged state AND the batch's δ vs the
+PRE-batch vv — the exact payload ``Node.ingest_batch`` used to compute
+with a second ``delta_extract`` dispatch for its WAL record — in ONE
+compiled program, plus the δ's fixed-K compact form (ops/compact.py) so
+the host pulls O(changed) lanes for the WAL record instead of the dense
+O(E) masks.  ``ops/pallas_ingest.py`` is the Pallas twin of the same
+contract (bitwise-pinned by tests/test_ingest_fused.py).
 """
 
 from __future__ import annotations
+
+import functools
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 
 from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
+
+
+# fixed-K capacity of the fused path's on-device δ compaction: batches
+# whose δ claims more lanes fall back to the dense WAL record — never
+# dropped (net/peer.Node and bench.py both select through
+# ingest_delta_regime, so there is exactly one policy)
+WAL_COMPACT_K = 128
+
+
+def ingest_delta_regime(num_elements: int):
+    """THE backend regime for the fused ingest+δ path: returns
+    ``(fused_fn, k)`` — the Pallas twin with the fixed-K on-device
+    compaction on TPU backends (the compaction shrinks the
+    device→host pull), the XLA fused path with ``k=0`` (host-side
+    compaction from the dense payload) everywhere else.  One selection
+    serves ``Node.ingest_batch`` and ``bench.py --ingest``: the bench
+    cannot drift into measuring a path the server no longer runs."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        from go_crdt_playground_tpu.ops.pallas_ingest import \
+            pallas_ingest_rows_delta
+
+        return pallas_ingest_rows_delta, min(WAL_COMPACT_K, num_elements)
+    return ingest_rows_delta, 0
 
 
 def _apply_add_row(st: AWSetDeltaState, row: jnp.ndarray) -> AWSetDeltaState:
@@ -95,3 +132,40 @@ def ingest_rows(state: AWSetDeltaState, add_rows: jnp.ndarray,
 
     out, _ = jax.lax.scan(step, state, (add_rows, del_rows, live))
     return out
+
+
+@functools.partial(jax.jit, static_argnames=("k_changed", "k_deleted"))
+def ingest_rows_delta(state: AWSetDeltaState, add_rows: jnp.ndarray,
+                      del_rows: jnp.ndarray, live: jnp.ndarray,
+                      k_changed: int, k_deleted: int) -> Tuple:
+    """Fused ingest+δ: one dispatch returning ``(merged, payload,
+    compact)`` — the merged single-replica slice, the batch δ vs the
+    PRE-batch vv (``delta_extract(merged, pre_vv)``, bitwise what the
+    two-pass path computed in its second dispatch), and the δ routed
+    through ``ops/compact.py``'s fixed-K lanes (``compact.overflow``
+    set when the δ doesn't fit — callers fall back to the dense
+    payload, never drop).
+
+    The δ is extracted against the pre-batch vv, so it contains the
+    batch's own effects PLUS any pre-existing lanes whose dots the
+    pre-batch vv did not cover (the compact-overflow gossip path can
+    leave those behind); that is exactly what
+    ``Node._log_local_delta`` always logged, preserved here bitwise.
+
+    ``k_changed == 0`` (or ``k_deleted == 0``) skips the on-device
+    compaction and returns ``compact=None``: the fixed-K form exists to
+    shrink the device→host pull, which costs nothing on a CPU backend
+    — there the caller compacts host-side from the dense payload
+    (``Node._append_delta_record``), and the scatter-heavy compaction
+    kernel would only slow the batch down.
+    """
+    from go_crdt_playground_tpu.ops import compact as compact_ops
+    from go_crdt_playground_tpu.ops import delta as delta_ops
+
+    pre_vv = state.vv
+    merged = ingest_rows(state, add_rows, del_rows, live)
+    payload = delta_ops.delta_extract(merged, pre_vv)
+    if k_changed == 0 or k_deleted == 0:
+        return merged, payload, None
+    compact = compact_ops.compact_payload(payload, k_changed, k_deleted)
+    return merged, payload, compact

@@ -17,6 +17,8 @@ from go_crdt_playground_tpu.serve.apply import (ApplyTarget,  # noqa: F401
 from go_crdt_playground_tpu.serve.batcher import MicroBatcher  # noqa: F401
 from go_crdt_playground_tpu.serve.client import (PendingOp,  # noqa: F401
                                                  ServeClient)
+from go_crdt_playground_tpu.serve.compaction import \
+    CompactionScheduler  # noqa: F401
 from go_crdt_playground_tpu.serve.frontend import ServeFrontend  # noqa: F401
 from go_crdt_playground_tpu.serve.host import ConnHost  # noqa: F401
 from go_crdt_playground_tpu.serve.protocol import (DeadlineExceeded,  # noqa: F401

@@ -59,7 +59,12 @@ class ServeFrontend:
                  max_batch: int = 32, flush_ms: float = 2.0,
                  checkpoint_every: int = 0, sync_interval_s: float = 0.05,
                  wal_fsync: bool = True, recorder=None, seed: int = 0,
-                 max_conns: Optional[int] = None):
+                 max_conns: Optional[int] = None,
+                 ingest_fused: bool = True,
+                 wal_compact_records: bool = True,
+                 compact_interval_s: float = 0.0,
+                 compact_p99_budget_s: float = 0.25,
+                 gc_participants: Optional[Sequence[int]] = None):
         from go_crdt_playground_tpu.obs import Recorder
 
         self.recorder = recorder if recorder is not None else Recorder()
@@ -76,6 +81,13 @@ class ServeFrontend:
             # by an fsync — production serving always passes durable_dir
             self.node = Node(actor, num_elements, num_actors,
                              recorder=self.recorder)
+        # serve-ladder knobs (plain config attrs — restore_durable
+        # rebuilds the node from checkpoint metadata, which does not
+        # carry them): fused one-dispatch ingest+δ and compact WAL
+        # records default ON; the soak's seed-comparison leg turns them
+        # off to measure the two-dispatch/dense-record baseline
+        self.node.ingest_fused = ingest_fused
+        self.node.wal_compact_records = wal_compact_records
         self.queue = AdmissionQueue(queue_depth)
         self.batcher = MicroBatcher(
             self.node, self.queue, max_batch=max_batch,
@@ -92,6 +104,22 @@ class ServeFrontend:
                 checkpoint_every=checkpoint_every,
                 interval_s=sync_interval_s, wal_fsync=wal_fsync,
                 recorder=self.recorder, seed=seed)
+        # SLO-aware background compaction (serve/compaction.py):
+        # deletion-record GC + WAL-driven checkpoint rotation, run only
+        # when the serve gauges show ingest-latency headroom
+        self.compactor = None
+        if compact_interval_s > 0:
+            from go_crdt_playground_tpu.serve.compaction import \
+                CompactionScheduler
+
+            ckpt = (self.supervisor.checkpoint
+                    if self.supervisor is not None
+                    and durable_dir is not None else None)
+            self.compactor = CompactionScheduler(
+                self.node, self.recorder, checkpoint=ckpt,
+                interval_s=compact_interval_s,
+                p99_budget_s=compact_p99_budget_s,
+                gc_participants=gc_participants)
         # the listener/reader/conn-slot plumbing is the shared host
         # (serve/host.py) — the router tier runs the identical stack,
         # so accept-path fixes land once.  Frame caps are PER VERB: the
@@ -110,6 +138,7 @@ class ServeFrontend:
             max_conns=max_conns,
             max_frame_body=lambda t: (slice_cap if t in slice_verbs
                                       else ConnHost.MAX_FRAME_BODY))
+        self._has_peers = bool(peers)
         self._closed = threading.Event()
         # race-ok: serve() owner thread sets it before any reader runs
         self.addr: Optional[Addr] = None
@@ -134,6 +163,18 @@ class ServeFrontend:
                                             or self.supervisor.
                                             checkpoint_every > 0):
             self.supervisor.start()
+        if self.compactor is not None:
+            if self.compactor.gc_participants is None:
+                # derive the GC membership declaration from the peer
+                # CONFIG (restart-stable, unlike any heard-traffic
+                # heuristic): no peer set and no anti-entropy listener
+                # means this replica IS the deployment (the isolated
+                # declaration, ``()``); any peer surface without an
+                # explicit --gc-participants keeps GC disabled
+                self.compactor.gc_participants = (
+                    None if (self._has_peers or peer_port is not None)
+                    else ())
+            self.compactor.start()
         return self.addr
 
     def _warmup(self) -> None:
@@ -153,7 +194,15 @@ class ServeFrontend:
 
         B, E = self.batcher.max_batch, self.node.num_elements
         with tempfile.TemporaryDirectory(prefix="serve-warmup-") as d:
+            # same ingest regime as the REAL node: a --no-fused-ingest
+            # worker must warm the seed two-dispatch programs, not the
+            # fused one it will never run (the first batch would
+            # otherwise pay the compile stall the warmup exists to
+            # prevent — and skew any seed-vs-fused comparison)
             scratch = Node(self.node.actor, E, self.node.num_actors,
+                           ingest_fused=self.node.ingest_fused,
+                           wal_compact_records=self.node.
+                           wal_compact_records,
                            wal=DeltaWal(os.path.join(d, "wal"),
                                         fsync=False))
             add = np.zeros((B, E), bool)
@@ -179,6 +228,10 @@ class ServeFrontend:
         # before-close listener dance); in-flight connections get typed
         # Draining rejects for new ops from here on
         self.host.stop_accepting()
+        if self.compactor is not None:
+            # before the drain: a background checkpoint racing the
+            # final drain checkpoint would double-write the store
+            self.compactor.stop()
         self.batcher.drain(timeout=drain_timeout_s)
         if self.supervisor is not None:
             self.supervisor.stop()

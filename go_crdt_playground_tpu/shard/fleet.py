@@ -136,6 +136,9 @@ class FleetSpec:
     queue_depth: int = 128
     max_batch: int = 32
     flush_ms: float = 2.0
+    # extra `serve --ingest` CLI flags appended verbatim to every shard
+    # (the serve soak's seed-comparison / compaction legs ride these)
+    extra_args: Tuple[str, ...] = ()
 
     def __post_init__(self) -> None:
         if self.n_shards < 1:
@@ -174,7 +177,7 @@ class ShardProc(_Proc):
                 "--queue-depth", str(spec.queue_depth),
                 "--max-batch", str(spec.max_batch),
                 "--flush-ms", str(spec.flush_ms),
-                "--checkpoint-every", "0"]
+                "--checkpoint-every", "0"] + list(spec.extra_args)
         super().__init__(argv, cwd=repo,
                          log_path=os.path.join(dirpath, "shard.log"),
                          env=env,

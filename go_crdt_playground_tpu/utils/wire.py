@@ -247,3 +247,128 @@ def payload_nbytes_wire(p: DeltaPayload) -> int:
     (BASELINE.md north-star metrics) as shipped, vs nbytes_dense for the
     on-device dense form."""
     return len(encode_payload(p))
+
+
+# ---------------------------------------------------------------------------
+# Compact WAL record bodies (serve-path throughput ladder, DESIGN.md §16)
+# ---------------------------------------------------------------------------
+#
+# The dense WAL record (net/peer.Node: guard-vv || PAYLOAD frame body)
+# costs O(E) bytes per fsync — two E/8-byte section bitmasks — even when
+# a micro-batch touched a handful of lanes.  The compact record is the
+# same δ in index form: only the claimed lanes cross the fsync, so
+# bytes-per-batch is O(changed), the reference's map-shaped
+# ``MakeDeltaMergeData`` bandwidth restored on disk (the ops/compact.py
+# treatment applied to the WAL).
+#
+# Version tagging: a legacy dense record body begins with the guard
+# vv's ``varint A`` and every real store has A >= 1, so a leading 0x00
+# byte can never open a valid dense record.  Compact records exploit
+# that: body = 0x00 | version | varint src_actor | guard-vv |
+# processed-vv | src-vv | varint E | changed-lanes | deleted-lanes,
+# each lane section ``varint n, n x (varint element, varint dot_actor,
+# varint dot_counter)``.  E is embedded and checked like the dense
+# form's masked sections: a store reopened at a different universe
+# must FAIL decode (replay's bad-record prefix rule), never merge
+# in-range lane ids onto the wrong lanes.  Old stores (all-dense) replay through the new
+# reader unchanged; a mixed segment replays in order with the causal
+# guard intact (tests/test_durability.py).  Overflowing deltas fall
+# back to the dense record — never dropped.
+
+WAL_COMPACT_TAG = 0x00
+WAL_COMPACT_V1 = 1
+
+
+def _put_lane_section(out: bytearray, idx, da, dc) -> None:
+    _put_varint(out, len(idx))
+    for i, a, c in zip(idx, da, dc):
+        _put_varint(out, int(i))
+        _put_varint(out, int(a))
+        _put_varint(out, int(c))
+
+
+def _get_lane_section(buf: bytes, pos: int, e: int):
+    n, pos = _get_varint(buf, pos)
+    if n > e:
+        raise ValueError(f"lane section claims {n} lanes in universe {e}")
+    mask = np.zeros(e, bool)
+    da = np.zeros(e, np.uint32)
+    dc = np.zeros(e, np.uint32)
+    for _ in range(n):
+        i, pos = _get_varint(buf, pos)
+        a, pos = _get_varint(buf, pos)
+        c, pos = _get_varint(buf, pos)
+        if i >= e:
+            raise ValueError(f"lane id {i} outside universe {e}")
+        if a > 0xFFFFFFFF or c > 0xFFFFFFFF:
+            raise ValueError("dot component out of uint32 range")
+        mask[i], da[i], dc[i] = True, a, c
+    return mask, da, dc, pos
+
+
+def encode_compact_wal_body(guard_vv: np.ndarray, src_actor: int,
+                            processed: np.ndarray, src_vv: np.ndarray,
+                            ch_idx, ch_da, ch_dc, del_idx, del_da,
+                            del_dc, num_elements: int) -> bytes:
+    """One compact WAL record body.  ``*_idx``/``*_da``/``*_dc`` are
+    1-D sequences of the claimed lanes only (already filtered to valid
+    slots — the fixed-K ``compact_payload`` form's valid lanes, or a
+    host-side ``np.nonzero`` of the dense masks); ``num_elements`` is
+    the writer's universe, embedded for the decode-time dimension
+    check."""
+    out = bytearray((WAL_COMPACT_TAG, WAL_COMPACT_V1))
+    _put_varint(out, int(src_actor))
+    body = bytes(out)
+    body += _encode_vv_py(np.asarray(guard_vv, np.uint32))
+    body += _encode_vv_py(np.asarray(processed, np.uint32))
+    body += _encode_vv_py(np.asarray(src_vv, np.uint32))
+    tail = bytearray()
+    _put_varint(tail, int(num_elements))
+    _put_lane_section(tail, ch_idx, ch_da, ch_dc)
+    _put_lane_section(tail, del_idx, del_da, del_dc)
+    return body + tail
+
+
+def decode_compact_wal_body(body: bytes, num_elements: int,
+                            num_actors: int):
+    """Inverse of ``encode_compact_wal_body``: returns ``(guard_vv,
+    DeltaPayload)`` with the lane sections scattered back to the dense
+    device form (exactly the payload the producing dispatch extracted,
+    when it fit the record's lanes — which is the only case written).
+    Raises ``ValueError`` on any structural problem, which replay
+    treats like any other undecodable record (prefix rule)."""
+    if len(body) < 2 or body[0] != WAL_COMPACT_TAG:
+        raise ValueError("not a compact WAL record")
+    if body[1] != WAL_COMPACT_V1:
+        raise ValueError(f"unknown compact WAL record version {body[1]}")
+    src_actor, pos = _get_varint(body, 2)
+    if src_actor >= num_actors:
+        raise ValueError(f"src_actor {src_actor} outside actor axis "
+                         f"{num_actors}")
+    guard, pos = _decode_vv_py(body, pos, num_actors)
+    processed, pos = _decode_vv_py(body, pos, num_actors)
+    src_vv, pos = _decode_vv_py(body, pos, num_actors)
+    enc_e, pos = _get_varint(body, pos)
+    if enc_e != num_elements:
+        raise ValueError(f"universe mismatch: encoded {enc_e}, "
+                         f"expected {num_elements}")
+    changed, ch_da, ch_dc, pos = _get_lane_section(body, pos,
+                                                   num_elements)
+    deleted, del_da, del_dc, pos = _get_lane_section(body, pos,
+                                                     num_elements)
+    if pos != len(body):
+        raise ValueError(f"{len(body) - pos} trailing bytes after "
+                         "compact WAL record")
+    import jax.numpy as jnp
+
+    return guard, DeltaPayload(
+        src_vv=jnp.asarray(src_vv),
+        changed=jnp.asarray(changed),
+        ch_da=jnp.asarray(ch_da),
+        ch_dc=jnp.asarray(ch_dc),
+        deleted=jnp.asarray(deleted),
+        del_da=jnp.asarray(del_da),
+        del_dc=jnp.asarray(del_dc),
+        src_actor=jnp.uint32(src_actor),
+        src_processed=jnp.asarray(processed),
+    )

@@ -727,6 +727,10 @@ def test_gate_fast(tmp_path):
     # race-ok-annotated and swept
     assert {"HandoffCoordinator", "RouteState", "ConnHost"} <= covered, \
         covered
+    # ... and the serve-ladder compaction scheduler (the throughput-
+    # ladder ISSUE): its scheduling state crosses the loop thread and
+    # the frontend's lifecycle thread
+    assert "CompactionScheduler" in covered, covered
 
 
 def test_report_shape_roundtrips(tmp_path):

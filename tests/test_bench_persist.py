@@ -319,3 +319,35 @@ def test_roofline_row_bytes_and_artifact(tmp_path, monkeypatch, capsys):
         by_cfg["config3"]["roofline_rate"] * 1.5
     assert "measured_rate" not in by_cfg["config3"]
     json.loads(capsys.readouterr().out.strip())
+
+
+def test_ingest_ladder_refuses_cpu_overwrite_of_tpu_artifact(tmp_path,
+                                                             monkeypatch,
+                                                             capsys):
+    """The BENCH_r03/r05 footgun, fenced for the ingest ladder: a
+    CPU(-fallback) run must refuse to overwrite an on-chip
+    BENCH_INGEST.json — and must still write a fresh or same-platform
+    artifact."""
+    out = str(tmp_path / "BENCH_INGEST.json")
+    with open(out, "w") as f:
+        json.dump({"platform": "tpu", "curve": [{"committed": True}]}, f)
+    # measure_ingest monkeypatched out: the guard must trip BEFORE any
+    # measurement (a refused run should not even initialize legs)
+    monkeypatch.setattr(bench, "measure_ingest",
+                        lambda *a, **k: pytest.fail("measured anyway"))
+    assert bench.run_ingest(out=out) is None
+    with open(out) as f:
+        assert json.load(f)["curve"] == [{"committed": True}]
+    assert "refusing" in capsys.readouterr().out
+
+    # same-platform (cpu over cpu) proceeds
+    with open(out, "w") as f:
+        json.dump({"platform": "cpu"}, f)
+    monkeypatch.setattr(
+        bench, "measure_ingest",
+        lambda *a, **k: [{"batch": 8, "keys_per_op": 1,
+                          "wal_bytes_ratio": 4.0}])
+    art = bench.run_ingest(out=out)
+    assert art["platform"] == "cpu"
+    with open(out) as f:
+        assert json.load(f)["curve"][0]["batch"] == 8

@@ -1,0 +1,231 @@
+"""SLO-aware background compaction (serve/compaction.py): headroom
+decision, exponential backoff, deletion-record GC against the provable
+frontier, WAL-driven checkpoint rotation — all pinned deterministically
+through the ``run_cycle`` seam (no thread timing), plus one end-to-end
+frontend integration."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from go_crdt_playground_tpu.net.peer import Node
+from go_crdt_playground_tpu.obs import Recorder
+from go_crdt_playground_tpu.obs.metrics import percentile_of_counts
+from go_crdt_playground_tpu.serve.compaction import CompactionScheduler
+
+E, A = 48, 3
+
+
+def _node(rec, **kw):
+    return Node(0, E, A, recorder=rec, **kw)
+
+
+def test_percentile_of_counts_windows():
+    rec = Recorder()
+    for v in (0.001,) * 90 + (2.0,) * 10:
+        rec.observe("lat", v)
+    h1 = rec.histogram("lat")
+    assert percentile_of_counts(h1, 0.50) == pytest.approx(0.001, rel=0.5)
+    assert percentile_of_counts(h1, 0.99) >= 1.0
+    assert percentile_of_counts([0] * len(h1), 0.99) is None  # empty window
+    # a window diff isolates RECENT behavior from cumulative history
+    for v in (0.5,) * 10:
+        rec.observe("lat", v)
+    h2 = rec.histogram("lat")
+    window = [a - b for a, b in zip(h2, h1)]
+    assert percentile_of_counts(window, 0.5) == pytest.approx(0.5, rel=0.5)
+
+
+def test_no_headroom_backs_off_exponentially():
+    rec = Recorder()
+    sched = CompactionScheduler(_node(rec), rec, interval_s=0.5,
+                                queue_depth_max=4, max_backoff_s=3.0)
+    rec.set_gauge("serve.queue.depth", 50)  # saturated
+    waits = []
+    for _ in range(4):
+        out = sched.run_cycle()
+        assert out["ran"] is False
+        waits.append(out["backoff_s"])
+    assert waits == [1.0, 2.0, 3.0, 3.0]  # doubles, then caps
+    snap = rec.snapshot()
+    assert snap["counters"]["compact.backoffs"] == 4
+    assert snap["gauges"]["compact.headroom"] == 0.0
+    # headroom returns -> the wait resets to the base interval
+    rec.set_gauge("serve.queue.depth", 0)
+    out = sched.run_cycle()
+    assert out["ran"] is True
+    assert sched._wait_s == 0.5
+
+
+def test_recent_latency_spike_blocks_compaction():
+    """The windowed p99 gates the cycle: an old idle history must NOT
+    mask a current spike, and an old spike must not block forever."""
+    rec = Recorder()
+    sched = CompactionScheduler(_node(rec), rec, interval_s=0.5,
+                                p99_budget_s=0.05)
+    rec.set_gauge("serve.queue.depth", 0)
+    for _ in range(50):
+        rec.observe("serve.ingest_latency_s", 0.001)
+    assert sched.run_cycle()["ran"] is True  # first window: calm
+    for _ in range(20):
+        rec.observe("serve.ingest_latency_s", 0.5)  # spike NOW
+    assert sched.run_cycle()["ran"] is False
+    assert sched.run_cycle()["ran"] is True  # spike aged out of window
+
+
+def test_gc_drops_stable_deletions_and_reports_occupancy(tmp_path):
+    rec = Recorder()
+    node = _node(rec)
+    node.add(*range(10))
+    node.delete(1, 2, 3)
+    # membership is DECLARED: without a declaration GC is disabled
+    # (an undeclared frontier is all-zeros — restart-safe, unlike any
+    # "have I heard a peer?" heuristic)
+    undeclared = CompactionScheduler(node, rec, interval_s=0.5)
+    rec.set_gauge("serve.queue.depth", 0)
+    out = undeclared.run_cycle()
+    assert out["ran"] is True and out["gc"] is None
+    # the explicit isolated declaration (participants=()): this
+    # replica IS the deployment, its own processed vector is the
+    # frontier, every deletion record is provably stable
+    sched = CompactionScheduler(node, rec, interval_s=0.5,
+                                gc_participants=())
+    out = sched.run_cycle()
+    assert out["gc"] == {"dropped": 3, "remaining": 0}
+    snap = rec.snapshot()
+    assert snap["counters"]["compact.gc_runs"] == 1
+    assert snap["counters"]["compact.gc_dropped_lanes"] == 3
+    assert snap["gauges"]["compact.deleted_lanes"] == 0
+    assert sorted(int(e) for e in node.members()) == [0] + list(range(4, 10))
+
+
+def test_gc_frontier_waits_for_peer_acknowledgement():
+    """Mid-fleet, a deletion record survives until every DECLARED
+    participant's advertised ``processed`` vector covers it — the
+    provable half of causal stability (ops/delta.gc_frontier,
+    per-participant) — and an UNCONFIGURED frontier disables GC
+    entirely once any peer has been heard (gossip is transitive:
+    membership cannot be guessed from traffic)."""
+    rec = Recorder()
+    node = _node(rec)
+    node.add(1, 2)
+    node.delete(1)
+    # a peer that has NOT processed our deletes yet advertises zeros
+    import jax
+
+    peer = Node(1, E, A)
+    prow = jax.tree.map(lambda x: x[0], peer._state)
+    from go_crdt_playground_tpu.net import framing as fr
+    from go_crdt_playground_tpu.ops import delta as delta_ops
+
+    payload = delta_ops.delta_extract(prow, np.zeros(A, np.uint32))
+    node.apply_payload_body(fr.encode_payload_msg(
+        fr.MODE_DELTA, 1, np.asarray(prow.processed), payload))
+    assert node.gc_deletions(
+        participants=[1])["dropped"] == 0  # peer hasn't caught up
+    # the peer now advertises a processed vector covering our clock
+    caught_up = np.asarray([10, 10, 10], np.uint32)
+    payload = delta_ops.delta_extract(prow, np.zeros(A, np.uint32))
+    node.apply_payload_body(fr.encode_payload_msg(
+        fr.MODE_DELTA, 1, caught_up, payload))
+    # no participant set: a node that has heard ANY peer refuses to GC
+    # (a never-heard replica may hold our elements via transitive
+    # gossip and would keep them forever past a dropped record)
+    assert node.gc_deletions()["dropped"] == 0
+    assert np.all(node.deletion_frontier() == 0)
+    # an undeclared/unheard participant blocks GC too
+    assert node.gc_deletions(participants=[1, 2])["dropped"] == 0
+    # the declared set caught up: the record is provably stable
+    assert node.gc_deletions(participants=[1])["dropped"] == 1
+
+
+def test_gc_skipped_mid_heal_and_on_reference_semantics():
+    rec = Recorder()
+    node = _node(rec)
+    node.add(1)
+    node.delete(1)
+    with node._lock:
+        node.full_resync_pending = True
+    sched = CompactionScheduler(node, rec, interval_s=0.5,
+                                gc_participants=())
+    rec.set_gauge("serve.queue.depth", 0)
+    out = sched.run_cycle()
+    assert out["ran"] is True and out["gc"] is None  # healing: no GC
+    with node._lock:
+        node.full_resync_pending = False
+    assert sched.run_cycle()["gc"] is not None  # heal done: GC resumes
+    ref = Node(0, E, A, delta_semantics="reference")
+    with pytest.raises(ValueError, match="v2"):
+        ref.gc_deletions()
+
+
+def test_checkpoint_rotation_waits_for_wal_growth(tmp_path):
+    from go_crdt_playground_tpu.utils.checkpoint import CheckpointStore
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    d = str(tmp_path / "durable")
+    rec = Recorder()
+    node = _node(rec)
+    node.wal = DeltaWal(os.path.join(d, "wal"), recorder=rec,
+                        fsync=False)
+    store = CheckpointStore(d, recorder=rec)
+    calls = []
+
+    def ckpt():
+        calls.append(node.save_durable(store))
+
+    sched = CompactionScheduler(node, rec, checkpoint=ckpt,
+                                interval_s=0.5,
+                                checkpoint_wal_bytes=200)
+    sched._ckpt_base_bytes = rec.counter("wal.appended_bytes")
+    rec.set_gauge("serve.queue.depth", 0)
+    node.add(1)
+    assert sched.run_cycle()["checkpointed"] is False  # not enough WAL
+    for i in range(2, 30):
+        node.add(i)
+    out = sched.run_cycle()
+    assert out["checkpointed"] is True
+    assert calls == [1]
+    assert rec.snapshot()["counters"]["compact.checkpoints"] == 1
+    # rotation retired the sealed segments: replay-from-birth shrank
+    assert node.wal.record_count() == 0
+    # and does not re-checkpoint until the WAL grows again
+    assert sched.run_cycle()["checkpointed"] is False
+    with node._lock:
+        node.wal.close()
+
+
+def test_frontend_integration_compacts_under_idle(tmp_path):
+    """End to end: a frontend with compaction enabled GCs deletion
+    lanes while idle and keeps serving; the counters surface in the
+    STATS dialect like every other SLO number."""
+    from go_crdt_playground_tpu.serve.client import ServeClient
+    from go_crdt_playground_tpu.serve.frontend import ServeFrontend
+
+    fe = ServeFrontend(E, A, durable_dir=str(tmp_path / "n0"),
+                       max_batch=8, flush_ms=1.0,
+                       compact_interval_s=0.05)
+    fe.serve()
+    try:
+        with ServeClient(fe.addr) as c:
+            c.add(1, 2, 3)
+            c.delete(2)
+            deadline = time.monotonic() + 30.0
+            dropped = 0
+            while time.monotonic() < deadline:
+                snap = c.stats()
+                dropped = snap["counters"].get(
+                    "compact.gc_dropped_lanes", 0)
+                if dropped:
+                    break
+                time.sleep(0.05)
+            assert dropped == 1, "idle frontend never GC'd the deletion"
+            members, _ = c.members()
+            assert members == [1, 3]
+            c.add(10)  # still serving after maintenance
+            members, _ = c.members()
+            assert members == [1, 3, 10]
+    finally:
+        fe.close()

@@ -43,3 +43,14 @@ def test_crash_soak_quick_mode(tmp_path):
                and e["restore_counters"].get("restore.fallbacks", 0) > 0
                for e in faulted), \
         "the corrupt-newest-checkpoint fallback path was never exercised"
+    # serve-path throughput ladder: the zero-delta-loss verdict above
+    # covers BOTH WAL record modes — local δs wrote compact index-lane
+    # records, applied peer payloads dense ones, and restores replayed
+    # (compact-specific replay is pinned in tests/test_durability.py
+    # and the serve soak's crash leg; a kill can land right after a
+    # checkpoint truncation and leave one mode's tail empty)
+    modes = artifact["wal_record_modes"]
+    assert modes.get("wal.compact_records", 0) > 0, modes
+    assert modes.get("wal.dense_records", 0) > 0, modes
+    assert (modes.get("wal.replayed_compact", 0)
+            + modes.get("wal.replayed_dense", 0)) > 0, modes

@@ -644,3 +644,186 @@ def test_wal_alone_recovers_pre_first_checkpoint_history(tmp_path):
     assert "wal.future_records" not in snap
     assert not back.full_resync_pending  # nothing regressed
     back.wal.close()
+
+
+# -- compact WAL records (serve-path throughput ladder) ----------------------
+
+
+def _fields_equal(a, b):
+    for name in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+            err_msg=name)
+
+
+def test_dense_only_store_replays_through_new_reader(tmp_path):
+    """Backward compatibility: a store written ENTIRELY with the legacy
+    dense records (a pre-ladder node: compact records off) replays
+    through the upgraded reader to the same state."""
+    from go_crdt_playground_tpu.net import Node
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    d = str(tmp_path / "durable")
+    rec = Recorder()
+    node = Node(0, 48, 3, recorder=rec, wal_compact_records=False,
+                wal=DeltaWal(os.path.join(d, "wal"), recorder=rec))
+    node.add(1, 2, 3)
+    node.delete(2)
+    node.ingest_batch(
+        np.eye(48, dtype=bool)[[5, 9]], np.zeros((2, 48), bool))
+    node.wal.close()
+    snap = rec.snapshot()["counters"]
+    assert snap["wal.dense_records"] == 3
+    assert "wal.compact_records" not in snap
+
+    rec2 = Recorder()
+    back = Node.restore_durable(
+        d, recorder=rec2, fallback_init=lambda: Node(0, 48, 3))
+    _fields_equal(back.state_slice(), node.state_slice())
+    snap2 = rec2.snapshot()["counters"]
+    assert snap2["wal.replayed_dense"] == 3
+    assert "wal.replayed_compact" not in snap2
+    back.wal.close()
+
+
+def test_mixed_dense_compact_segment_replays_in_order(tmp_path):
+    """A segment interleaving dense and compact records — local compact
+    δs, a dense overflow-style record, an applied peer payload (always
+    dense), compact again — replays in order to the writer's state,
+    with both mode counters accounted."""
+    from go_crdt_playground_tpu.net import Node
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    d = str(tmp_path / "durable")
+    rec = Recorder()
+    node = Node(0, 48, 3, recorder=rec,
+                wal=DeltaWal(os.path.join(d, "wal"), recorder=rec))
+    node.add(1, 2)                       # compact
+    with node._lock:                     # force one DENSE local record
+        node.wal_compact_records = False
+    node.add(7)                          # dense
+    with node._lock:
+        node.wal_compact_records = True
+    node.delete(2)                       # compact (deletion lanes)
+    # an applied peer payload is logged dense as-received
+    peer = Node(1, 48, 3)
+    peer.add(30, 31)
+    import jax
+
+    me_vv = node.vv()
+    from go_crdt_playground_tpu.net import framing as fr
+    from go_crdt_playground_tpu.ops import delta as delta_ops
+
+    prow = jax.tree.map(lambda x: x[0], peer._state)
+    payload = delta_ops.delta_extract(prow, np.zeros(3, np.uint32))
+    body = fr.encode_payload_msg(fr.MODE_FULL, 1,
+                                 np.asarray(prow.processed), payload)
+    node.apply_payload_body(body)        # dense (wire body)
+    node.ingest_batch(np.eye(48, dtype=bool)[[40]],
+                      np.zeros((1, 48), bool))  # compact (fused batch)
+    node.wal.close()
+    snap = rec.snapshot()["counters"]
+    assert snap["wal.compact_records"] == 3
+    assert snap["wal.dense_records"] == 2
+    assert me_vv is not None
+
+    rec2 = Recorder()
+    back = Node.restore_durable(
+        d, recorder=rec2, fallback_init=lambda: Node(0, 48, 3))
+    _fields_equal(back.state_slice(), node.state_slice())
+    snap2 = rec2.snapshot()["counters"]
+    assert snap2["wal.records"] == 5
+    assert snap2["wal.replayed_compact"] == 3
+    assert snap2["wal.replayed_dense"] == 2
+    back.wal.close()
+
+
+def test_compact_record_respects_causal_replay_guard(tmp_path):
+    """The causal guard survives the record-format change: a compact
+    record whose guard vv outruns the replaying base is refused
+    (wal.future_records) exactly like a dense one, the refused suffix
+    is discarded (prefix rule), and the log resets."""
+    from go_crdt_playground_tpu.net import Node
+    from go_crdt_playground_tpu.utils import wire
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    d = str(tmp_path / "durable")
+    os.makedirs(d)
+    wal = DeltaWal(os.path.join(d, "wal"))
+    # record 1: applies from a zero base (guard 0) — lane 3 added
+    wal.append(wire.encode_compact_wal_body(
+        np.zeros(2, np.uint32), 0, np.asarray([1, 0], np.uint32),
+        np.asarray([1, 0], np.uint32), [3], [0], [1], [], [], [], 16))
+    # record 2: guard claims vv [5, 0] — a future the base never saw
+    wal.append(wire.encode_compact_wal_body(
+        np.asarray([5, 0], np.uint32), 0,
+        np.asarray([6, 0], np.uint32), np.asarray([6, 0], np.uint32),
+        [9], [0], [6], [], [], [], 16))
+    wal.close()
+
+    rec = Recorder()
+    back = Node.restore_durable(
+        d, recorder=rec, fallback_init=lambda: Node(0, 16, 2))
+    assert [int(e) for e in back.members()] == [3]  # prefix applied
+    snap = rec.snapshot()["counters"]
+    assert snap["wal.records"] == 1
+    assert snap["wal.future_records"] == 1
+    assert back.full_resync_pending      # regressed base arms the heal
+    assert back.wal.record_count() == 0  # refused suffix reset
+    back.wal.close()
+
+
+def test_compact_and_dense_records_replay_to_identical_state(tmp_path):
+    """The same op stream logged compact vs dense recovers to the same
+    state — the record form is an encoding, never a semantics."""
+    from go_crdt_playground_tpu.net import Node
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    stores = {}
+    for mode, compact in (("compact", True), ("dense", False)):
+        d = str(tmp_path / mode)
+        node = Node(0, 48, 3, wal_compact_records=compact,
+                    wal=DeltaWal(os.path.join(d, "wal")))
+        add = np.zeros((3, 48), bool)
+        add[0, [1, 5]] = True
+        add[1, 9] = True
+        dl = np.zeros((3, 48), bool)
+        dl[2, 5] = True
+        node.ingest_batch(add, dl)
+        node.add(20)
+        node.delete(9)
+        node.wal.close()
+        stores[mode] = (d, node)
+    backs = {}
+    for mode, (d, _) in stores.items():
+        back = Node.restore_durable(
+            d, fallback_init=lambda: Node(0, 48, 3))
+        backs[mode] = back.state_slice()
+        back.wal.close()
+    _fields_equal(backs["compact"], backs["dense"])
+    _fields_equal(backs["compact"], stores["compact"][1].state_slice())
+
+
+def test_compact_record_refuses_universe_change(tmp_path):
+    """Review fix: compact records embed E like the dense form's masked
+    sections — a store reopened at a different universe must FAIL
+    decode (bad-record prefix rule), never merge in-range lane ids
+    onto the wrong lanes."""
+    from go_crdt_playground_tpu.net import Node
+    from go_crdt_playground_tpu.utils import wire
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    d = str(tmp_path / "durable")
+    os.makedirs(d)
+    wal = DeltaWal(os.path.join(d, "wal"))
+    wal.append(wire.encode_compact_wal_body(
+        np.zeros(2, np.uint32), 0, np.asarray([1, 0], np.uint32),
+        np.asarray([1, 0], np.uint32), [3], [0], [1], [], [], [], 64))
+    wal.close()
+    rec = Recorder()
+    # replay at E=16: lane 3 is in range, but the universe differs
+    back = Node.restore_durable(
+        d, recorder=rec, fallback_init=lambda: Node(0, 16, 2))
+    assert list(back.members()) == []
+    assert rec.snapshot()["counters"]["wal.bad_records"] == 1
+    back.wal.close()

@@ -624,6 +624,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                if any(r.get("violation_reports") for r in runs) else {}),
         })
 
+    # WAL record-mode census (serve-path throughput ladder): the
+    # workers write local δs as COMPACT index-lane records and applied
+    # peer payloads as DENSE records, so a healthy sweep must show both
+    # modes written AND replayed — the zero-acked-delta-loss verdict
+    # below covers the mixed-mode log, not just the legacy form
+    record_modes: Dict[str, int] = {}
+    for e in curve:
+        for k, v in e["restore_counters"].items():
+            if k in ("wal.compact_records", "wal.dense_records",
+                     "wal.replayed_compact", "wal.replayed_dense"):
+                record_modes[k] = record_modes.get(k, 0) + v
+
     artifact = {
         "metric": ("recovery rounds to the no-fault fixed point vs per-tick "
                    f"SIGKILL rate ({n_nodes}-process durable Node fleet: "
@@ -634,6 +646,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "unit": "worker rounds (at the lowest faulted kill rate)",
         "fleet": {"nodes": n_nodes, "elements": n_elements,
                   "quick": bool(args.quick)},
+        "wal_record_modes": record_modes,
         "curve": curve,
         "elapsed_s": round(time.time() - t0, 1),
         "platform": "cpu",
@@ -663,6 +676,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         e["corruption_injected"]
         and e["restore_counters"].get("restore.fallbacks", 0) > 0
         for e in faulted)
+    # both WAL record modes were written under the kill storm, and
+    # restores replayed records — the zero-delta-loss verdict above
+    # covers the mixed-mode log.  (Replay of specifically-compact
+    # records is pinned deterministically in tests/test_durability.py
+    # and adjudicated in the serve soak's crash leg; here a kill can
+    # legitimately land right after a checkpoint truncation, leaving
+    # any single mode's tail empty.)
+    ok = ok and record_modes.get("wal.compact_records", 0) > 0
+    ok = ok and record_modes.get("wal.dense_records", 0) > 0
+    ok = ok and (record_modes.get("wal.replayed_compact", 0)
+                 + record_modes.get("wal.replayed_dense", 0)) > 0
     return 0 if ok else 1
 
 
