@@ -143,7 +143,8 @@ def _cmd_serve_ingest(args) -> int:
         wal_compact_records=args.fused_ingest,
         compact_interval_s=args.compact_interval,
         compact_p99_budget_s=args.compact_p99_budget_ms / 1e3,
-        gc_participants=args.gc_participants)
+        gc_participants=args.gc_participants,
+        sync_mode=args.sync_mode)
     if args.gc_participants is not None and args.compact_interval <= 0:
         print("WARNING: --gc-participants has no effect without "
               "--compact-interval > 0 — no compaction scheduler runs, "
@@ -155,6 +156,7 @@ def _cmd_serve_ingest(args) -> int:
           f"queue={args.queue_depth} "
           f"durable={'yes' if args.durable_dir else 'NO'} "
           f"fused={'yes' if args.fused_ingest else 'NO'} "
+          f"sync={args.sync_mode} "
           f"compaction={args.compact_interval or 'off'})", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -230,7 +232,8 @@ def _cmd_router(args) -> int:
 
     router = ShardRouter(shards, args.elements, seed=args.seed,
                          state_dir=args.state_dir,
-                         transfer_timeout_s=args.transfer_timeout)
+                         transfer_timeout_s=args.transfer_timeout,
+                         fleet_gc_interval_s=args.fleet_gc_interval)
     # the banner's load split reuses the router's OWN precomputed owner
     # map — recomputing it here would double the O(E x shards) blake2b
     # startup cost for a log line
@@ -380,6 +383,15 @@ def main(argv=None) -> int:
                         "freely, peered ones keep GC off; an empty "
                         "string is the explicit isolated declaration; "
                         "takes effect only with --compact-interval > 0)")
+    s.add_argument("--sync-mode", dest="sync_mode", default="delta",
+                   choices=("delta", "digest"),
+                   help="anti-entropy regime (DESIGN.md §19): 'digest' "
+                        "opens every exchange with a packed per-lane-"
+                        "group digest summary and ships only mismatched "
+                        "lanes (O(diff) rounds; quiescent peers exchange "
+                        "~digest+vv bytes and zero state lanes), "
+                        "negotiated per peer with automatic fallback to "
+                        "the delta ladder for pre-digest peers")
     s.add_argument("--no-fused-ingest", dest="fused_ingest",
                    action="store_false",
                    help="seed-comparison mode: two dispatches per batch "
@@ -420,6 +432,14 @@ def main(argv=None) -> int:
                    help="keyspace-handoff transfer deadline in seconds "
                         "(size to the slice: past it the handoff aborts "
                         "and the old ring keeps serving)")
+    r.add_argument("--fleet-gc-interval", dest="fleet_gc_interval",
+                   type=float, default=0.0,
+                   help="seconds between fleet-aware deletion-record GC "
+                        "rounds (0 = off): the router aggregates every "
+                        "shard's provable frontier into the true fleet "
+                        "minimum and pushes it back for clamped local GC "
+                        "(ROADMAP item c; requires every shard reachable "
+                        "per round)")
 
     rs = sub.add_parser(
         "reshard",

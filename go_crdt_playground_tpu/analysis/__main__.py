@@ -41,7 +41,8 @@ PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # state, so the guarded-by sweep covers them like the sync runtime;
 # the shard/ files are the router tier — its per-shard links, relay
 # fan-in, and fleet runner cross as many threads as the frontend does)
-LOCK_TARGETS = ["net/peer.py", "net/antientropy.py", "utils/wal.py",
+LOCK_TARGETS = ["net/peer.py", "net/antientropy.py", "net/digestsync.py",
+                "utils/wal.py",
                 "serve/admission.py", "serve/session.py",
                 "serve/batcher.py", "serve/frontend.py",
                 "serve/client.py", "serve/host.py", "serve/compaction.py",
@@ -57,7 +58,8 @@ DURABILITY_TARGETS = ["utils/wal.py", "utils/checkpoint.py",
 PURITY_TARGETS = ["ops/merge.py", "ops/delta.py", "ops/lattices.py",
                   "ops/vv.py", "ops/compact.py", "ops/pallas_merge.py",
                   "ops/pallas_delta.py", "ops/ingest.py",
-                  "ops/pallas_ingest.py"]
+                  "ops/pallas_ingest.py", "ops/digest.py",
+                  "ops/pallas_digest.py"]
 # attribute-name -> class hints for cross-class lock-order edges
 ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "recorder": "Recorder", "_store": "CheckpointStore",
@@ -68,7 +70,8 @@ ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "relay": "_Relay", "_client": "ServeClient",
                 "host": "ConnHost", "handoff": "HandoffCoordinator",
                 "_route": "RouteState",
-                "compactor": "CompactionScheduler"}
+                "compactor": "CompactionScheduler",
+                "_negotiator": "DigestNegotiator"}
 
 
 def _paths(rel: List[str], root: str) -> List[str]:

@@ -477,10 +477,19 @@ class AWSetDelta(AWSet):
     def _absorb_records(self, records: Dict[str, Dot]) -> None:
         """v2: received deletion records enter our own log so they re-gossip
         transitively (reference mode never does this — that is why its
-        deletions only travel originator→peer)."""
+        deletions only travel originator→peer).
+
+        The retained record is the lexicographic MAX on (counter,
+        actor): counter ties between records from DIFFERENT actors are
+        broken by actor id, never by arrival order — without the
+        tie-break the absorb is not a join (two replicas receiving the
+        same tied records in opposite orders keep different ones
+        forever), which the digest-sync regime (DESIGN.md §19) would
+        read as permanent lane divergence."""
         for k, d in records.items():
             cur = self.deleted.get(k)
-            if cur is None or d.counter > cur.counter:
+            if cur is None or (d.counter, d.actor) > (cur.counter,
+                                                     cur.actor):
                 self.deleted[k] = d
 
     def _join_processed(self, src: "AWSetDelta") -> None:

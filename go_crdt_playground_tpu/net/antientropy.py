@@ -269,13 +269,42 @@ class SyncSupervisor:
                  durable_dir: Optional[str] = None,
                  keep_generations: int = 3,
                  wal_fsync: bool = True,
+                 sync_mode: str = "delta",
                  recorder=None, seed: int = 0,
                  sleep: Callable[[float], None] = time.sleep,
                  clock: Callable[[], float] = time.monotonic):
+        """``sync_mode``: the anti-entropy regime (DESIGN.md §19).
+        ``"delta"`` is the FULL/DELTA ladder; ``"digest"`` opens every
+        exchange with a digest summary (net/digestsync.py) and ships
+        only mismatched lanes — O(diff) rounds — NEGOTIATED per peer:
+        a peer answering "expected HELLO" is pinned legacy and synced
+        over the ladder for its lifetime, so mixed fleets roll forward
+        safely.  Digest exchanges require v2 delta semantics (the
+        reference mode never absorbs deletion records, so its logs
+        never converge bitwise and every digest would mismatch
+        forever).  A node healing a regressed restore
+        (full_resync_pending) rides the ladder until the epoch
+        retires — the forced-FULL zero-vv advertisement is the
+        ladder's mechanism."""
         if durable_dir is not None and checkpoint_path is not None:
             raise ValueError(
                 "durable_dir and checkpoint_path are alternative "
                 "checkpoint regimes; pass one")
+        if sync_mode not in ("delta", "digest"):
+            raise ValueError(f"unknown sync_mode {sync_mode!r} "
+                             "(expected 'delta' or 'digest')")
+        if sync_mode == "digest" and node.delta_semantics != "v2":
+            raise ValueError(
+                "digest sync requires v2 (record-absorbing) delta "
+                "semantics: reference-mode deletion logs never "
+                "converge bitwise, so their digests mismatch forever")
+        self.sync_mode = sync_mode
+        self._negotiator = None
+        if sync_mode == "digest":
+            from go_crdt_playground_tpu.net.digestsync import \
+                DigestNegotiator
+
+            self._negotiator = DigestNegotiator()
         self.node = node
         self.policy = policy if policy is not None else BackoffPolicy()
         self.sync_timeout_s = sync_timeout_s
@@ -427,10 +456,7 @@ class SyncSupervisor:
         bo = Backoff(self.policy, seed=self._rng.getrandbits(32))
         while True:
             try:
-                self.node.sync_with(
-                    addr, timeout=self.sync_timeout_s,
-                    connect_timeout_s=self.connect_timeout_s,
-                    hello_timeout_s=self.hello_timeout_s)
+                self._exchange(addr)
             except Exception as e:  # noqa: BLE001 — classified below
                 cls = classify_failure(e)
                 if cls == CLASS_UNKNOWN and not isinstance(
@@ -460,6 +486,32 @@ class SyncSupervisor:
                 breaker.record_success()
                 self._count("sync.successes")
                 return True
+
+    def _exchange(self, addr: Addr) -> None:
+        """One exchange on the negotiated regime: digest-first when the
+        digest regime is on, the peer is not pinned legacy, and no
+        forced-FULL healing epoch is pending; a peer that answers
+        "expected HELLO" is pinned legacy (``sync.digest.unsupported``)
+        and the SAME attempt completes over the ladder — negotiation
+        costs one extra dial once per legacy peer, never a failed
+        round."""
+        if (self._negotiator is not None
+                and self._negotiator.use_digest(addr)
+                and not self.node.full_resync_is_pending()):
+            from go_crdt_playground_tpu.net import digestsync
+
+            try:
+                digestsync.sync_digest(
+                    self.node, addr, timeout=self.sync_timeout_s,
+                    connect_timeout_s=self.connect_timeout_s)
+                return
+            except digestsync.DigestUnsupported:
+                self._negotiator.mark_legacy(addr)
+                self._count("sync.digest.unsupported")
+        self.node.sync_with(
+            addr, timeout=self.sync_timeout_s,
+            connect_timeout_s=self.connect_timeout_s,
+            hello_timeout_s=self.hello_timeout_s)
 
     def run(self, max_rounds: Optional[int] = None,
             until: Optional[Callable[[], bool]] = None) -> int:

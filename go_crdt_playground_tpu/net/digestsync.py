@@ -1,0 +1,469 @@
+"""Digest-driven anti-entropy: O(diff) sync rounds (DESIGN.md §19).
+
+The FULL/DELTA ladder (net/peer.py) ships state every round — a δ
+payload's floor is two E/8-byte section bitmasks plus the whole
+un-resurrected deletion log, even between two CONVERGED replicas.  At
+fleet scale that floor, not merge throughput, is the wall (ROADMAP):
+the ring-fused merge kernel measures 0.999 of its HBM roofline while
+every quiescent pair still burns O(E) wire bytes per round.
+
+This tier implements the join-decomposition digest protocol of
+"Efficient Synchronization of State-based CRDTs" (PAPERS.md, arxiv
+1803.02750) on the packed substrate: peers exchange a compact DIGEST
+SUMMARY — vv, processed, and one uint32 per ``DIGEST_GROUP_LANES``-lane
+group (``ops/digest.py``, Pallas twin on TPU backends) — *before* any
+state, compute the mismatching lane set ON-DEVICE, and ship only those
+lanes, index-encoded (``MODE_DIGEST``, utils/wire.encode_payload_lanes).
+A quiescent pair converges in ~O(digest) bytes (summary + vv + an
+empty lane payload, zero state lanes); a divergent pair in O(diff).
+
+Exchange (one push-pull round, mirroring ``Node.sync_with``'s shape)::
+
+    client                                  server
+      DIGEST(vv, processed, digests)  --->
+                                      <---  DIGEST(vv, processed, digests)
+      PAYLOAD(lanes of mismatched groups | δ | empty) ---> apply
+                                      <---  PAYLOAD(...)  (post-absorb)
+      apply
+
+Each side picks its payload mode by the same rule:
+
+* some groups mismatch → ``MODE_DIGEST``: complete lane state for
+  exactly the mismatched groups (ops/digest.digest_diff_payload),
+  applied by ordinary v2 δ arbitration — CRDT-monotone, idempotent,
+  order-free, so both directions of a push-pull round compose;
+* no group mismatches but the vvs DIFFER → the digests claim equality
+  the clocks contradict: either a vv-only divergence (e.g. an
+  add+delete pair another peer already relayed) or a digest COLLISION
+  (the documented 2^-32-per-group bound, ops/digest.py).  Both heal
+  the same way: fall back to the always-sound δ ladder for this round
+  (``Node._extract_msg`` — δ against the peer's advertised vv, FULL on
+  first contact), counted as ``digest.fallback_delta``.  This is the
+  collision-detected-divergence rung of the ladder;
+* digests AND vvs agree → an empty ``MODE_DIGEST`` payload (a few
+  bytes); its apply is a no-op join.  Counted ``digest.quiescent``.
+
+NEGOTIATION (per peer, supervisor-driven): the client opens with
+``MSG_DIGEST``; a pre-digest server answers ``MSG_ERROR`` ("expected
+HELLO"), surfaced here as ``DigestUnsupported`` — the supervisor marks
+the peer legacy in its ``DigestNegotiator`` and re-syncs over the
+FULL/DELTA ladder, permanently for that peer (net/antientropy.py).  A
+group-size or universe mismatch is a deterministic config error and
+propagates as the protocol failure class (breaker-visible), like a
+dimension mismatch in HELLO.
+
+v2-ONLY: reference delta semantics never absorb deletion records, so
+two reference replicas' deletion-log lanes never become bitwise equal
+and their digests mismatch forever — the supervisor refuses the digest
+regime for a reference-mode node at construction.
+
+GC evidence rides along: each side records the peer's advertised
+``processed`` vector (``Node.note_peer_processed``) even when no
+payload ships, so the deletion-GC frontier (DESIGN.md §16) keeps
+advancing in a quiescent digest fleet — without this, zero-payload
+rounds would starve ``_peer_processed`` and freeze GC.
+
+Metric names (the contract): ``digest.exchanges``,
+``digest.bytes_sent`` / ``digest.bytes_received``,
+``digest.lanes_sent`` (state lanes shipped on ANY rung — the
+δ-fallback's lanes count too, so the quiescent-fleet adjudication in
+SYNC_CURVE.json, this counter staying flat, cannot miss state that
+rode the fallback),
+``digest.groups_mismatched``, ``digest.quiescent``,
+``digest.fallback_delta``, ``sync.digest.unsupported`` (negotiation
+fallbacks, counted by the supervisor).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import NamedTuple, Optional, Set, Tuple
+
+import numpy as np
+
+from go_crdt_playground_tpu.net import framing
+from go_crdt_playground_tpu.net.framing import (MODE_DIGEST, MSG_DIGEST,
+                                                MSG_PAYLOAD, ProtocolError)
+from go_crdt_playground_tpu.ops.digest import (DIGEST_GROUP_LANES,
+                                               num_groups)
+from go_crdt_playground_tpu.utils import wire
+
+Addr = Tuple[str, int]
+
+# summary-body version: bumped when the summary layout or the
+# fingerprint algebra changes incompatibly (a mismatch is a
+# deterministic config error, like an element-universe mismatch)
+DIGEST_V1 = 1
+
+
+class DigestUnsupported(Exception):
+    """The peer answered MSG_DIGEST with the legacy ladder's "expected
+    HELLO" error: it predates the digest protocol.  NOT a failure —
+    the caller falls back to ``Node.sync_with`` and pins the peer
+    legacy (DigestNegotiator)."""
+
+
+class DigestSyncStats(NamedTuple):
+    """One digest exchange, measured (client side)."""
+
+    bytes_sent: int
+    bytes_received: int
+    mode_sent: int            # MODE_DIGEST | MODE_DELTA | MODE_FULL
+    mode_received: int
+    lanes_sent: int           # state lanes in our payload (0 quiescent)
+    groups_mismatched: int
+    quiescent: bool
+
+
+class DigestNegotiator:
+    """Per-peer digest-capability cache (thread-safe): the supervisor's
+    round thread asks ``use_digest`` before each dial and
+    ``mark_legacy`` pins a peer that answered "expected HELLO" — the
+    negotiation outcome is deterministic for a given peer build, so
+    one fallback is enough for the peer's lifetime in this process.
+    A peer set can mix digest and legacy nodes freely (rolling
+    upgrades)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._legacy: Set[Addr] = set()  # guarded-by: _lock
+
+    def use_digest(self, addr: Addr) -> bool:
+        key = (addr[0], int(addr[1]))
+        with self._lock:
+            return key not in self._legacy
+
+    def mark_legacy(self, addr: Addr) -> None:
+        with self._lock:
+            self._legacy.add((addr[0], int(addr[1])))
+
+    def legacy_peers(self) -> Set[Addr]:
+        with self._lock:
+            return set(self._legacy)
+
+
+# ---------------------------------------------------------------------------
+# Summary body codec
+# ---------------------------------------------------------------------------
+#
+#   varint version | varint actor | varint E | varint group_size |
+#   vv-section(vv) | vv-section(processed) | varint G | G x uint32 LE
+
+
+def encode_summary(actor: int, num_elements: int, group_size: int,
+                   vv: np.ndarray, processed: np.ndarray,
+                   digests: np.ndarray) -> bytes:
+    out = bytearray()
+    wire._put_varint(out, DIGEST_V1)
+    wire._put_varint(out, actor)
+    wire._put_varint(out, num_elements)
+    wire._put_varint(out, group_size)
+    body = bytes(out)
+    body += wire._encode_vv_py(np.asarray(vv, np.uint32))
+    body += wire._encode_vv_py(np.asarray(processed, np.uint32))
+    d = np.asarray(digests, np.uint32)
+    tail = bytearray()
+    wire._put_varint(tail, d.shape[0])
+    return body + bytes(tail) + d.astype("<u4").tobytes()
+
+
+def decode_summary(body: bytes, num_elements: int, num_actors: int
+                   ) -> Tuple[int, int, np.ndarray, np.ndarray,
+                              np.ndarray]:
+    """Returns (actor, group_size, vv, processed, digests); raises
+    ProtocolError on any structural or dimensional disagreement —
+    digest peers must share version, universe, actor axis, AND group
+    size (the digests are meaningless across a grouping mismatch)."""
+    try:
+        version, pos = wire._get_varint(body, 0)
+        if version != DIGEST_V1:
+            raise ProtocolError(f"digest summary version {version} != "
+                                f"{DIGEST_V1}")
+        actor, pos = wire._get_varint(body, pos)
+        e, pos = wire._get_varint(body, pos)
+        if e != num_elements:
+            raise ProtocolError(f"element-universe mismatch: peer E={e}, "
+                                f"ours E={num_elements}")
+        group_size, pos = wire._get_varint(body, pos)
+        if group_size < 1:
+            raise ProtocolError("digest group size must be >= 1")
+        vv, pos = wire._decode_vv_py(body, pos, num_actors)
+        processed, pos = wire._decode_vv_py(body, pos, num_actors)
+        g, pos = wire._get_varint(body, pos)
+        if g != num_groups(num_elements, group_size):
+            raise ProtocolError(
+                f"digest count {g} does not cover E={num_elements} at "
+                f"group size {group_size}")
+        raw = body[pos:pos + 4 * g]
+        if len(raw) != 4 * g or pos + 4 * g != len(body):
+            raise ProtocolError("malformed digest section")
+        digests = np.frombuffer(raw, "<u4").copy()
+    except ValueError as err:  # wire-layer section mismatch / malformed
+        raise ProtocolError(str(err)) from err
+    if actor >= num_actors:
+        raise ProtocolError(f"peer actor {actor} outside actor axis "
+                            f"{num_actors}")
+    return actor, group_size, vv, processed, digests
+
+
+# ---------------------------------------------------------------------------
+# Shared exchange halves
+# ---------------------------------------------------------------------------
+
+
+def node_summary(node, group_size: int = DIGEST_GROUP_LANES) -> bytes:
+    """This node's current digest summary frame body.  The state
+    reference is snapshotted under the node lock; the digest kernel
+    runs OUTSIDE it (states are immutable pytrees), so a summary never
+    holds the lock across a dispatch."""
+    import jax
+
+    with node._lock:
+        me = jax.tree.map(lambda x: x[0], node._state)
+    digests = np.asarray(node._digest_fn(me, group_size))
+    return encode_summary(node.actor, node.num_elements, group_size,
+                          np.asarray(me.vv), np.asarray(me.processed),
+                          digests)
+
+
+def warm(node, group_size: int = DIGEST_GROUP_LANES) -> None:
+    """Compile the digest-exchange kernel set for ``node``'s shapes by
+    running one full self-exchange (summary digests + the on-device
+    mismatch extraction): the first real round must pay a socket
+    round-trip, not a trace+compile.  THE warm recipe — serve
+    frontends and soak harnesses call this instead of hand-rolling the
+    exchange, so a future digest-path kernel is warmed everywhere by
+    updating one place.  Safe on a live node (summary and reply are
+    side-effect-free); callers typically pass a scratch node of the
+    serving shapes."""
+    body = node_summary(node, group_size)
+    _, _, vv, _, digs = decode_summary(body, node.num_elements,
+                                       node.num_actors)
+    # a self-exchange is quiescent and would short-circuit before the
+    # diff-extraction kernel — perturb the advertised digests so the
+    # mismatched-group path (the expensive compile) traces too
+    digs = np.asarray(digs, np.uint32) ^ np.uint32(1)
+    with node._lock:
+        build_reply_payload(node, vv, digs, group_size)
+
+
+# requires-lock: node._lock
+def build_reply_payload(node, peer_vv: np.ndarray,
+                        peer_digests: np.ndarray,
+                        group_size: int) -> Tuple[int, bytes, int, int]:
+    """Build this side's PAYLOAD frame body against the peer's
+    advertised summary, from the CURRENT state (the server calls this
+    after absorbing the client's payload, so transitively-learned
+    lanes ride along — the ``_serve_conn`` extract-after-absorb
+    shape).  Caller holds the node lock.
+
+    Returns ``(mode, body, lanes, groups_mismatched)`` per the module
+    docstring's mode rule.  ``lanes`` counts the state lanes shipped
+    on EVERY rung — digest-extracted or δ-fallback — so the
+    ``digest.lanes_sent`` counter (the SYNC_CURVE quiescent
+    adjudication) cannot miss state that rode the fallback."""
+    import jax
+
+    from go_crdt_playground_tpu.ops import digest as digest_ops
+    from go_crdt_playground_tpu.ops.delta import DeltaPayload
+
+    me = jax.tree.map(lambda x: x[0], node._state)
+    own = np.asarray(node._digest_fn(me, group_size))
+    n_mism = digest_ops.mismatched_group_count(own, peer_digests)
+    if n_mism == 0:
+        if np.array_equal(np.asarray(me.vv, np.uint32),
+                          np.asarray(peer_vv, np.uint32)):
+            # quiescent (the common round, whose whole pitch is
+            # cheapness): the digest kernel already ran for `own`;
+            # the empty MODE_DIGEST payload is built host-side, with
+            # no extract dispatch
+            e = int(me.present.shape[-1])
+            zb = np.zeros(e, bool)
+            zu = np.zeros(e, np.uint32)
+            payload = DeltaPayload(
+                src_vv=np.asarray(me.vv, np.uint32),
+                changed=zb, ch_da=zu, ch_dc=zu,
+                deleted=zb, del_da=zu, del_dc=zu,
+                src_actor=np.uint32(node.actor),
+                src_processed=np.asarray(me.processed, np.uint32))
+            body = framing.encode_payload_msg(
+                MODE_DIGEST, node.actor, np.asarray(me.processed),
+                payload)
+            return MODE_DIGEST, body, 0, 0
+        # digests claim equality, clocks disagree: vv-only divergence
+        # or a digest collision — this round rides the δ ladder
+        mode, processed, payload = node._extract_payload(
+            np.asarray(peer_vv))
+        lanes = int(np.asarray(payload.changed).sum()) + \
+            int(np.asarray(payload.deleted).sum())
+        body = framing.encode_payload_msg(mode, node.actor, processed,
+                                          payload)
+        return mode, body, lanes, 0
+    payload = digest_ops.digest_diff_payload(me, own, peer_digests,
+                                             group_size)
+    lanes = int(np.asarray(payload.changed).sum()) + \
+        int(np.asarray(payload.deleted).sum())
+    body = framing.encode_payload_msg(
+        MODE_DIGEST, node.actor, np.asarray(me.processed), payload)
+    return MODE_DIGEST, body, lanes, n_mism
+
+
+def _record(node, *, bytes_sent: int, bytes_received: int, lanes: int,
+            groups: int, mode_sent: int, quiescent: bool) -> None:
+    if node.recorder is None:
+        return
+    counts = {
+        "digest.exchanges": 1,
+        "digest.bytes_sent": bytes_sent,
+        "digest.bytes_received": bytes_received,
+    }
+    if lanes > 0:
+        counts["digest.lanes_sent"] = lanes
+    if groups:
+        counts["digest.groups_mismatched"] = groups
+    if quiescent:
+        counts["digest.quiescent"] = 1
+    if mode_sent != MODE_DIGEST:
+        counts["digest.fallback_delta"] = 1
+    node.recorder.count_many(counts)
+
+
+# ---------------------------------------------------------------------------
+# Server half (dispatched from Node._serve_conn on MSG_DIGEST)
+# ---------------------------------------------------------------------------
+
+
+def serve_digest_exchange(node, conn: socket.socket,
+                          summary_body: bytes) -> None:
+    """Answer one inbound digest exchange.  Mirrors the legacy server
+    flow: summary-for-summary, then payload-for-payload with apply and
+    extract under ONE lock hold.  Protocol errors reply MSG_ERROR and
+    return (connection-scoped; the dialing supervisor classifies)."""
+    group_size = DIGEST_GROUP_LANES
+    try:
+        peer_actor, peer_gs, peer_vv, peer_processed, peer_digests = \
+            decode_summary(summary_body, node.num_elements,
+                           node.num_actors)
+        if peer_gs != group_size:
+            raise ProtocolError(
+                f"digest group-size mismatch: peer {peer_gs}, ours "
+                f"{group_size}")
+    except ProtocolError as e:
+        framing.send_frame(conn, framing.MSG_ERROR, str(e).encode())
+        return
+    sent = framing.send_frame(conn, MSG_DIGEST,
+                              node_summary(node, group_size))
+    recv = framing.frame_size(len(summary_body))
+    node.note_peer_processed(peer_actor, peer_processed)
+    msg_type, body = framing.recv_frame(conn,
+                                        timeout=node.conn_timeout_s)
+    if msg_type != MSG_PAYLOAD:
+        framing.send_frame(conn, framing.MSG_ERROR,
+                           f"expected PAYLOAD, got {msg_type}".encode())
+        return
+    try:
+        with node._lock:
+            mode_recv = node._apply_msg(body)
+            mode, out, lanes, groups = build_reply_payload(
+                node, peer_vv, peer_digests, group_size)
+    except (ProtocolError, ValueError) as e:
+        # ValueError: apply hit a closed/refusing WAL (teardown race)
+        # — served as a clean error frame, like the legacy path
+        framing.send_frame(conn, framing.MSG_ERROR, str(e).encode())
+        return
+    sent += framing.send_frame(conn, MSG_PAYLOAD, out)
+    recv += framing.frame_size(len(body))
+    _record(node, bytes_sent=sent, bytes_received=recv,
+            lanes=lanes, groups=groups, mode_sent=mode,
+            quiescent=(mode == MODE_DIGEST and lanes == 0
+                       and mode_recv == MODE_DIGEST))
+
+
+# ---------------------------------------------------------------------------
+# Client half
+# ---------------------------------------------------------------------------
+
+
+def sync_digest(node, addr: Addr, timeout: float = 30.0, *,
+                connect_timeout_s: Optional[float] = None,
+                group_size: int = DIGEST_GROUP_LANES) -> DigestSyncStats:
+    """One push-pull digest exchange with the peer at ``addr``.
+
+    Deadline model: the dial is bounded by ``connect_timeout_s``
+    (default ``timeout``); both reply frames by the full ``timeout`` —
+    unlike HELLO, the summary reply sits behind a digest-kernel
+    dispatch, so it gets the payload budget, not the idle-dial one.
+    Raises the same typed ``SyncError`` hierarchy as ``sync_with``
+    (net/antientropy.py classifies it identically), plus
+    ``DigestUnsupported`` for the legacy-peer negotiation outcome."""
+    from go_crdt_playground_tpu.net.peer import (ConnectFailed,
+                                                 PeerProtocolError,
+                                                 PeerReset, PeerTimeout)
+
+    my_summary = node_summary(node, group_size)
+    connect_t = timeout if connect_timeout_s is None else \
+        connect_timeout_s
+    try:
+        sock = socket.create_connection(addr, timeout=connect_t)
+    except socket.timeout as e:
+        raise PeerTimeout(f"connect to {addr}: {e}",
+                          phase="connect") from e
+    except OSError as e:
+        raise ConnectFailed(f"connect to {addr}: {e}") from e
+    sock.settimeout(timeout)
+    with sock:
+        phase = "digest"
+        try:
+            sent = framing.send_frame(sock, MSG_DIGEST, my_summary)
+            try:
+                msg_type, body = framing.recv_frame(sock,
+                                                    timeout=timeout)
+            except framing.RemoteError as e:
+                if "expected HELLO" in str(e):
+                    # a pre-digest peer: negotiation outcome, not a
+                    # failure — the caller re-syncs over the ladder
+                    raise DigestUnsupported(str(e)) from e
+                raise
+            if msg_type != MSG_DIGEST:
+                raise ProtocolError(f"expected DIGEST, got {msg_type}")
+            peer_actor, peer_gs, peer_vv, peer_processed, \
+                peer_digests = decode_summary(
+                    body, node.num_elements, node.num_actors)
+            if peer_gs != group_size:
+                raise ProtocolError(
+                    f"digest group-size mismatch: peer {peer_gs}, "
+                    f"ours {group_size}")
+            recv = framing.frame_size(len(body))
+            node.note_peer_processed(peer_actor, peer_processed)
+            with node._lock:
+                mode_sent, out, lanes, groups = build_reply_payload(
+                    node, peer_vv, peer_digests, group_size)
+            phase = "payload"
+            sent += framing.send_frame(sock, MSG_PAYLOAD, out)
+            msg_type, body = framing.recv_frame(sock, timeout=timeout)
+            if msg_type != MSG_PAYLOAD:
+                raise ProtocolError(f"expected PAYLOAD, got {msg_type}")
+            recv += framing.frame_size(len(body))
+            with node._lock:
+                mode_recv = node._apply_msg(body)
+        except (DigestUnsupported, framing.RemoteError):
+            raise  # typed already; RemoteError carries the message
+        except socket.timeout as e:
+            raise PeerTimeout(f"{phase} exchange with {addr}: {e}",
+                              phase=phase) from e
+        except framing.TruncatedFrame as e:
+            raise PeerReset(f"{phase} exchange with {addr}: {e}") from e
+        except ProtocolError as e:
+            raise PeerProtocolError(str(e)) from e
+        except OSError as e:
+            raise PeerReset(f"{phase} exchange with {addr}: {e}") from e
+    quiescent = (mode_sent == MODE_DIGEST and lanes == 0
+                 and mode_recv == MODE_DIGEST)
+    _record(node, bytes_sent=sent, bytes_received=recv,
+            lanes=lanes, groups=groups, mode_sent=mode_sent,
+            quiescent=quiescent)
+    return DigestSyncStats(
+        bytes_sent=sent, bytes_received=recv, mode_sent=mode_sent,
+        mode_received=mode_recv, lanes_sent=lanes,
+        groups_mismatched=groups, quiescent=quiescent)

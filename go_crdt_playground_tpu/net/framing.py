@@ -30,6 +30,13 @@ MAGIC = b"\xc7\xd1"
 MSG_HELLO = 1
 MSG_PAYLOAD = 2
 MSG_ERROR = 3
+# digest-driven anti-entropy (DESIGN.md §19): the opening frame of a
+# digest exchange carries a compact summary — vv + processed + packed
+# per-lane-group digests (net/digestsync.py owns the body codec) —
+# instead of HELLO.  A pre-digest peer answers it with MSG_ERROR
+# ("expected HELLO"), which the client reads as version-mismatch and
+# falls back to the FULL/DELTA ladder for that peer.
+MSG_DIGEST = 4
 
 MODE_DELTA = 0
 MODE_FULL = 1
@@ -40,6 +47,14 @@ MODE_FULL = 1
 # slice pushes join donor vvs), and arbitration would drop exactly
 # those lanes
 MODE_SLICE = 2
+# digest-sync lane payload (DESIGN.md §19): the sender's COMPLETE lane
+# state for digest-mismatched groups, index-encoded (utils/wire.py
+# encode_payload_lanes — O(diff) bytes, no E/8 section bitmasks),
+# applied by normal v2 δ arbitration (ops/delta.delta_apply): lanes in
+# digest-MATCHED groups are withheld because they are provably (to the
+# ops/digest.py collision bound) identical, which is what makes the
+# full-vv join safe — contrast MODE_SLICE's fenced overwrite.
+MODE_DIGEST = 3
 
 _MAX_BODY = 1 << 30
 
@@ -203,9 +218,16 @@ def encode_payload_msg(mode: int, src_actor: int, processed: np.ndarray,
     out = bytearray()
     out.append(mode)
     wire._put_varint(out, src_actor)
-    return (bytes(out)
-            + wire._encode_vv_py(np.asarray(processed, np.uint32))
-            + wire.encode_payload(payload))
+    head = (bytes(out)
+            + wire._encode_vv_py(np.asarray(processed, np.uint32)))
+    if mode == MODE_DIGEST:
+        # digest-sync lane payloads are sparse by construction (only
+        # mismatched groups' lanes): index-encode them — the dense
+        # section bitmasks would reintroduce the O(E) floor the digest
+        # exchange exists to beat
+        return head + wire.encode_payload_lanes(
+            payload, int(payload.changed.shape[-1]))
+    return head + wire.encode_payload(payload)
 
 
 def encode_delta_wal_record(pre_vv: np.ndarray, src_actor: int, payload,
@@ -225,9 +247,37 @@ def encode_delta_wal_record(pre_vv: np.ndarray, src_actor: int, payload,
     lane vs the dense record's two E/8-byte section bitmasks) → the
     legacy dense record (guard-vv || PAYLOAD body).  Nothing is ever
     dropped; ``compact_records=False`` forces the dense form (the
-    seed-comparison mode)."""
+    seed-comparison mode).
+
+    DELETION-LOG FILTERING (every form, DESIGN.md §16): the δ's
+    deleted section carries the WHOLE un-resurrected deletion log
+    (``delta_extract`` ships records regardless of the receiver's
+    clock — reference wire semantics), so without filtering every
+    record costs O(changed + deletion log).  For a WAL record the
+    replay GUARD gives the exact filter: a deletion dot ``(a, c)``
+    with ``c <= pre_vv[a]`` predates this record's ops, so the record
+    that INTRODUCED it — the local delete whose dot outran its own
+    pre-vv, or the applied peer payload logged dense as-received —
+    sits earlier in checkpoint ⊔ log and replays first (the prefix
+    rule preserves the order; a guard-refused suffix resets the log
+    whole).  Only deletions the record's own window produced survive
+    the filter, making records O(changed) outright.  Replay-compat
+    pinned in tests/test_durability.py.
+
+    Recovery-model note: after a guard-refused replay RESETS the log
+    (restore_durable), the applied prefix lives only in state until
+    the next checkpoint — changed lanes have ALWAYS ridden that
+    window (later records compress them away against pre-vv; the
+    persisted resync epoch + anti-entropy is the documented heal),
+    and filtered deletion records now ride the same one instead of
+    being accidentally re-carried by every later record."""
     pre_vv = np.asarray(pre_vv, np.uint32)
     num_elements = int(payload.changed.shape[-1])
+
+    def fresh_mask(da: np.ndarray, dc: np.ndarray) -> np.ndarray:
+        # NOT covered by the guard: introduced by this record's window
+        return dc > np.take(pre_vv, da.astype(np.int64), mode="clip")
+
     if compact_records:
         if compact is not None:
             import jax
@@ -238,7 +288,8 @@ def encode_delta_wal_record(pre_vv: np.ndarray, src_actor: int, payload,
             compact = jax.device_get(compact)
         if compact is not None and not bool(compact.overflow):
             chv = compact.ch_valid
-            dlv = compact.del_valid
+            dlv = compact.del_valid & np.asarray(
+                fresh_mask(compact.del_da, compact.del_dc))
             return wire.encode_compact_wal_body(
                 pre_vv, src_actor, compact.src_processed,
                 compact.src_vv,
@@ -249,7 +300,12 @@ def encode_delta_wal_record(pre_vv: np.ndarray, src_actor: int, payload,
                 compact.del_da[dlv],
                 compact.del_dc[dlv], num_elements), True
         changed = np.asarray(payload.changed)
-        deleted = np.asarray(payload.deleted)
+        del_da = np.asarray(payload.del_da)
+        del_dc = np.asarray(payload.del_dc)
+        deleted = np.asarray(payload.deleted) & fresh_mask(del_da,
+                                                           del_dc)
+        # break-even on the FILTERED lane count: an old deletion log
+        # must not push a small record into the dense form
         lanes = int(changed.sum()) + int(deleted.sum())
         if lanes * 3 <= max(16, num_elements // 4):
             ch = np.nonzero(changed)[0]
@@ -259,10 +315,21 @@ def encode_delta_wal_record(pre_vv: np.ndarray, src_actor: int, payload,
                 np.asarray(payload.src_vv),
                 ch, np.asarray(payload.ch_da)[ch],
                 np.asarray(payload.ch_dc)[ch],
-                dl, np.asarray(payload.del_da)[dl],
-                np.asarray(payload.del_dc)[dl], num_elements), True
+                dl, del_da[dl], del_dc[dl], num_elements), True
+    # dense fallback: the deletion filter applies here too (the form
+    # is an encoding, the record contract is the same)
+    del_da = np.asarray(payload.del_da)
+    del_dc = np.asarray(payload.del_dc)
+    deleted = np.asarray(payload.deleted) & fresh_mask(del_da, del_dc)
+    # host numpy throughout: the encoder np.asarray's every field, so
+    # bouncing the filtered arrays through the device buys nothing
+    filtered = payload._replace(
+        deleted=deleted,
+        del_da=np.where(deleted, del_da, np.uint32(0)),
+        del_dc=np.where(deleted, del_dc, np.uint32(0)))
     body = encode_payload_msg(
-        MODE_DELTA, src_actor, np.asarray(payload.src_processed), payload)
+        MODE_DELTA, src_actor, np.asarray(payload.src_processed),
+        filtered)
     return wire._encode_vv_py(pre_vv) + body, False
 
 
@@ -272,7 +339,7 @@ def decode_payload_msg(body: bytes, num_elements: int, num_actors: int):
     if not body:
         raise ProtocolError("empty PAYLOAD body")
     mode = body[0]
-    if mode not in (MODE_DELTA, MODE_FULL, MODE_SLICE):
+    if mode not in (MODE_DELTA, MODE_FULL, MODE_SLICE, MODE_DIGEST):
         raise ProtocolError(f"unknown payload mode {mode}")
     try:
         src_actor, pos = wire._get_varint(body, 1)
@@ -280,8 +347,10 @@ def decode_payload_msg(body: bytes, num_elements: int, num_actors: int):
             raise ProtocolError(f"payload src_actor {src_actor} outside "
                                 f"actor axis {num_actors}")
         processed, pos = wire._decode_vv_py(body, pos, num_actors)
-        payload = wire.decode_payload(body[pos:], num_elements, num_actors,
-                                      src_actor=src_actor)
+        decode = (wire.decode_payload_lanes if mode == MODE_DIGEST
+                  else wire.decode_payload)
+        payload = decode(body[pos:], num_elements, num_actors,
+                         src_actor=src_actor)
     except ValueError as err:  # wire-layer section mismatch / malformed
         raise ProtocolError(str(err)) from err
     import jax.numpy as jnp

@@ -168,6 +168,11 @@ class Node:
         # (fused_fn, k) resolved on first fused batch — backend and E
         # are fixed for the node's lifetime
         self._fused_regime = None  # guarded-by: _lock
+        # digest-sync kernel dispatch (net/digestsync.py), resolved on
+        # first digest exchange — backend and E are lifetime-fixed
+        # race-ok: idempotent lazy init (every racer computes the same
+        # backend dispatch; last write wins harmlessly)
+        self._digest_regime = None
         # race-ok: read-only configuration after __init__
         self.wal_compact_records = wal_compact_records
         # freshest causal-stability vector each peer actor advertised
@@ -350,9 +355,13 @@ class Node:
     # -- payload plumbing ---------------------------------------------------
 
     # requires-lock: _lock
-    def _extract_msg(self, peer_vv: np.ndarray) -> Tuple[int, bytes]:
-        """Build the PAYLOAD frame body for a peer that advertised peer_vv.
-        Caller holds the lock."""
+    def _extract_payload(self, peer_vv: np.ndarray):
+        """The FULL/DELTA ladder's payload for a peer that advertised
+        peer_vv, pre-encode: ``(mode, processed, payload)``.  Caller
+        holds the lock.  Split from ``_extract_msg`` so the digest
+        tier's δ-fallback rung can census the shipped lanes before
+        encoding (net/digestsync.py — ``digest.lanes_sent`` must count
+        EVERY state lane, whichever rung ships it)."""
         import jax
         import jax.numpy as jnp
 
@@ -376,8 +385,15 @@ class Node:
         else:
             payload = delta_ops.delta_extract(me, jnp.asarray(peer_vv))
             mode = MODE_DELTA
+        return mode, np.asarray(me.processed), payload
+
+    # requires-lock: _lock
+    def _extract_msg(self, peer_vv: np.ndarray) -> Tuple[int, bytes]:
+        """Build the PAYLOAD frame body for a peer that advertised peer_vv.
+        Caller holds the lock."""
+        mode, processed, payload = self._extract_payload(peer_vv)
         body = framing.encode_payload_msg(
-            mode, self.actor, np.asarray(me.processed), payload)
+            mode, self.actor, processed, payload)
         return mode, body
 
     # requires-lock: _lock
@@ -431,6 +447,11 @@ class Node:
             # extract_slice / ops/delta.slice_apply)
             merged = delta_ops.slice_apply(me, payload)
         else:
+            # MODE_DELTA and MODE_DIGEST both apply by δ arbitration:
+            # a digest-sync lane payload differs only in its wire form
+            # (index lanes, net/digestsync.py) — its merge semantics
+            # are exactly a δ's, which is what lets both directions of
+            # a digest push-pull round compose CRDT-monotonically
             merged = delta_ops.delta_apply(
                 me, payload, self.delta_semantics,
                 self.strict_reference_semantics)
@@ -550,6 +571,34 @@ class Node:
         any client op (restore_durable replays it)."""
         with self._lock:
             self._apply_msg(body)
+
+    # -- digest-driven anti-entropy (net/digestsync.py, DESIGN.md §19) ------
+
+    def _digest_fn(self, state_slice, group_size):
+        """The digest-kernel backend dispatch, resolved once per node
+        lifetime (ops/digest.digest_regime: Pallas twin on TPU, fused
+        XLA pass elsewhere)."""
+        if self._digest_regime is None:
+            from go_crdt_playground_tpu.ops.digest import digest_regime
+
+            self._digest_regime = digest_regime(self.num_elements)
+        return self._digest_regime(state_slice, group_size)
+
+    def note_peer_processed(self, src_actor: int, processed) -> None:
+        """Record a peer's advertised causal-stability vector — the
+        ``_apply_payload`` GC bookkeeping, callable WITHOUT a payload:
+        a quiescent digest exchange ships no state yet still proves
+        what the peer has processed, and without this the deletion-GC
+        frontier (deletion_frontier) would freeze in a converged
+        digest fleet.  Monotone join, like the payload path."""
+        src_actor = int(src_actor)
+        if src_actor == self.actor:
+            return
+        proc = np.asarray(processed, np.uint32)
+        with self._lock:
+            prev = self._peer_processed.get(src_actor)
+            self._peer_processed[src_actor] = (
+                proc.copy() if prev is None else np.maximum(prev, proc))
 
     # -- deletion-record GC (serve-path compaction, DESIGN.md §16) ----------
 
@@ -770,6 +819,16 @@ class Node:
                 # real client sends HELLO immediately on connect)
                 msg_type, body = framing.recv_frame(
                     conn, timeout=self.hello_timeout_s)
+                if msg_type == framing.MSG_DIGEST:
+                    # digest-driven anti-entropy (DESIGN.md §19): the
+                    # whole exchange is the tier's job — summary for
+                    # summary, then lane payloads.  Dispatched here so
+                    # one listener speaks both ladders; a pre-digest
+                    # peer never sends this frame.
+                    from go_crdt_playground_tpu.net import digestsync
+
+                    digestsync.serve_digest_exchange(self, conn, body)
+                    return
                 if msg_type != MSG_HELLO:
                     framing.send_frame(conn, framing.MSG_ERROR,
                                        f"expected HELLO, got {msg_type}"

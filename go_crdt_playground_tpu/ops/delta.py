@@ -166,8 +166,16 @@ def _delta_apply_impl(
 
     if delta_semantics == "v2":
         # absorb received records for transitive re-gossip (spec
-        # _absorb_records: overwrite if absent or newer counter)
-        take_rec = p.deleted & (~dst.deleted | (p.del_dc > dst.del_dot_counter))
+        # _absorb_records: overwrite if absent or (counter, actor)
+        # lexicographically newer — the actor tie-break is what makes
+        # the absorb a JOIN: without it, equal-counter records from
+        # different actors are retained by arrival order and two
+        # replicas never converge bitwise on the lane, which digest
+        # sync (DESIGN.md §19) would re-ship forever)
+        take_rec = p.deleted & (~dst.deleted
+                                | (p.del_dc > dst.del_dot_counter)
+                                | ((p.del_dc == dst.del_dot_counter)
+                                   & (p.del_da > dst.del_dot_actor)))
         deleted_log = dst.deleted | p.deleted
         del_da = jnp.where(take_rec, p.del_da, dst.del_dot_actor)
         del_dc = jnp.where(take_rec, p.del_dc, dst.del_dot_counter)
@@ -263,8 +271,13 @@ def full_merge_delta(dst: AWSetDeltaState, src: AWSetDeltaState,
         src.vv, src.present, src.dot_actor, src.dot_counter,
     )
     if delta_semantics == "v2":
-        take_rec = src.deleted & (~dst.deleted
-                                  | (src.del_dot_counter > dst.del_dot_counter))
+        # (counter, actor) lexicographic max — the same join-not-
+        # arrival-order absorb as _delta_apply_impl's
+        take_rec = src.deleted & (
+            ~dst.deleted
+            | (src.del_dot_counter > dst.del_dot_counter)
+            | ((src.del_dot_counter == dst.del_dot_counter)
+               & (src.del_dot_actor > dst.del_dot_actor)))
         deleted_log = dst.deleted | src.deleted
         del_da = jnp.where(take_rec, src.del_dot_actor, dst.del_dot_actor)
         del_dc = jnp.where(take_rec, src.del_dot_counter, dst.del_dot_counter)

@@ -1,0 +1,244 @@
+"""Packed per-lane digest kernel: O(diff) anti-entropy fingerprints.
+
+"Efficient Synchronization of State-based CRDTs" (PAPERS.md, arxiv
+1803.02750) cuts a sync round's cost to O(diff) by exchanging join-
+decomposition DIGESTS before any state.  This module is the tensorized
+half of that design (net/digestsync.py is the wire half): one jitted
+pass fingerprints every element lane of a packed ``AWSetDeltaState``
+slice — present bit, live dot, deletion record, with the lane id folded
+in — and XOR-folds the fingerprints into fixed-size GROUP digests.  Two
+replicas exchange ``ceil(E / group) * 4`` bytes of digests plus their
+vvs; equal digests mean (to a 2^-32-per-group collision bound, below)
+the groups' lanes are identical and nothing ships; a mismatched group
+names exactly which lanes to exchange.
+
+This extends the host-only ``models/digest.py`` CRC approach (whole-
+array integrity digests for checkpoints) into a VECTORIZED per-element
+fingerprint the sync path can compute on-device every round: the CRC
+digest answers "is this stored state intact?", the lane digest answers
+"WHICH lanes differ between two live replicas?".
+
+Fingerprint function: a murmur3-finalizer-style avalanche mix
+(``_mix32``) folded over the lane's CONVERGENT projection — the
+present bit, deletion-log membership, and the deletion record's dot —
+seeded with the lane id so identical content on different lanes
+digests differently (and so the group XOR fold cannot cancel two
+equal-content lanes).  Every operation is uint32 add/xor/shift/
+multiply — elementwise over [E], no gathers — so the XLA form is one
+fused pass and the Pallas twin (``ops/pallas_digest.py``) computes it
+block-resident in VMEM.
+
+WHY LIVE DOTS ARE EXCLUDED: the reference merge's both-present rule
+(awset.go:122-147, ``take_src = sp & (dp | ~seen)``) OVERWRITES the
+receiver's live dot with the sender's whenever both hold the element,
+so after concurrent adds of one key a push-pull pair permanently holds
+DIFFERENT (and on every full exchange, swapping) dots for the same
+present lane — divergent by design, converged in every observable.
+Folding live dots in would make such lanes mismatch forever and the
+digest regime would re-ship them every round without ever reaching
+quiescence (measured: a 4-node soak fleet never went lane-silent).
+Excluding them is sound: a lane pair differing ONLY in live dots has
+equal membership on both sides, so withholding it ships nothing the
+receiver observably lacks, and the dot divergence heals through
+ordinary δ arbitration the moment it matters (any delete/re-add moves
+the projection, which IS fingerprinted).  Deletion records, by
+contrast, stay folded in — their absorb rule is a true join
+((counter, actor) lexicographic max, ops/delta.py), so converged
+replicas agree on them bitwise.
+
+SOUNDNESS (the direction the protocol's correctness leans on): the
+fingerprint is a deterministic pure function of (lane id, lane state),
+so equal lanes ALWAYS produce equal fingerprints, and a group-digest
+mismatch PROVES some lane in the group differs (pinned by
+tests/test_digest_kernel.py).  The converse is probabilistic:
+
+COLLISION BOUND (documented contract): two DIFFERING groups collide —
+digest-equal while a lane differs — with probability ~2^-32 per group
+pair per comparison (the XOR of >= 1 differing well-mixed 32-bit lane
+fingerprints is ~uniform).  A collision makes one digest round ship
+nothing for a group that differs; the protocol layer additionally
+falls back to a δ exchange whenever the digests claim equality while
+the vvs differ (net/digestsync.py), so a collided round degrades to
+the always-sound δ ladder instead of silently diverging.  At 2^-32
+per group per round, a 6-node fleet syncing 1024 lanes (16 groups)
+every 100ms expects one collision per ~4.5 years; each is healed by
+the very next round's δ fallback (vv inequality persists until joined).
+
+Group size: ``DIGEST_GROUP_LANES`` = 64 lanes per uint32 digest — the
+summary costs E/16 bytes against the dense δ payload's two E/8-byte
+section bitmasks, while a single divergent lane ships at most its
+64-lane group.  The value is a protocol parameter (carried in the
+digest summary frame and checked for equality — peers must agree), and
+must divide the Pallas lane width (128) so both kernel forms pad to
+identical group boundaries.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
+from go_crdt_playground_tpu.ops.delta import DeltaPayload, delta_extract
+
+# protocol parameter (net/digestsync.py carries + checks it on the
+# wire): lanes per uint32 group digest.  Must divide the Pallas lane
+# width (ops/pallas_merge._LANE = 128) — see module docstring.
+DIGEST_GROUP_LANES = 64
+
+# fingerprint seed: folded into every lane's hash so a digest is
+# versioned implicitly — changing the mix (or this constant) makes
+# every group mismatch, which degrades to a δ exchange, never to a
+# false "equal".  numpy scalars, not jnp: they must stay concrete
+# literals inside the Pallas kernel body (traced module constants get
+# rejected as captured consts).
+_SEED = np.uint32(0x9E3779B9)
+_M1 = np.uint32(0x85EBCA6B)
+_M2 = np.uint32(0xC2B2AE35)
+
+
+def _mix32(h: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 fmix32: full avalanche over uint32 lanes (every input
+    bit flips each output bit with ~1/2 probability — what the 2^-32
+    collision bound in the module docstring leans on)."""
+    h = h ^ (h >> 16)
+    h = h * _M1
+    h = h ^ (h >> 13)
+    h = h * _M2
+    return h ^ (h >> 16)
+
+
+def _fold(h: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Fold one uint32 state component into the running lane hash."""
+    return _mix32(h ^ v.astype(jnp.uint32))
+
+
+def lane_fingerprint_arrays(lane_ids, present, deleted, del_dot_actor,
+                            del_dot_counter) -> jnp.ndarray:
+    """The fingerprint algebra on raw component arrays — shared
+    verbatim by the XLA pass below and the Pallas twin's in-kernel
+    body (ops/pallas_digest.py), so the bitwise-pinned definition
+    lives in exactly one place.  Covers the lane's CONVERGENT
+    projection only (module docstring: live dots are divergent by
+    design and deliberately excluded).  All inputs broadcast over the
+    lane axis; masks may be bool or uint8."""
+    h = _mix32(lane_ids.astype(jnp.uint32) ^ _SEED)
+    h = _fold(h, present != 0)
+    h = _fold(h, deleted != 0)
+    h = _fold(h, del_dot_actor)
+    h = _fold(h, del_dot_counter)
+    return h
+
+
+@jax.jit
+def lane_fingerprints(state: AWSetDeltaState) -> jnp.ndarray:
+    """uint32[E] per-lane fingerprints of one single-replica slice
+    (fields shaped [E]/[A]).  vv/processed are deliberately NOT folded
+    in: they are A-shaped replica clocks, exchanged explicitly in the
+    digest summary — the lane digest answers only "do these LANES
+    match" (in their convergent projection)."""
+    e = state.present.shape[-1]
+    return lane_fingerprint_arrays(
+        jnp.arange(e, dtype=jnp.uint32), state.present, state.deleted,
+        state.del_dot_actor, state.del_dot_counter)
+
+
+def group_fold(fp: jnp.ndarray, group_size: int) -> jnp.ndarray:
+    """XOR-fold uint32[E] lane fingerprints into uint32[ceil(E/gs)]
+    group digests.  Lanes past E pad with the fingerprint OF A ZERO
+    LANE AT THAT LANE ID — the same value every replica of the same
+    universe computes, so the ragged last group is comparison-stable
+    (pinned by tests/test_digest_kernel.py)."""
+    e = fp.shape[-1]
+    pad = (-e) % group_size
+    if pad:
+        pad_ids = jnp.arange(e, e + pad, dtype=jnp.uint32)
+        z = jnp.zeros(pad, jnp.uint32)
+        fp = jnp.concatenate(
+            [fp, lane_fingerprint_arrays(pad_ids, z, z, z, z)])
+    grouped = fp.reshape(-1, group_size)
+    return jax.lax.reduce(grouped, jnp.uint32(0), jax.lax.bitwise_xor,
+                          (1,))
+
+
+@functools.partial(jax.jit, static_argnames=("group_size",))
+def state_group_digests(state: AWSetDeltaState,
+                        group_size: int = DIGEST_GROUP_LANES
+                        ) -> jnp.ndarray:
+    """One dispatch: per-lane fingerprints + group XOR fold (XLA
+    form).  ``digest_regime`` is the backend dispatch callers should
+    use."""
+    return group_fold(lane_fingerprints(state), group_size)
+
+
+def digest_regime(num_elements: int):
+    """THE backend dispatch for the digest kernel (the
+    ``ops/ingest.ingest_delta_regime`` pattern): returns a
+    ``digests_fn(state_slice, group_size) -> uint32[G]`` — the Pallas
+    twin on TPU backends (fingerprints computed block-resident in
+    VMEM), the fused XLA pass everywhere else.  Both are bitwise-
+    pinned (tests/test_digest_kernel.py), so the protocol tier may
+    call either side of an exchange on either backend."""
+    del num_elements  # shape-independent today; keeps the seam stable
+    if jax.default_backend() == "tpu":
+        from go_crdt_playground_tpu.ops.pallas_digest import \
+            pallas_state_group_digests
+
+        return pallas_state_group_digests
+    return state_group_digests
+
+
+def num_groups(num_elements: int,
+               group_size: int = DIGEST_GROUP_LANES) -> int:
+    return -(-num_elements // group_size)
+
+
+@functools.partial(jax.jit, static_argnames=("group_size",))
+def digest_diff_payload(state: AWSetDeltaState, own_digests,
+                        peer_digests,
+                        group_size: int = DIGEST_GROUP_LANES
+                        ) -> DeltaPayload:
+    """The mismatching-lane set, computed ON-DEVICE in one dispatch:
+    compare our group digests (``own_digests`` — the caller computed
+    them once via the backend regime; recomputing here would double
+    the fingerprint pass and pin the XLA form even on TPU) against the
+    peer's, expand the mismatched groups to a lane mask, and extract
+    our COMPLETE state for exactly those lanes (the
+    ``Node.extract_slice`` shape: ``delta_extract`` vs a zero vv,
+    masked) — every present lane with its dot and every un-resurrected
+    deletion record in a mismatched group, nothing from matched
+    groups.
+
+    The payload's ``src_vv`` is our FULL vv (unlike the compact-
+    overflow path, which must neutralize it): lanes withheld here are
+    in digest-MATCHED groups, i.e. OBSERVABLY identical on the
+    receiver (equal convergent projection — a withheld lane may differ
+    in its live dot, but then the receiver already holds the element
+    present under its own dot) to the collision bound, so joining the
+    full clock cannot cover an add the receiver lacks — the module-
+    docstring collision bound is exactly the probability of that
+    invariant failing, and the protocol's δ fallback on vv-divergence-
+    without-digest-mismatch is the healing path (net/digestsync.py)."""
+    e = state.present.shape[-1]
+    mism = jnp.asarray(own_digests, jnp.uint32) != \
+        jnp.asarray(peer_digests, jnp.uint32)
+    lane_mask = jnp.repeat(mism, group_size, total_repeat_length=
+                           mism.shape[0] * group_size)[:e]
+    p = delta_extract(state, jnp.zeros_like(state.vv))
+    return p._replace(
+        changed=p.changed & lane_mask,
+        ch_da=jnp.where(lane_mask, p.ch_da, 0),
+        ch_dc=jnp.where(lane_mask, p.ch_dc, 0),
+        deleted=p.deleted & lane_mask,
+        del_da=jnp.where(lane_mask, p.del_da, 0),
+        del_dc=jnp.where(lane_mask, p.del_dc, 0))
+
+
+def mismatched_group_count(own_digests, peer_digests) -> int:
+    """Host-side census for the Recorder (the wire decision itself
+    stays on-device in digest_diff_payload)."""
+    return int(np.sum(np.asarray(own_digests, np.uint32)
+                      != np.asarray(peer_digests, np.uint32)))

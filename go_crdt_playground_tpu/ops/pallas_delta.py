@@ -127,7 +127,12 @@ def _delta_algebra(dst, src, s_actor, mode: str = "v2"):
     joined_vv = jnp.where(dvv < svv, svv, dvv)
 
     if mode == "v2":
-        rec_f = sd & (~dd | (sddc > dddc))
+        # deletion-record absorb is a (counter, actor) lexicographic
+        # JOIN (ops/delta._delta_apply_impl) — the actor tie-break
+        # keeps equal-counter records from different actors order-free,
+        # which the digest regime needs for bitwise lane convergence
+        rec_newer = (sddc > dddc) | ((sddc == dddc) & (sdda > ddda))
+        rec_f = sd & (~dd | rec_newer)
         deleted_f = dd | sd
         del_da_f = jnp.where(rec_f, sdda, ddda)
         del_dc_f = jnp.where(rec_f, sddc, dddc)
@@ -142,7 +147,7 @@ def _delta_algebra(dst, src, s_actor, mode: str = "v2"):
         present_d = present1 & ~remove
         da_d = jnp.where(present_d, da1, 0)
         dc_d = jnp.where(present_d, dc1, 0)
-        rec_d = deleted_p & (~dd | (sddc > dddc))
+        rec_d = deleted_p & (~dd | rec_newer)
         deleted_d = dd | deleted_p
         del_da_d = jnp.where(rec_d, sdda, ddda)
         del_dc_d = jnp.where(rec_d, sddc, dddc)

@@ -1,0 +1,94 @@
+"""Pallas TPU twin of the packed per-lane digest kernel.
+
+``ops/digest.state_group_digests`` is one fused elementwise XLA pass;
+this kernel computes the identical fingerprints with each element block
+resident in VMEM — the convergent-projection arrays (present, deletion
+log, deletion dots) stream HBM→VMEM once and the whole mix runs on the
+VPU, the ``ops/pallas_ingest.py`` treatment applied to the digest path.  The group XOR fold runs in XLA around the
+kernel (a [E]→[G] reduction is bandwidth-trivial next to the state
+read), so the bitwise-pinned fingerprint algebra
+(``ops/digest.lane_fingerprint_arrays``) is shared verbatim.
+
+Ladder (the merge/δ/ingest kernels' contract): off-TPU the kernel runs
+in interpret mode; block shapes the kernel cannot take fall back to
+the XLA pass.  ``tests/test_digest_kernel.py`` pins bitwise equality
+across occupancies, paddings, and the fallback boundary.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from go_crdt_playground_tpu.models.awset_delta import AWSetDeltaState
+from go_crdt_playground_tpu.ops.digest import (DIGEST_GROUP_LANES,
+                                               group_fold,
+                                               lane_fingerprint_arrays)
+from go_crdt_playground_tpu.ops.pallas_merge import _LANE, _round_up
+
+
+def _digest_kernel(blk: int, p_ref, d_ref, dda_ref, ddc_ref, out_ref):
+    """One element block: fingerprint the resident lanes (the
+    convergent projection: present, deletion log, deletion dots —
+    ops/digest.py).  Lane ids are reconstructed from the grid position
+    (block j covers lanes [j*blk, (j+1)*blk)), so padded lanes hash as
+    zero-state lanes at their true ids — exactly the XLA pass's
+    padding semantics."""
+    j = pl.program_id(0)
+    base = (j * blk).astype(jnp.uint32)
+    lane_ids = base + jax.lax.broadcasted_iota(jnp.uint32, (1, blk), 1)
+    out_ref[...] = lane_fingerprint_arrays(
+        lane_ids, p_ref[...], d_ref[...], dda_ref[...], ddc_ref[...])
+
+
+@functools.partial(jax.jit, static_argnames=("block_e", "interpret"))
+def _fused_fingerprints(state: AWSetDeltaState, block_e: int,
+                        interpret: bool) -> jnp.ndarray:
+    num_e = state.present.shape[-1]
+    e_pad = _round_up(num_e, _LANE)
+    blk = min(_round_up(block_e, _LANE), e_pad)
+    while e_pad % blk:
+        blk -= _LANE
+
+    def pad_lane(x):
+        x = x.astype(jnp.uint8) if x.dtype == jnp.bool_ else x
+        return jnp.pad(x[None, :], ((0, 0), (0, e_pad - num_e)))
+
+    ins = [pad_lane(state.present), pad_lane(state.deleted),
+           pad_lane(state.del_dot_actor),
+           pad_lane(state.del_dot_counter)]
+    e_blk = pl.BlockSpec((1, blk), lambda j: (0, j))
+    out = pl.pallas_call(
+        functools.partial(_digest_kernel, blk),
+        grid=(e_pad // blk,),
+        in_specs=[e_blk] * 4,
+        out_specs=e_blk,
+        out_shape=jax.ShapeDtypeStruct((1, e_pad), jnp.uint32),
+        interpret=interpret,
+    )(*ins)
+    return out[0, :num_e]
+
+
+def pallas_lane_fingerprints(state: AWSetDeltaState, *,
+                             block_e: int = 512,
+                             interpret: bool | None = None) -> jnp.ndarray:
+    """Drop-in bitwise twin of ``ops/digest.lane_fingerprints``."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _fused_fingerprints(state, block_e, interpret)
+
+
+def pallas_state_group_digests(state: AWSetDeltaState,
+                               group_size: int = DIGEST_GROUP_LANES, *,
+                               block_e: int = 512,
+                               interpret: bool | None = None
+                               ) -> jnp.ndarray:
+    """Drop-in bitwise twin of ``ops/digest.state_group_digests`` (the
+    ``digest_regime`` TPU arm): Pallas fingerprints + the shared XLA
+    group fold."""
+    return group_fold(
+        pallas_lane_fingerprints(state, block_e=block_e,
+                                 interpret=interpret), group_size)

@@ -200,6 +200,24 @@ class ServeClient:
         return self._request_reply(protocol.MSG_STATS,
                                    protocol.encode_stats)
 
+    # -- fleet-aware GC (router aggregation, DESIGN.md §17) -----------------
+
+    def frontier(self) -> Tuple[np.ndarray, np.ndarray, bool]:
+        """Read the shard's GC evidence: ``(frontier, processed,
+        isolated)`` — its local provable causal-stability vector, its
+        raw applied vv, and whether its membership declaration is the
+        explicit isolated one (the router's lane-mask input)."""
+        return self._request_reply(protocol.MSG_FRONTIER,
+                                   protocol.encode_frontier)
+
+    def gc(self, frontier: np.ndarray) -> Tuple[int, int]:
+        """Push a fleet frontier for the shard to GC against (clamped
+        shard-side to its own provable evidence).  Returns
+        ``(dropped, remaining)`` deletion-record lane counts."""
+        return self._request_reply(
+            protocol.MSG_GC,
+            lambda rid: protocol.encode_gc(rid, frontier))
+
     # -- live resharding (DESIGN.md §18) ------------------------------------
 
     def slice_pull(self, elements: Sequence[int]) -> bytes:
@@ -277,6 +295,18 @@ class ServeClient:
                     req_id, ok, detail = protocol.decode_reshard_reply(body)
                     with self._lock:
                         self._replies[req_id] = (ok, detail)
+                    self._finish(req_id, None, now)
+                elif msg_type == protocol.MSG_FRONTIER_REPLY:
+                    req_id, fr, proc, iso = \
+                        protocol.decode_frontier_reply(body)
+                    with self._lock:
+                        self._replies[req_id] = (fr, proc, iso)
+                    self._finish(req_id, None, now)
+                elif msg_type == protocol.MSG_GC_REPLY:
+                    req_id, dropped, remaining = \
+                        protocol.decode_gc_reply(body)
+                    with self._lock:
+                        self._replies[req_id] = (dropped, remaining)
                     self._finish(req_id, None, now)
                 else:
                     err = framing.ProtocolError(

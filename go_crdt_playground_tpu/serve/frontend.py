@@ -64,7 +64,8 @@ class ServeFrontend:
                  wal_compact_records: bool = True,
                  compact_interval_s: float = 0.0,
                  compact_p99_budget_s: float = 0.25,
-                 gc_participants: Optional[Sequence[int]] = None):
+                 gc_participants: Optional[Sequence[int]] = None,
+                 sync_mode: str = "delta"):
         from go_crdt_playground_tpu.obs import Recorder
 
         self.recorder = recorder if recorder is not None else Recorder()
@@ -96,6 +97,7 @@ class ServeFrontend:
         # owns the durable checkpoint cadence (and attaches a WAL to a
         # fresh non-restored node when durable_dir is set)
         self.supervisor = None
+        self.sync_mode = sync_mode
         if peers or durable_dir is not None:
             from go_crdt_playground_tpu.net.antientropy import SyncSupervisor
 
@@ -103,6 +105,7 @@ class ServeFrontend:
                 self.node, peers, durable_dir=durable_dir,
                 checkpoint_every=checkpoint_every,
                 interval_s=sync_interval_s, wal_fsync=wal_fsync,
+                sync_mode=sync_mode,
                 recorder=self.recorder, seed=seed)
         # SLO-aware background compaction (serve/compaction.py):
         # deletion-record GC + WAL-driven checkpoint rotation, run only
@@ -139,6 +142,12 @@ class ServeFrontend:
             max_frame_body=lambda t: (slice_cap if t in slice_verbs
                                       else ConnHost.MAX_FRAME_BODY))
         self._has_peers = bool(peers)
+        # the GC membership declaration as CONFIGURED; serve() resolves
+        # it (deriving None-vs-() from the peer config when unset) into
+        # _gc_declared, which the compactor AND the fleet-GC verbs
+        # (FRONTIER/GC — the router's evidence channel) share
+        self.gc_participants = gc_participants
+        self._gc_declared = gc_participants
         self._closed = threading.Event()
         # race-ok: serve() owner thread sets it before any reader runs
         self.addr: Optional[Addr] = None
@@ -163,17 +172,19 @@ class ServeFrontend:
                                             or self.supervisor.
                                             checkpoint_every > 0):
             self.supervisor.start()
+        if self._gc_declared is None:
+            # derive the GC membership declaration from the peer
+            # CONFIG (restart-stable, unlike any heard-traffic
+            # heuristic): no peer set and no anti-entropy listener
+            # means this replica IS the deployment (the isolated
+            # declaration, ``()``); any peer surface without an
+            # explicit --gc-participants keeps GC disabled
+            self._gc_declared = (
+                None if (self._has_peers or peer_port is not None)
+                else ())
         if self.compactor is not None:
             if self.compactor.gc_participants is None:
-                # derive the GC membership declaration from the peer
-                # CONFIG (restart-stable, unlike any heard-traffic
-                # heuristic): no peer set and no anti-entropy listener
-                # means this replica IS the deployment (the isolated
-                # declaration, ``()``); any peer surface without an
-                # explicit --gc-participants keeps GC disabled
-                self.compactor.gc_participants = (
-                    None if (self._has_peers or peer_port is not None)
-                    else ())
+                self.compactor.gc_participants = self._gc_declared
             self.compactor.start()
         return self.addr
 
@@ -216,6 +227,12 @@ class ServeFrontend:
             mask = np.zeros(E, bool)
             mask[0] = True
             scratch.apply_payload_body(scratch.extract_slice(mask))
+            if self.sync_mode == "digest":
+                # the supervisor's first digest round must pay a
+                # socket round-trip, not a trace+compile
+                from go_crdt_playground_tpu.net import digestsync
+
+                digestsync.warm(scratch)
             with scratch._lock:
                 scratch.wal.close()
 
@@ -281,6 +298,10 @@ class ServeFrontend:
             return self._handle_slice_pull(session, body)
         if msg_type == protocol.MSG_SLICE_PUSH:
             return self._handle_slice_push(session, body)
+        if msg_type == protocol.MSG_FRONTIER:
+            return self._handle_frontier(session, body)
+        if msg_type == protocol.MSG_GC:
+            return self._handle_gc(session, body)
         session.send(framing.MSG_ERROR,
                      f"unexpected frame type {msg_type}".encode())
         return False
@@ -439,6 +460,79 @@ class ServeFrontend:
             return True
         self._count("serve.slice.pushes")
         session.send(protocol.MSG_ACK, protocol.encode_ack(req_id))
+        return True
+
+    # -- fleet-aware deletion-record GC (router aggregation, §17) -----------
+
+    def _handle_frontier(self, session: Session, body: bytes) -> bool:
+        """Report this shard's GC evidence for the router's fleet
+        aggregation: local provable frontier + raw processed vv +
+        whether the membership declaration is the explicit isolated
+        one (serve/protocol.encode_frontier_reply documents why all
+        three travel together).  A non-v2 or mid-heal shard reports a
+        zero frontier — it can prove nothing stable, and the zeros
+        block fleet GC for every lane it holds state in."""
+        import numpy as np
+
+        try:
+            req_id = protocol.decode_frontier(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return False
+        node = self.node
+        declared = self._gc_declared
+        with node._lock:
+            processed = np.asarray(node._state.processed[0],
+                                   np.uint32).copy()
+        if (node.delta_semantics != "v2"
+                or node.full_resync_is_pending()):
+            frontier = np.zeros(node.num_actors, np.uint32)
+        else:
+            frontier = node.deletion_frontier(declared)
+        isolated = declared is not None and len(tuple(declared)) == 0
+        self._count("serve.fleet_gc.frontier_reads")
+        session.send(protocol.MSG_FRONTIER_REPLY,
+                     protocol.encode_frontier_reply(
+                         req_id, frontier, processed, isolated))
+        return True
+
+    def _handle_gc(self, session: Session, body: bytes) -> bool:
+        """Apply a router-pushed fleet frontier, CLAMPED lane-wise to
+        what this shard can prove locally — conservative on both hops:
+        a buggy or hostile router can never make a shard drop a record
+        its own evidence does not already cover (so an undeclared shard
+        clamps everything to zero and never GCs)."""
+        import numpy as np
+
+        try:
+            req_id, fleet = protocol.decode_gc(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return False
+        node = self.node
+        dropped = 0
+        if (node.delta_semantics == "v2"
+                and not node.full_resync_is_pending()):
+            own = node.deletion_frontier(self._gc_declared)
+            eff = np.zeros(node.num_actors, np.uint32)
+            n = min(own.shape[0], fleet.shape[0])
+            eff[:n] = np.minimum(own[:n], fleet[:n])
+            if eff.any():
+                out = node.gc_deletions(frontier=eff)
+                dropped = out["dropped"]
+                remaining = out["remaining"]
+                self._count("serve.fleet_gc.runs")
+                if dropped:
+                    self._count("serve.fleet_gc.dropped_lanes", dropped)
+            else:
+                with node._lock:
+                    remaining = int(
+                        np.asarray(node._state.deleted[0]).sum())
+        else:
+            with node._lock:
+                remaining = int(np.asarray(node._state.deleted[0]).sum())
+        session.send(protocol.MSG_GC_REPLY,
+                     protocol.encode_gc_reply(req_id, dropped, remaining))
         return True
 
     def _count(self, name: str, n: int = 1) -> None:

@@ -23,6 +23,12 @@ codec; there is no new byte format below the body layouts here.
     SLICE_PULL     varint req_id | varint k | k x varint element_id
     SLICE_STATE    varint req_id | anti-entropy PAYLOAD body (opaque)
     SLICE_PUSH     varint req_id | anti-entropy PAYLOAD body (opaque)
+    FRONTIER       varint req_id
+    FRONTIER_REPLY varint req_id | flags(1: bit0 = isolated decl)
+                   | varint A | A x varint frontier
+                   | A x varint processed
+    GC             varint req_id | varint A | A x varint frontier
+    GC_REPLY       varint req_id | varint dropped | varint remaining
 
 ``deadline_us`` is the client's remaining latency budget in
 MICROSECONDS at send time (0 = none); the server converts it to an
@@ -74,6 +80,20 @@ MSG_RESHARD_REPLY = 24
 MSG_SLICE_PULL = 25
 MSG_SLICE_STATE = 26
 MSG_SLICE_PUSH = 27
+# fleet-aware deletion-record GC (DESIGN.md §16/§17): shards of a
+# sharded fleet never anti-entropy with each other (disjoint
+# keyspaces), so a shard's own ``_peer_processed`` evidence can never
+# cover the fleet — the ROUTER is the evidence channel.  FRONTIER asks
+# a shard for its local provable causal-stability frontier
+# (Node.deletion_frontier under the shard's own declared membership);
+# the router mins the replies into the true FLEET frontier (the
+# collective-min gc_frontier of ops/delta.py, computed over sockets)
+# and pushes it back via GC, which each shard clamps to its own
+# frontier before applying — conservative on both hops.
+MSG_FRONTIER = 28
+MSG_FRONTIER_REPLY = 29
+MSG_GC = 30
+MSG_GC_REPLY = 31
 
 OP_ADD = 0
 OP_DEL = 1
@@ -451,6 +471,137 @@ def encode_slice_push(req_id: int, payload: bytes) -> bytes:
 
 def decode_slice_push(body: bytes) -> Tuple[int, bytes]:
     return _decode_slice_body(body, "SLICE_PUSH")
+
+
+# -- fleet-aware deletion-record GC (router-aggregated frontier) ------------
+
+_FRONTIER_ISOLATED = 0x01
+
+
+def _put_u32_array(out: bytearray, arr: np.ndarray) -> None:
+    arr = np.asarray(arr, np.uint32)
+    for v in arr:
+        wire._put_varint(out, int(v))
+
+
+def _get_u32_array(body: bytes, pos: int, n: int
+                   ) -> Tuple[np.ndarray, int]:
+    if n > len(body) - pos:
+        # every entry costs >= 1 byte, so a count beyond the remaining
+        # body is malformed — checked BEFORE the allocation a huge
+        # varint count would otherwise trigger
+        raise ValueError(f"array count {n} exceeds body")
+    arr = np.zeros(n, np.uint32)
+    for i in range(n):
+        v, pos = wire._get_varint(body, pos)
+        if v > 0xFFFFFFFF:
+            # ValueError, like wire._decode_vv_py: the decoders map it
+            # to ProtocolError -> MSG_ERROR (an unchecked assignment
+            # raises OverflowError, which escapes that contract and
+            # kills the reader thread instead)
+            raise ValueError("counter out of uint32 range")
+        arr[i] = v
+    return arr, pos
+
+
+def encode_frontier(req_id: int) -> bytes:
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    return bytes(out)
+
+
+def decode_frontier(body: bytes) -> int:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos != len(body):
+        raise ProtocolError("trailing bytes after FRONTIER")
+    return req_id
+
+
+def encode_frontier_reply(req_id: int, frontier: np.ndarray,
+                          processed: np.ndarray,
+                          isolated: bool) -> bytes:
+    """The shard's GC evidence, both halves the aggregation needs:
+    ``frontier`` is its local provable causal-stability vector
+    (``Node.deletion_frontier`` under its own declared membership —
+    zeros when undeclared or healing), ``processed`` its raw applied
+    vv (what actor lanes it HOLDS state for), and ``isolated`` whether
+    its declared membership is the explicit empty set — the one case
+    where ``processed[a] == 0`` proves the shard's whole deployment
+    unit holds no lane-``a`` state (with replicas declared, its own vv
+    says nothing about what a replica may hold)."""
+    frontier = np.asarray(frontier, np.uint32)
+    processed = np.asarray(processed, np.uint32)
+    if frontier.shape != processed.shape:
+        raise ValueError("frontier/processed length mismatch")
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    out.append(_FRONTIER_ISOLATED if isolated else 0)
+    wire._put_varint(out, frontier.shape[0])
+    _put_u32_array(out, frontier)
+    _put_u32_array(out, processed)
+    return bytes(out)
+
+
+def decode_frontier_reply(body: bytes
+                          ) -> Tuple[int, np.ndarray, np.ndarray, bool]:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+        if pos >= len(body):
+            raise ProtocolError("truncated FRONTIER_REPLY body")
+        flags = body[pos]
+        pos += 1
+        a, pos = wire._get_varint(body, pos)
+        frontier, pos = _get_u32_array(body, pos, a)
+        processed, pos = _get_u32_array(body, pos, a)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos != len(body):
+        raise ProtocolError("trailing bytes after FRONTIER_REPLY")
+    return req_id, frontier, processed, bool(flags & _FRONTIER_ISOLATED)
+
+
+def encode_gc(req_id: int, frontier: np.ndarray) -> bytes:
+    frontier = np.asarray(frontier, np.uint32)
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    wire._put_varint(out, frontier.shape[0])
+    _put_u32_array(out, frontier)
+    return bytes(out)
+
+
+def decode_gc(body: bytes) -> Tuple[int, np.ndarray]:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+        a, pos = wire._get_varint(body, pos)
+        frontier, pos = _get_u32_array(body, pos, a)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos != len(body):
+        raise ProtocolError("trailing bytes after GC")
+    return req_id, frontier
+
+
+def encode_gc_reply(req_id: int, dropped: int, remaining: int) -> bytes:
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    wire._put_varint(out, max(0, int(dropped)))
+    wire._put_varint(out, max(0, int(remaining)))
+    return bytes(out)
+
+
+def decode_gc_reply(body: bytes) -> Tuple[int, int, int]:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+        dropped, pos = wire._get_varint(body, pos)
+        remaining, pos = wire._get_varint(body, pos)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos != len(body):
+        raise ProtocolError("trailing bytes after GC_REPLY")
+    return req_id, dropped, remaining
 
 
 def decode_members(body: bytes) -> Tuple[int, List[int], np.ndarray]:

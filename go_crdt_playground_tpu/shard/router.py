@@ -311,6 +311,12 @@ class _ShardLink:
     def stats(self) -> dict:
         return self._request("stats")
 
+    def frontier(self) -> Tuple[np.ndarray, np.ndarray, bool]:
+        return self._request("frontier")
+
+    def gc(self, fleet_frontier: np.ndarray) -> Tuple[int, int]:
+        return self._request("gc", fleet_frontier)
+
     def slice_pull(self, elements: Sequence[int]) -> bytes:
         """Handoff donor read (typed ServeError rejects propagate — the
         coordinator decides retry-vs-abort per class)."""
@@ -365,7 +371,8 @@ class ShardRouter:
                  max_conns: Optional[int] = None,
                  state_dir: Optional[str] = None,
                  fence_timeout_s: float = 10.0,
-                 transfer_timeout_s: float = 30.0):
+                 transfer_timeout_s: float = 30.0,
+                 fleet_gc_interval_s: float = 0.0):
         from go_crdt_playground_tpu.obs import Recorder
 
         if not shards:
@@ -422,6 +429,9 @@ class ShardRouter:
         # can dial dead shards for seconds without wedging a handoff
         self._op_epoch = 0  # guarded-by: _lock
         self._inflight_by_epoch: Dict[int, int] = {}  # guarded-by: _lock
+        self._fleet_gc_interval_s = float(fleet_gc_interval_s)
+        # race-ok: serve() owner thread only
+        self._fleet_gc_thread: Optional[threading.Thread] = None
         self._closed = threading.Event()
         self.host = ConnHost(self._dispatch, recorder=self.recorder,
                              counter_prefix="router", thread_name="router",
@@ -547,7 +557,21 @@ class ShardRouter:
     # -- lifecycle ----------------------------------------------------------
 
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
-        return self.host.listen(host, port)
+        addr = self.host.listen(host, port)
+        if self._fleet_gc_interval_s > 0:
+            self._fleet_gc_thread = threading.Thread(
+                target=self._fleet_gc_loop, name="router-fleet-gc",
+                daemon=True)
+            self._fleet_gc_thread.start()
+        return addr
+
+    def _fleet_gc_loop(self) -> None:
+        while not self._closed.wait(self._fleet_gc_interval_s):
+            try:
+                self.run_fleet_gc()
+            except Exception:  # noqa: BLE001 — maintenance must never
+                # take the router down; the next wake retries
+                self._count("router.fleet_gc.errors")
 
     def close(self) -> None:
         if self._closed.is_set():
@@ -566,6 +590,8 @@ class ShardRouter:
         # one SHARED flush window across all sessions (the frontend's
         # drain shape): stalled clients cost ~1s total, not each
         self.host.close_sessions(flush_timeout_s=1.0)
+        if self._fleet_gc_thread is not None:
+            self._fleet_gc_thread.join(timeout=5.0)
 
     def __enter__(self) -> "ShardRouter":
         return self
@@ -694,8 +720,8 @@ class ShardRouter:
 
     # -- fan-out reads ------------------------------------------------------
 
-    def _fan_out(self, call: str) -> Dict[str, object]:
-        """Run ``link.<call>()`` on every shard concurrently; returns
+    def _fan_out(self, call: str, *args) -> Dict[str, object]:
+        """Run ``link.<call>(*args)`` on every shard concurrently; returns
         sid -> result or the _Unreachable error.  Thread-per-shard per
         request is a deliberate control-plane tradeoff: QUERY/STATS are
         orders of magnitude rarer than OPs, and the alternative (async
@@ -714,7 +740,7 @@ class ShardRouter:
 
         def one(sid: str, link: _ShardLink) -> None:
             try:
-                r = getattr(link, call)()
+                r = getattr(link, call)(*args)
             except _Unreachable as e:
                 r = e
             except Exception as e:  # noqa: BLE001 — any escape still
@@ -820,6 +846,68 @@ class ShardRouter:
                      # generation + owner-map digest (the soak asserts
                      # a failed handoff left these untouched)
                      "ring": self.route().info()}))
+
+    # -- fleet-aware deletion-record GC (ROADMAP item c, DESIGN.md §17) -----
+
+    def run_fleet_gc(self) -> dict:
+        """One fleet GC round: collect every shard's GC evidence
+        (FRONTIER), aggregate the true FLEET frontier, push it back
+        (GC) for each shard to apply clamped to its own proof.
+
+        Aggregation is a lane-wise MIN with one exclusion: a shard
+        whose declared membership is the explicit isolated set AND
+        whose applied vv is zero for lane ``a`` provably holds no
+        lane-``a`` state anywhere in its deployment unit, so it is no
+        constraint on lane ``a`` (without the exclusion, disjoint
+        keyspaces would pin every foreign lane to zero forever and
+        fleet GC would never drop anything).  A shard WITH declared
+        replicas is always included — its own vv says nothing about
+        what a replica may hold via transitive gossip, and a future
+        reshard can hand that replica's cluster any element.  An
+        UNREACHABLE shard blocks the whole round (its evidence is
+        unknown, and unknown must read as zero everywhere).
+
+        Returns the round's accounting; the periodic driver and the
+        fleet soak read the same dict."""
+        results = self._fan_out("frontier")
+        evidence = []
+        for sid, r in sorted(results.items()):
+            if isinstance(r, _Unreachable):
+                self._count("router.fleet_gc.partial")
+                return {"pushed": False,
+                        "reason": f"shard {sid} unreachable"}
+            evidence.append(r)
+        a_max = max(f.shape[0] for f, _, _ in evidence)
+        fleet = np.zeros(a_max, np.uint32)
+        for lane in range(a_max):
+            lanes = [int(f[lane]) if lane < f.shape[0] else 0
+                     for f, proc, isolated in evidence
+                     if not (isolated
+                             and (lane >= proc.shape[0]
+                                  or proc[lane] == 0))]
+            if lanes:
+                fleet[lane] = min(lanes)
+        if not fleet.any():
+            self._count("router.fleet_gc.noop")
+            return {"pushed": False, "reason": "all-zeros fleet frontier",
+                    "frontier": fleet}
+        pushes = self._fan_out("gc", fleet)
+        dropped = 0
+        unreachable = 0
+        for sid, r in pushes.items():
+            if isinstance(r, _Unreachable):
+                # GC is local compaction: a shard that missed this push
+                # just keeps its records until a later round
+                unreachable += 1
+                continue
+            dropped += int(r[0])
+        self._count("router.fleet_gc.runs")
+        if dropped:
+            self._count("router.fleet_gc.dropped_lanes", dropped)
+        if unreachable:
+            self._count("router.fleet_gc.push_misses", unreachable)
+        return {"pushed": True, "frontier": fleet, "dropped": dropped,
+                "push_misses": unreachable}
 
     # -- the admin verb -----------------------------------------------------
 

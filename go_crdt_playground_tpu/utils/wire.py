@@ -372,3 +372,70 @@ def decode_compact_wal_body(body: bytes, num_elements: int,
         src_actor=jnp.uint32(src_actor),
         src_processed=jnp.asarray(processed),
     )
+
+
+# ---------------------------------------------------------------------------
+# Index-lane payload bodies (digest-driven anti-entropy, DESIGN.md §19)
+# ---------------------------------------------------------------------------
+#
+# A digest-sync round ships only the lanes of digest-MISMATCHED groups
+# (net/digestsync.py).  The dense payload encoding above always pays two
+# E/8-byte section bitmasks — exactly the O(E) floor the digest exchange
+# exists to beat — so MODE_DIGEST payload bodies use the index-lane form
+# the compact WAL records pioneered: O(claimed lanes) bytes, with the
+# writer's universe embedded and checked so a mis-dimensioned peer fails
+# decode instead of scattering in-range lane ids onto wrong lanes.
+
+
+def encode_payload_lanes(p: DeltaPayload, num_elements: int) -> bytes:
+    """Index-lane wire form of a sparse payload: ``varint E |
+    vv-section(src_vv) | changed lane-section | deleted lane-section``
+    (lane sections as in the compact WAL body: ``varint n, n x (varint
+    element, varint dot_actor, varint dot_counter)``).  ``src_processed``
+    and ``src_actor`` ride out-of-band like encode_payload's."""
+    changed = np.asarray(p.changed, bool)
+    deleted = np.asarray(p.deleted, bool)
+    out = bytearray()
+    _put_varint(out, num_elements)
+    body = bytes(out) + _encode_vv_py(np.asarray(p.src_vv, np.uint32))
+    tail = bytearray()
+    ch = np.nonzero(changed)[0]
+    _put_lane_section(tail, ch, np.asarray(p.ch_da)[ch],
+                      np.asarray(p.ch_dc)[ch])
+    dl = np.nonzero(deleted)[0]
+    _put_lane_section(tail, dl, np.asarray(p.del_da)[dl],
+                      np.asarray(p.del_dc)[dl])
+    return body + bytes(tail)
+
+
+def decode_payload_lanes(buf: bytes, num_elements: int, num_actors: int,
+                         src_actor: int = 0) -> DeltaPayload:
+    """Inverse of encode_payload_lanes: lane sections scattered back to
+    the dense device form.  Raises ``ValueError`` on any structural
+    problem (dimension change, trailing bytes) — callers map it to their
+    dialect's protocol error like decode_payload's."""
+    enc_e, pos = _get_varint(buf, 0)
+    if enc_e != num_elements:
+        raise ValueError(f"universe mismatch: encoded {enc_e}, "
+                         f"expected {num_elements}")
+    src_vv, pos = _decode_vv_py(buf, pos, num_actors)
+    changed, ch_da, ch_dc, pos = _get_lane_section(buf, pos,
+                                                   num_elements)
+    deleted, del_da, del_dc, pos = _get_lane_section(buf, pos,
+                                                     num_elements)
+    if pos != len(buf):
+        raise ValueError(f"{len(buf) - pos} trailing bytes after lane "
+                         "payload")
+    import jax.numpy as jnp
+
+    return DeltaPayload(
+        src_vv=jnp.asarray(src_vv),
+        changed=jnp.asarray(changed),
+        ch_da=jnp.asarray(ch_da),
+        ch_dc=jnp.asarray(ch_dc),
+        deleted=jnp.asarray(deleted),
+        del_da=jnp.asarray(del_da),
+        del_dc=jnp.asarray(del_dc),
+        src_actor=jnp.uint32(src_actor),
+        src_processed=jnp.zeros(num_actors, jnp.uint32),
+    )

@@ -731,6 +731,10 @@ def test_gate_fast(tmp_path):
     # ladder ISSUE): its scheduling state crosses the loop thread and
     # the frontend's lifecycle thread
     assert "CompactionScheduler" in covered, covered
+    # ... and the digest-sync tier (the digest anti-entropy ISSUE):
+    # the per-peer negotiation cache crosses the supervisor's round
+    # thread and any caller marking a peer legacy
+    assert "DigestNegotiator" in covered, covered
 
 
 def test_report_shape_roundtrips(tmp_path):

@@ -197,6 +197,124 @@ def test_checkpoint_rotation_waits_for_wal_growth(tmp_path):
         node.wal.close()
 
 
+def test_fleet_gc_protocol_roundtrip():
+    """The FRONTIER/GC wire codecs: self-describing, trailing-byte
+    strict, flags preserved."""
+    from go_crdt_playground_tpu.serve import protocol
+
+    fr = np.asarray([5, 0, 9], np.uint32)
+    proc = np.asarray([7, 1, 9], np.uint32)
+    body = protocol.encode_frontier_reply(3, fr, proc, True)
+    rid, f2, p2, iso = protocol.decode_frontier_reply(body)
+    assert (rid, iso) == (3, True)
+    assert np.array_equal(f2, fr) and np.array_equal(p2, proc)
+    body = protocol.encode_frontier_reply(4, fr, proc, False)
+    assert protocol.decode_frontier_reply(body)[3] is False
+    rid, f3 = protocol.decode_gc(protocol.encode_gc(9, fr))
+    assert rid == 9 and np.array_equal(f3, fr)
+    assert protocol.decode_gc_reply(
+        protocol.encode_gc_reply(1, 2, 3)) == (1, 2, 3)
+    with pytest.raises(Exception):
+        protocol.decode_gc(protocol.encode_gc(9, fr) + b"x")
+
+
+def test_fleet_gc_router_aggregates_true_minimum(tmp_path):
+    """ROADMAP item c pin: the router aggregates per-shard
+    ``deletion_frontier()``s into the TRUE fleet minimum.
+
+    Three phases against a 2-shard in-process fleet (isolated GC
+    declarations — no anti-entropy peers):
+
+    1. static fleet: a shard that provably holds NO lane-a state
+       (isolated + zero applied vv for the lane) is no constraint on
+       lane a, so fleet GC drops exactly what per-shard isolated GC
+       would — the lane mask that keeps disjoint keyspaces from
+       pinning every foreign lane to zero forever;
+    2. cross-shard state: once s1 holds actor-0-dotted state at an OLD
+       clock (a moved slice / relayed payload), s0's newer deletion
+       records must SURVIVE fleet GC — even though s0's own isolated
+       evidence covers them (the per-node-evidence wrongness this
+       subsystem exists to prevent);
+    3. s1 catches up past the record clocks: the fleet minimum now
+       covers them and the records drop.
+
+    Plus: an unreachable shard blocks the whole round (unknown
+    evidence must read as zero everywhere)."""
+    import jax
+
+    from go_crdt_playground_tpu.net import framing as fr
+    from go_crdt_playground_tpu.ops import delta as delta_ops
+    from go_crdt_playground_tpu.serve.client import ServeClient
+    from go_crdt_playground_tpu.serve.frontend import ServeFrontend
+    from go_crdt_playground_tpu.shard.router import ShardRouter
+
+    fes = [ServeFrontend(E, A, actor=i, durable_dir=str(tmp_path / f"s{i}"),
+                         max_batch=8, flush_ms=1.0)
+           for i in range(2)]
+    addrs = {f"s{i}": fe.serve() for i, fe in enumerate(fes)}
+    router = ShardRouter(addrs, E, seed=5)
+    addr = router.serve()
+    try:
+        owned0 = [e for e in range(E)
+                  if router.ring.shards[router._owner[e]] == "s0"]
+        assert len(owned0) >= 4
+        with ServeClient(addr) as c:
+            c.add(*owned0[:4])
+            c.delete(owned0[0], owned0[1])
+            # phase 1: s1 has zero lane-0 vv -> excluded from lane 0's
+            # min -> fleet GC == isolated GC for s0's records
+            out = router.run_fleet_gc()
+            assert out["pushed"] is True and out["dropped"] == 2
+
+            # phase 2: s1 applies an actor-0 payload at clock 1 (the
+            # stale cross-shard copy); s0 deletes at a NEWER clock
+            scratch = Node(0, E, A)
+            scratch.add(owned0[2])
+            srow = jax.tree.map(lambda x: x[0], scratch._state)
+            payload = delta_ops.delta_extract(
+                srow, np.zeros(A, np.uint32))
+            fes[1].node.apply_payload_body(fr.encode_payload_msg(
+                fr.MODE_DELTA, 0, np.asarray(srow.processed), payload))
+            assert int(np.asarray(
+                fes[1].node._state.processed[0])[0]) == 1
+            c.delete(owned0[2], owned0[3])
+            out = router.run_fleet_gc()
+            assert out["pushed"] is True and out["dropped"] == 0
+            # ... while s0's OWN isolated evidence covers the records
+            # (per-shard GC would have dropped them wrongly)
+            assert fes[0].node.deletion_frontier(())[0] > 1
+            with fes[0].node._lock:
+                assert int(np.asarray(
+                    fes[0].node._state.deleted[0]).sum()) == 2
+
+            # phase 3: s1 catches up past the record clocks
+            while int(np.asarray(scratch._state.processed[0])[0]) < 32:
+                scratch.add(owned0[2])
+                scratch.delete(owned0[2])
+            srow = jax.tree.map(lambda x: x[0], scratch._state)
+            payload = delta_ops.delta_extract(
+                srow, np.zeros(A, np.uint32))
+            fes[1].node.apply_payload_body(fr.encode_payload_msg(
+                fr.MODE_DELTA, 0, np.asarray(srow.processed), payload))
+            out = router.run_fleet_gc()
+            assert out["pushed"] is True and out["dropped"] == 2
+            with fes[0].node._lock:
+                assert int(np.asarray(
+                    fes[0].node._state.deleted[0]).sum()) == 0
+
+        # an unreachable shard's evidence is unknown: no round
+        fes[1].close()
+        out = router.run_fleet_gc()
+        assert out["pushed"] is False and "unreachable" in out["reason"]
+        snap = router.recorder.snapshot()
+        assert snap["counters"]["router.fleet_gc.partial"] == 1
+        assert snap["counters"]["router.fleet_gc.runs"] == 3
+    finally:
+        router.close()
+        for fe in fes:
+            fe.close()
+
+
 def test_frontend_integration_compacts_under_idle(tmp_path):
     """End to end: a frontend with compaction enabled GCs deletion
     lanes while idle and keeps serving; the counters surface in the

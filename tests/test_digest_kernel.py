@@ -1,0 +1,232 @@
+"""Digest-kernel laws (ops/digest.py + ops/pallas_digest.py).
+
+The digest-sync protocol (net/digestsync.py, DESIGN.md §19) leans on
+exactly these properties, so each is pinned:
+
+* soundness — a group-digest mismatch PROVES a lane in the group
+  differs (equal lanes always fingerprint equal, deterministically);
+* padding stability — the ragged last group digests identically
+  however the kernel pads the lane axis (XLA group-multiple padding
+  vs Pallas 128-lane blocks), so two replicas always compare like
+  with like;
+* collision behavior — the documented 2^-32-per-group bound is
+  probabilistic, but single-lane perturbations must never collide in
+  any direct sweep (an avalanche sanity floor, not a proof);
+* Pallas-vs-XLA bitwise identity across occupancies and shapes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_crdt_playground_tpu.models import awset_delta
+from go_crdt_playground_tpu.ops import digest as dg
+from go_crdt_playground_tpu.ops.pallas_digest import (
+    pallas_lane_fingerprints, pallas_state_group_digests)
+
+A = 4
+
+
+def _slice(state, r=0):
+    return jax.tree.map(lambda x: x[r], state)
+
+
+def _random_state(e, seed, occupancy=0.5, deletions=0.3):
+    """One seeded single-replica slice with live entries, deletion
+    records, and re-adds (the delta_apply-reachable field shapes)."""
+    rng = np.random.default_rng(seed)
+    st = awset_delta.init(1, e, A)
+    row = _slice(st)
+    present = rng.random(e) < occupancy
+    da = rng.integers(0, A, e).astype(np.uint32)
+    dc = rng.integers(1, 50, e).astype(np.uint32)
+    deleted = rng.random(e) < deletions
+    dda = rng.integers(0, A, e).astype(np.uint32)
+    ddc = rng.integers(1, 50, e).astype(np.uint32)
+    vv = rng.integers(50, 100, A).astype(np.uint32)
+    return row._replace(
+        vv=jnp.asarray(vv),
+        present=jnp.asarray(present),
+        dot_actor=jnp.asarray(np.where(present, da, 0)),
+        dot_counter=jnp.asarray(np.where(present, dc, 0)),
+        deleted=jnp.asarray(deleted),
+        del_dot_actor=jnp.asarray(np.where(deleted, dda, 0)),
+        del_dot_counter=jnp.asarray(np.where(deleted, ddc, 0)),
+        processed=jnp.asarray(vv))
+
+
+def test_equal_lanes_equal_fingerprints_deterministic():
+    s = _random_state(96, seed=1)
+    f1 = np.asarray(dg.lane_fingerprints(s))
+    f2 = np.asarray(dg.lane_fingerprints(s))
+    np.testing.assert_array_equal(f1, f2)
+    # a state rebuilt from the same arrays (fresh device buffers)
+    # fingerprints identically: content, not identity
+    s2 = jax.tree.map(lambda x: jnp.asarray(np.asarray(x)), s)
+    np.testing.assert_array_equal(
+        f1, np.asarray(dg.lane_fingerprints(s2)))
+
+
+def test_mismatch_implies_lane_differs_soundness():
+    """digest(a ⊔ b) vs digest(a): every group whose digest CHANGED
+    must contain a lane that actually changed — the soundness pin the
+    protocol ships lanes by."""
+    from go_crdt_playground_tpu.ops import delta as delta_ops
+
+    a = _random_state(256, seed=2)
+    b = _random_state(256, seed=3)
+    payload = delta_ops.delta_extract(b, a.vv)
+    merged = delta_ops.delta_apply(a, payload, "v2")
+    gs = 64
+    d_a = np.asarray(dg.state_group_digests(a, gs))
+    d_m = np.asarray(dg.state_group_digests(merged, gs))
+    # the digest covers the CONVERGENT projection (live dots are
+    # divergent by design and excluded — ops/digest.py docstring)
+    changed_lane = np.zeros(256, bool)
+    for name in ("present", "deleted", "del_dot_actor",
+                 "del_dot_counter"):
+        changed_lane |= (np.asarray(getattr(a, name))
+                        != np.asarray(getattr(merged, name)))
+    assert (d_a != d_m).any(), "the merge changed nothing — bad fixture"
+    for g in np.nonzero(d_a != d_m)[0]:
+        assert changed_lane[g * gs:(g + 1) * gs].any(), (
+            f"group {g} digest mismatch without a differing lane")
+    # and the contrapositive direction on this instance: groups with
+    # NO differing lane digest equal (deterministic, not probabilistic)
+    for g in np.nonzero(~(d_a != d_m))[0]:
+        assert not changed_lane[g * gs:(g + 1) * gs].any()
+
+
+def test_ragged_group_padding_stability():
+    """E not a multiple of the group size: the ragged last group's
+    digest depends only on the real lanes (zero-lane padding at true
+    lane ids), so it is stable across every computation path."""
+    e = 100  # 2 groups of 64: the second has 36 real + 28 pad lanes
+    s = _random_state(e, seed=4)
+    d1 = np.asarray(dg.state_group_digests(s, 64))
+    assert d1.shape == (2,)
+    d2 = np.asarray(dg.group_fold(dg.lane_fingerprints(s), 64))
+    np.testing.assert_array_equal(d1, d2)
+    d3 = np.asarray(pallas_state_group_digests(s, 64))
+    np.testing.assert_array_equal(d1, d3)
+    # mutating a PAD-ADJACENT real lane moves the last group's digest;
+    # the first group never moves
+    s2 = s._replace(present=s.present.at[99].set(~s.present[99]))
+    d4 = np.asarray(dg.state_group_digests(s2, 64))
+    assert d4[1] != d1[1] and d4[0] == d1[0]
+
+
+def test_live_dot_divergence_is_digest_invisible():
+    """The projection pin: two replicas differing ONLY in a present
+    lane's live dot (the reference both-present overwrite leaves
+    exactly this divergence after concurrent adds) digest EQUAL —
+    the regime must go quiescent on observably-converged fleets
+    instead of re-shipping dot-divergent lanes forever."""
+    s = _random_state(128, seed=7)
+    swapped = s._replace(
+        dot_actor=jnp.where(s.present, (s.dot_actor + 1) % A,
+                            s.dot_actor),
+        dot_counter=jnp.where(s.present, s.dot_counter + 5,
+                              s.dot_counter))
+    np.testing.assert_array_equal(
+        np.asarray(dg.state_group_digests(s, 64)),
+        np.asarray(dg.state_group_digests(swapped, 64)))
+
+
+def test_lane_id_folded_in():
+    """Two lanes with IDENTICAL content fingerprint differently (lane
+    id is folded in), so a content swap between lanes is visible and
+    the group XOR fold cannot cancel equal-content lanes."""
+    e = 8
+    st = awset_delta.init(1, e, A)
+    row = _slice(st)
+    same = row._replace(
+        present=jnp.ones(e, bool),
+        dot_actor=jnp.full(e, 1, jnp.uint32),
+        dot_counter=jnp.full(e, 7, jnp.uint32))
+    fp = np.asarray(dg.lane_fingerprints(same))
+    assert len(set(fp.tolist())) == e
+
+
+def test_single_lane_perturbations_never_collide_in_sweep():
+    """Avalanche floor under the documented 2^-32 bound: for one base
+    state, every single-field single-lane perturbation produces a
+    distinct group digest (2k+ trials — a weak mix would collide
+    here long before the bound says it may)."""
+    e = 64
+    s = _random_state(e, seed=5)
+    base = int(np.asarray(dg.state_group_digests(s, 64))[0])
+    seen = {base}
+    for lane in range(0, e, 2):
+        for field, delta in (("del_dot_counter", 1),
+                             ("del_dot_counter", 1000),
+                             ("del_dot_counter", 3),
+                             ("del_dot_actor", 1)):
+            arr = getattr(s, field)
+            mutated = s._replace(
+                **{field: arr.at[lane].set(arr[lane] + delta)})
+            d = int(np.asarray(dg.state_group_digests(mutated, 64))[0])
+            assert d != base
+            seen.add(d)
+    # distinct perturbations are also pairwise distinct in this sweep
+    assert len(seen) == 1 + (e // 2) * 4
+
+
+@pytest.mark.parametrize("e", [48, 64, 200, 512])
+def test_pallas_bitwise_pin_across_shapes(e):
+    s = _random_state(e, seed=6 + e)
+    np.testing.assert_array_equal(
+        np.asarray(dg.lane_fingerprints(s)),
+        np.asarray(pallas_lane_fingerprints(s)))
+    np.testing.assert_array_equal(
+        np.asarray(dg.state_group_digests(s, 64)),
+        np.asarray(pallas_state_group_digests(s, 64)))
+
+
+def test_pallas_bitwise_pin_across_occupancy_extremes():
+    for occ, dels in ((0.0, 0.0), (1.0, 0.0), (0.0, 1.0), (1.0, 1.0)):
+        s = _random_state(128, seed=9, occupancy=occ, deletions=dels)
+        np.testing.assert_array_equal(
+            np.asarray(dg.lane_fingerprints(s)),
+            np.asarray(pallas_lane_fingerprints(s)))
+
+
+def test_digest_diff_payload_extracts_only_mismatched_groups():
+    """The on-device mismatching-lane extraction: lanes in digest-
+    matched groups never appear; every shipped lane sits in a
+    mismatched group; a self-comparison ships nothing."""
+    e, gs = 256, 64
+    a = _random_state(e, seed=10)
+    b = a._replace(  # perturb exactly one lane in group 1 (the
+        # projection the digest covers: membership + deletion log)
+        present=a.present.at[70].set(~a.present[70]),
+        deleted=a.deleted.at[70].set(True),
+        del_dot_actor=a.del_dot_actor.at[70].set(2),
+        del_dot_counter=a.del_dot_counter.at[70].set(99))
+    d_a = dg.state_group_digests(a, gs)
+    d_b = dg.state_group_digests(b, gs)
+    p = dg.digest_diff_payload(a, d_a, d_b, gs)
+    ch = np.nonzero(np.asarray(p.changed))[0]
+    dl = np.nonzero(np.asarray(p.deleted))[0]
+    assert len(ch) or len(dl)
+    for lane in np.concatenate([ch, dl]):
+        assert 64 <= lane < 128, f"lane {lane} outside mismatched group"
+    # self-comparison: zero lanes (the quiescent round's zero-state-
+    # lanes guarantee is this property plus the wire layer)
+    p0 = dg.digest_diff_payload(a, d_a, d_a, gs)
+    assert not np.asarray(p0.changed).any()
+    assert not np.asarray(p0.deleted).any()
+    # the full vv rides the payload (digest-matched withholding is
+    # clock-safe — ops/digest.py docstring)
+    np.testing.assert_array_equal(np.asarray(p.src_vv),
+                                  np.asarray(a.vv))
+
+
+def test_digest_regime_dispatch():
+    fn = dg.digest_regime(128)
+    s = _random_state(128, seed=11)
+    expected = (pallas_state_group_digests if jax.default_backend()
+                == "tpu" else dg.state_group_digests)
+    np.testing.assert_array_equal(np.asarray(fn(s, 64)),
+                                  np.asarray(expected(s, 64)))

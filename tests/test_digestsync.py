@@ -1,0 +1,354 @@
+"""Digest-driven anti-entropy protocol tier (net/digestsync.py).
+
+The wire-level pins of DESIGN.md §19: a QUIESCENT pair exchanges
+digests + vv and zero state lanes; a DIVERGENT pair ships only the
+lanes of mismatched digest groups; vv-divergence-without-digest-
+mismatch falls back to the δ ladder (the collision healing rung);
+legacy peers negotiate down to FULL/DELTA; digest-applied payloads are
+WAL-logged and replay; and the supervisor regime converges a fleet.
+"""
+
+import numpy as np
+import pytest
+
+from go_crdt_playground_tpu.net import digestsync, framing
+from go_crdt_playground_tpu.net.digestsync import (DigestNegotiator,
+                                                   DigestUnsupported,
+                                                   sync_digest)
+from go_crdt_playground_tpu.net.framing import (MODE_DELTA, MODE_DIGEST,
+                                                MODE_FULL)
+from go_crdt_playground_tpu.net.peer import Node
+from go_crdt_playground_tpu.obs import Recorder
+
+E, A = 256, 4  # 4 digest groups of 64
+
+
+def _pair(recorders=False, e=E):
+    recs = [Recorder(), Recorder()] if recorders else [None, None]
+    a = Node(0, e, A, recorder=recs[0])
+    b = Node(1, e, A, recorder=recs[1])
+    return a, b, recs
+
+
+def _converge(a, b, addr):
+    """Digest rounds until fixpoint (bounded)."""
+    for _ in range(4):
+        st = sync_digest(a, addr)
+        if st.quiescent:
+            return
+    raise AssertionError("pair failed to reach a quiescent round")
+
+
+def test_summary_codec_roundtrip():
+    vv = np.asarray([3, 0, 9, 1], np.uint32)
+    proc = np.asarray([2, 0, 9, 1], np.uint32)
+    digs = np.arange(4, dtype=np.uint32) * 0x1234567
+    body = digestsync.encode_summary(2, E, 64, vv, proc, digs)
+    actor, gs, vv2, proc2, digs2 = digestsync.decode_summary(body, E, A)
+    assert (actor, gs) == (2, 64)
+    np.testing.assert_array_equal(vv, vv2)
+    np.testing.assert_array_equal(proc, proc2)
+    np.testing.assert_array_equal(digs, digs2)
+    with pytest.raises(framing.ProtocolError, match="universe"):
+        digestsync.decode_summary(body, E + 1, A)
+    with pytest.raises(framing.ProtocolError):
+        digestsync.decode_summary(body[:-2], E, A)  # truncated digests
+
+
+def test_digest_payload_mode_roundtrip():
+    """MODE_DIGEST payload bodies carry the index-lane form and decode
+    through the same decode_payload_msg as every other mode."""
+    import jax
+
+    a, _, _ = _pair()
+    a.add(3, 70, 200)
+    a.delete(70)
+    me = jax.tree.map(lambda x: x[0], a._state)
+    from go_crdt_playground_tpu.ops import delta as delta_ops
+    import jax.numpy as jnp
+
+    p = delta_ops.delta_extract(me, jnp.zeros(A, jnp.uint32))
+    body = framing.encode_payload_msg(MODE_DIGEST, 0,
+                                      np.asarray(me.processed), p)
+    mode, p2 = framing.decode_payload_msg(body, E, A)
+    assert mode == MODE_DIGEST
+    np.testing.assert_array_equal(np.asarray(p.changed),
+                                  np.asarray(p2.changed))
+    np.testing.assert_array_equal(np.asarray(p.ch_dc),
+                                  np.asarray(p2.ch_dc))
+    np.testing.assert_array_equal(np.asarray(p.deleted),
+                                  np.asarray(p2.deleted))
+    # the index form is O(diff): 3 touched lanes cost far less than
+    # the dense form's two E/8 bitmasks
+    dense = framing.encode_payload_msg(MODE_DELTA, 0,
+                                       np.asarray(me.processed), p)
+    assert len(body) < len(dense) - 2 * (E // 8) + 16
+
+
+def test_divergent_pair_ships_only_mismatched_lanes():
+    a, b, recs = _pair(recorders=True)
+    a.add(*range(0, 8))        # group 0
+    b.add(*range(64, 70))      # group 1
+    addr = b.serve()
+    try:
+        st = sync_digest(a, addr)
+    finally:
+        b.close()
+    assert st.mode_sent == MODE_DIGEST
+    assert st.groups_mismatched == 2       # groups 0 and 1 differ
+    assert st.lanes_sent == 8              # only a's group-0/1 lanes
+    assert sorted(a.members().tolist()) == list(range(8)) + \
+        list(range(64, 70))
+    assert sorted(b.members().tolist()) == sorted(a.members().tolist())
+    np.testing.assert_array_equal(a.vv(), b.vv())
+    # groups 2..3 were equal: nothing from them crossed the wire —
+    # the server shipped only ITS mismatched lanes too
+    assert recs[1].counter("digest.lanes_sent") == 6
+
+
+def test_quiescent_pair_ships_zero_state_lanes():
+    a, b, recs = _pair(recorders=True)
+    a.add(1, 2, 100)
+    a.delete(2)
+    addr = b.serve()
+    try:
+        _converge(a, b, addr)
+        base_bytes = (recs[0].counter("digest.bytes_sent")
+                      + recs[1].counter("digest.bytes_sent"))
+        lanes_before = (recs[0].counter("digest.lanes_sent")
+                        + recs[1].counter("digest.lanes_sent"))
+        for _ in range(5):
+            st = sync_digest(a, addr)
+            assert st.quiescent and st.lanes_sent == 0
+            assert st.mode_sent == MODE_DIGEST
+        lanes_after = (recs[0].counter("digest.lanes_sent")
+                       + recs[1].counter("digest.lanes_sent"))
+        assert lanes_after == lanes_before  # ZERO state lanes shipped
+        assert recs[0].counter("digest.quiescent") >= 5
+        # bytes/quiescent round ≈ digest + vv only: 2 summaries
+        # (G*4 digest bytes + 2 vv sections each) + 2 near-empty lane
+        # payloads — far below one dense δ round's 4 E/8 bitmasks
+        per_round = (recs[0].counter("digest.bytes_sent")
+                     + recs[1].counter("digest.bytes_sent")
+                     - base_bytes) / 5
+        assert per_round < 4 * (E // 8)
+    finally:
+        b.close()
+
+
+def test_deletion_heavy_quiescence_beats_delta_ladder():
+    """The δ ladder re-ships the whole un-resurrected deletion log
+    every round (reference wire semantics); a converged digest pair
+    ships none of it — the sync-bandwidth wall the regime exists to
+    break."""
+    a, b, recs = _pair(recorders=True)
+    a.add(*range(32))
+    a.delete(*range(16))
+    addr = b.serve()
+    try:
+        _converge(a, b, addr)
+        r0 = (recs[0].counter("digest.bytes_sent")
+              + recs[1].counter("digest.bytes_sent"))
+        st = sync_digest(a, addr)
+        digest_round = (recs[0].counter("digest.bytes_sent")
+                        + recs[1].counter("digest.bytes_sent") - r0)
+        assert st.quiescent
+        # the same converged pair over the legacy ladder:
+        s0 = (recs[0].counter("sync.bytes_sent")
+              + recs[1].counter("sync.bytes_sent"))
+        a.sync_with(addr)
+        delta_round = (recs[0].counter("sync.bytes_sent")
+                       + recs[1].counter("sync.bytes_sent") - s0)
+        assert digest_round < delta_round
+    finally:
+        b.close()
+
+
+def test_vv_only_divergence_falls_back_to_delta():
+    """Same lanes, different clocks (an empty-effect op): the digests
+    agree, the vvs do not — the round must ride the δ ladder (the
+    collision-healing rung) and JOIN the clocks."""
+    a, b, _ = _pair(recorders=False)
+    a.add(1)
+    addr = b.serve()
+    try:
+        _converge(a, b, addr)
+        # a delete of an ABSENT element ticks a's clock but touches no
+        # lane (del_elements: unconditional tick, empty hit mask) —
+        # lanes stay identical while the vvs diverge
+        a.delete(200)
+        rec = Recorder()
+        a.recorder = rec
+        st = sync_digest(a, addr)
+        assert st.mode_sent in (MODE_DELTA, MODE_FULL)
+        assert rec.counter("digest.fallback_delta") == 1
+        np.testing.assert_array_equal(a.vv(), b.vv())
+        st2 = sync_digest(a, addr)
+        assert st2.quiescent
+    finally:
+        b.close()
+
+
+def test_legacy_peer_negotiates_down():
+    """A server that only speaks the HELLO ladder answers MSG_DIGEST
+    with "expected HELLO" — surfaced as DigestUnsupported, and the
+    supervisor-side negotiator pins the peer legacy."""
+    a, b, _ = _pair()
+
+    # simulate a pre-digest peer: serve connections through the OLD
+    # dispatch (no MSG_DIGEST branch) by monkeypatching the handler
+    import types
+
+    from go_crdt_playground_tpu.net.framing import (MSG_HELLO,
+                                                    MSG_PAYLOAD)
+
+    def legacy_serve_conn(self, conn):
+        try:
+            with conn:
+                conn.settimeout(self.conn_timeout_s)
+                msg_type, body = framing.recv_frame(
+                    conn, timeout=self.hello_timeout_s)
+                if msg_type != MSG_HELLO:
+                    framing.send_frame(
+                        conn, framing.MSG_ERROR,
+                        f"expected HELLO, got {msg_type}".encode())
+                    return
+                peer_actor, peer_vv = framing.decode_hello(
+                    body, self.num_elements, self.num_actors)
+                framing.send_frame(conn, MSG_HELLO, framing.encode_hello(
+                    self.actor, self.num_elements, self.vv()))
+                msg_type, body = framing.recv_frame(
+                    conn, timeout=self.conn_timeout_s)
+                with self._lock:
+                    self._apply_msg(body)
+                    _, reply = self._extract_msg(peer_vv)
+                framing.send_frame(conn, MSG_PAYLOAD, reply)
+        except Exception:  # noqa: BLE001 — test double
+            pass
+
+    b._serve_conn = types.MethodType(legacy_serve_conn, b)
+    a.add(5)
+    addr = b.serve()
+    neg = DigestNegotiator()
+    try:
+        with pytest.raises(DigestUnsupported):
+            sync_digest(a, addr)
+        # the supervisor's fallback: pin legacy, ride the ladder
+        neg.mark_legacy(addr)
+        assert not neg.use_digest(addr)
+        a.sync_with(addr)
+        assert sorted(b.members().tolist()) == [5]
+    finally:
+        b.close()
+
+
+def test_digest_payloads_are_wal_logged_and_replay(tmp_path):
+    """A lane payload applied over a digest exchange is durably logged
+    before the state mutates and replays through restore_durable —
+    MODE_DIGEST rides the §14 contract unchanged."""
+    import os
+
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    d = str(tmp_path / "durable")
+    rec = Recorder()
+    b = Node(1, E, A, recorder=rec,
+             wal=DeltaWal(os.path.join(d, "wal"), recorder=rec))
+    a = Node(0, E, A)
+    a.add(3, 9, 70)
+    a.delete(9)
+    addr = b.serve()
+    try:
+        sync_digest(a, addr)
+    finally:
+        b.close()
+    live = b.state_slice()
+    with b._lock:
+        b.wal.close()
+    back = Node.restore_durable(d, fallback_init=lambda: Node(1, E, A))
+    import jax
+
+    for name in live._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(live, name)),
+            np.asarray(getattr(back.state_slice(), name)), err_msg=name)
+    assert sorted(back.members().tolist()) == [3, 70]
+    back.wal.close()
+    del jax
+
+
+def test_quiescent_rounds_feed_gc_evidence():
+    """Zero-payload digest rounds still advance the deletion-GC
+    frontier: the peer's processed vector rides the summary
+    (Node.note_peer_processed)."""
+    a, b, _ = _pair()
+    a.add(1, 2)
+    a.delete(1)
+    addr = b.serve()
+    try:
+        _converge(a, b, addr)
+        frontier = a.deletion_frontier(participants=[1])
+        assert frontier.any(), "peer evidence missing after digest sync"
+        assert a.gc_deletions(participants=[1])["dropped"] == 1
+    finally:
+        b.close()
+
+
+def test_supervisor_digest_regime_converges_fleet():
+    from go_crdt_playground_tpu.net.antientropy import SyncSupervisor
+    from go_crdt_playground_tpu.utils.backoff import BackoffPolicy
+
+    n, e = 3, 192
+    recs = [Recorder() for _ in range(n)]
+    nodes = [Node(i, e, n, recorder=recs[i]) for i in range(n)]
+    addrs = [nd.serve() for nd in nodes]
+    for i, nd in enumerate(nodes):
+        nd.add(*range(i * 16, (i + 1) * 16))
+    sups = []
+    try:
+        for i in range(n):
+            peers = [addrs[j] for j in range(n) if j != i]
+            sups.append(SyncSupervisor(
+                nodes[i], peers, sync_mode="digest",
+                policy=BackoffPolicy(base_s=0.005, cap_s=0.02,
+                                     max_retries=1),
+                sync_timeout_s=5.0, interval_s=0.0,
+                recorder=recs[i], seed=7 + i))
+        expected = set(range(16 * n))
+        for _ in range(6):
+            for s in sups:
+                s.sync_round()
+            if all(set(nd.members().tolist()) == expected
+                   for nd in nodes):
+                break
+        assert all(set(nd.members().tolist()) == expected
+                   for nd in nodes)
+        vv0 = nodes[0].vv()
+        for _ in range(3):     # settle clocks, then assert quiescence
+            for s in sups:
+                s.sync_round()
+        vv0 = nodes[0].vv()
+        assert all(np.array_equal(nd.vv(), vv0) for nd in nodes)
+        lanes0 = sum(r.counter("digest.lanes_sent") for r in recs)
+        for _ in range(2):
+            for s in sups:
+                s.sync_round()
+        assert sum(r.counter("digest.lanes_sent")
+                   for r in recs) == lanes0
+        assert sum(r.counter("digest.quiescent") for r in recs) > 0
+        assert sum(r.counter("sync.exchanges") for r in recs) == 0
+    finally:
+        for s in sups:
+            s.stop(timeout=1.0)
+        for nd in nodes:
+            nd.close()
+
+
+def test_supervisor_refuses_digest_on_reference_semantics():
+    from go_crdt_playground_tpu.net.antientropy import SyncSupervisor
+
+    node = Node(0, 32, 2, delta_semantics="reference")
+    with pytest.raises(ValueError, match="v2"):
+        SyncSupervisor(node, [], sync_mode="digest")
+    with pytest.raises(ValueError, match="sync_mode"):
+        SyncSupervisor(Node(0, 32, 2), [], sync_mode="bogus")

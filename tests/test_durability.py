@@ -827,3 +827,114 @@ def test_compact_record_refuses_universe_change(tmp_path):
     assert list(back.members()) == []
     assert rec.snapshot()["counters"]["wal.bad_records"] == 1
     back.wal.close()
+
+
+def test_wal_records_filter_guard_covered_deletions(tmp_path):
+    """δ-for-WAL deletion-log filtering (DESIGN.md §16): a record's
+    deleted section carries ONLY the deletions its own window
+    produced — lanes whose dots the replay guard (pre-op vv) covers
+    were introduced by earlier records and are filtered, so records
+    are O(changed) even against a large standing deletion log — and
+    replay still recovers the writer's exact state."""
+    from go_crdt_playground_tpu.net import Node
+    from go_crdt_playground_tpu.utils import wire
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    d = str(tmp_path / "durable")
+    rec = Recorder()
+    node = Node(0, 48, 3, recorder=rec,
+                wal=DeltaWal(os.path.join(d, "wal"), recorder=rec))
+    node.add(*range(20))
+    node.delete(*range(10))      # standing deletion log: 10 records
+    # an unrelated batch: its record must carry ZERO deletion lanes
+    node.ingest_batch(np.eye(48, dtype=bool)[[30, 31]],
+                      np.zeros((2, 48), bool))
+    # a batch with ONE fresh delete: exactly that lane, not the log
+    node.ingest_batch(np.zeros((1, 48), bool),
+                      np.eye(48, dtype=bool)[[15]])
+    bodies = list(node.wal.records())
+    assert len(bodies) == 4
+
+    def record_payload(body):
+        from go_crdt_playground_tpu.net import framing as fr
+
+        if body[:1] == bytes((wire.WAL_COMPACT_TAG,)):
+            return wire.decode_compact_wal_body(body, 48, 3)[1]
+        _, pos = wire._decode_vv_py(body, 0, 3)
+        return fr.decode_payload_msg(body[pos:], 48, 3)[1]
+
+    payloads = [record_payload(b) for b in bodies]
+    # record 2 (the delete op) carries its own 10 fresh deletions
+    assert int(np.asarray(payloads[1].deleted).sum()) == 10
+    # record 3 (adds only): zero deletion lanes despite the log —
+    # pre-filter it re-carried all 10, forcing dense; filtered it
+    # fits the compact form
+    assert bodies[2][:1] == bytes((wire.WAL_COMPACT_TAG,))
+    assert int(np.asarray(payloads[2].deleted).sum()) == 0
+    # record 4: exactly the one fresh deletion
+    dl = np.nonzero(np.asarray(payloads[3].deleted))[0]
+    assert dl.tolist() == [15]
+
+    node.wal.close()
+    back = Node.restore_durable(d, fallback_init=lambda: Node(0, 48, 3))
+    _fields_equal(back.state_slice(), node.state_slice())
+    back.wal.close()
+
+
+def test_dense_fallback_record_filters_deletions_too(tmp_path):
+    """The filter is the record CONTRACT, not a compact-form detail:
+    an oversized δ that falls back to the dense record form still
+    drops guard-covered deletion lanes, and replays to state
+    identity."""
+    from go_crdt_playground_tpu.net import Node
+    from go_crdt_playground_tpu.net.framing import encode_delta_wal_record
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    E = 48
+    d = str(tmp_path / "durable")
+    node = Node(0, E, 3, wal=DeltaWal(os.path.join(d, "wal")))
+    node.add(*range(24))
+    node.delete(*range(12))
+    # a batch touching MOST lanes: past the compact break-even, so the
+    # record goes dense — count its encoded deletion section
+    add = np.zeros((1, E), bool)
+    add[0, 24:48] = True
+    pre_vv = node.vv()
+    node.ingest_batch(add, np.zeros((1, E), bool))
+    bodies = list(node.wal.records())
+    from go_crdt_playground_tpu.utils import wire as w
+
+    last = bodies[-1]
+    assert last[:1] != bytes((w.WAL_COMPACT_TAG,)), "expected dense"
+    # decode: guard vv || PAYLOAD body
+    guard, pos = w._decode_vv_py(last, 0, 3)
+    np.testing.assert_array_equal(guard, pre_vv)
+    from go_crdt_playground_tpu.net import framing as fr
+
+    mode, payload = fr.decode_payload_msg(last[pos:], E, 3)
+    assert int(np.asarray(payload.deleted).sum()) == 0  # all filtered
+    assert int(np.asarray(payload.changed).sum()) == 24
+    node.wal.close()
+    back = Node.restore_durable(d, fallback_init=lambda: Node(0, E, 3))
+    _fields_equal(back.state_slice(), node.state_slice())
+    back.wal.close()
+    # and the shared policy itself, called directly with a fresh
+    # deletion mixed into an old log, keeps exactly the fresh lane
+    import jax
+
+    me = jax.tree.map(lambda x: x[0], node._state)
+    from go_crdt_playground_tpu.ops import delta as delta_ops
+    import jax.numpy as jnp
+
+    p = delta_ops.delta_extract(me, jnp.zeros(3, jnp.uint32))
+    body, is_compact = encode_delta_wal_record(
+        np.zeros(3, np.uint32), 0, p, None)
+    # zero guard: NOTHING is covered — every deletion survives
+    # (whichever record form the break-even picked)
+    if is_compact:
+        _, p2 = w.decode_compact_wal_body(body, E, 3)
+    else:
+        g, pos = w._decode_vv_py(body, 0, 3)
+        _, p2 = fr.decode_payload_msg(body[pos:], E, 3)
+    assert int(np.asarray(p2.deleted).sum()) == \
+        int(np.asarray(p.deleted).sum())

@@ -95,6 +95,44 @@ def test_fused_delta_large_counters_exact():
     _assert_equal(want, got, "large counters")
 
 
+def test_fused_delta_equal_counter_deletion_tiebreak():
+    """Equal-counter deletion records from DIFFERENT actors must take
+    the (counter, actor) lexicographic max in the Pallas kernel exactly
+    as in XLA (ops/delta._delta_apply_impl) — counter-only absorb kept
+    whichever record arrived first, so opposite ring directions left
+    replicas' deletion-log lanes permanently divergent (the lane-never-
+    silent pathology the digest regime's bitwise pin exposes)."""
+    E = 32
+    st = awset_delta.init(4, E, 4)
+    # rows 0 and 1: both delete element 7 with counter 5, actors 0/1
+    for row, actor in ((0, 0), (1, 1)):
+        st = st._replace(
+            vv=st.vv.at[row, actor].set(5),
+            deleted=st.deleted.at[row, 7].set(True),
+            del_dot_actor=st.del_dot_actor.at[row, 7].set(actor),
+            del_dot_counter=st.del_dot_counter.at[row, 7].set(5),
+        )
+    # two opposite round orders: the records arrive in different
+    # sequence at each row, yet every row must land on the SAME
+    # (counter=5, actor=1) lexicographic max — and each round stays
+    # bitwise-pinned to XLA
+    for order in ((1, 2, 3), (3, 2, 1)):
+        cur = st
+        for offset in order:
+            perm = gossip.ring_perm(4, offset)
+            want = gossip.delta_gossip_round(
+                cur, perm, delta_semantics="v2", kernel="xla")
+            got = pallas_delta.pallas_delta_gossip_round(cur, perm)
+            _assert_equal(want, got, f"tiebreak order {order} "
+                                     f"offset {offset}")
+            cur = want
+        for row in range(4):
+            assert int(np.asarray(cur.del_dot_counter)[row, 7]) == 5, \
+                (order, row)
+            assert int(np.asarray(cur.del_dot_actor)[row, 7]) == 1, \
+                (order, row)
+
+
 def test_delta_dispatch_guard():
     st = awset_delta.init(4, 8, 4)
     with pytest.raises(ValueError):

@@ -1,0 +1,38 @@
+"""Slow-marked wrapper for the digest-sync bytes-on-the-wire sweep
+(tools/chaos_soak.py --sync-curve — the SYNC_CURVE.json leg of the
+chaos soak, DESIGN.md §19)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+
+@pytest.mark.slow
+def test_sync_curve_quick(tmp_path):
+    """Quick sweep: quiescent digest rounds ship ZERO state lanes at
+    bytes ≈ digest+vv, divergent digest rounds cost strictly fewer
+    bytes than the δ ladder on the identical seeded op stream, and the
+    digest regime converges under ChaosProxy faults race-free."""
+    import chaos_soak
+
+    out = str(tmp_path / "SYNC_CURVE.json")
+    rc = chaos_soak.main(["--sync-curve", "--quick", "--detect-races",
+                          "--out", out])
+    assert rc == 0
+    with open(out) as f:
+        artifact = json.load(f)
+    assert artifact["quiescent"]["digest_state_lanes"] == 0
+    assert (artifact["quiescent"]["digest_bytes_per_round"]
+            < artifact["quiescent"]["delta_bytes_per_round"])
+    for leg in artifact["divergent"]:
+        assert leg["ok"], leg
+        assert (leg["digest"]["bytes_per_round"]
+                < leg["delta"]["bytes_per_round"]), leg
+    assert artifact["chaos"]["converged"]
+    assert artifact["race_detection"]["races"] == []

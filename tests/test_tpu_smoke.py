@@ -293,3 +293,46 @@ def test_dotpacked_delta_ring_kernel_mosaic(offset):
             packed_mod.pack_awset_delta_dots(state), offset,
             interpret=False), E)
     _assert_equal(want, got)
+
+
+def test_fused_ingest_kernel_mosaic():
+    """The fused ingest+δ kernel (serve hot path, ISSUE 8) must Mosaic-
+    compile and agree bitwise with the XLA fused pass on-chip — the
+    compile proof BENCH_INGEST.json's on-chip regeneration (ROADMAP
+    item b) rides on."""
+    from go_crdt_playground_tpu.ops import ingest as ingest_ops
+    from go_crdt_playground_tpu.ops import pallas_ingest
+
+    row = jax.tree.map(lambda x: x[0], _delta_state(19))
+    rng = np.random.default_rng(19)
+    add = jnp.asarray(rng.random((4, E)) < 0.2)
+    dl = jnp.asarray(rng.random((4, E)) < 0.1)
+    live = jnp.ones(4, bool)
+    want = ingest_ops.ingest_rows_delta(row, add, dl, live,
+                                        k_changed=16, k_deleted=16)
+    got = pallas_ingest.pallas_ingest_rows_delta(
+        row, add, dl, live, k_changed=16, k_deleted=16, interpret=False)
+    for w, g, label in zip(want, got, ("state", "payload", "compact")):
+        for name in w._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(w, name)),
+                np.asarray(getattr(g, name)), err_msg=f"{label}:{name}")
+
+
+def test_digest_kernel_mosaic():
+    """The per-lane digest kernel (digest-sync summary path, ISSUE 9)
+    must Mosaic-compile and agree bitwise with the XLA pass on-chip —
+    ``digest_regime`` dispatches the Pallas twin on TPU backends, so
+    this is the lowering proof for every on-chip digest round."""
+    from go_crdt_playground_tpu.ops import digest as dg
+    from go_crdt_playground_tpu.ops import pallas_digest
+
+    row = jax.tree.map(lambda x: x[0], _delta_state(23))
+    np.testing.assert_array_equal(
+        np.asarray(dg.lane_fingerprints(row)),
+        np.asarray(pallas_digest.pallas_lane_fingerprints(
+            row, interpret=False)))
+    np.testing.assert_array_equal(
+        np.asarray(dg.state_group_digests(row, 64)),
+        np.asarray(pallas_digest.pallas_state_group_digests(
+            row, 64, interpret=False)))

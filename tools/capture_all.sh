@@ -161,6 +161,19 @@ else
             NORTHSTAR.json
 fi
 
+if on_tpu BENCH_INGEST.json; then
+    step "ingest ladder: already on chip, skipping"
+else
+    step "ingest ladder (fused serve path)"
+    # ROADMAP item b: the committed artifact records the CPU regime;
+    # run_ingest itself refuses a CPU(-fallback) overwrite once a TPU
+    # capture lands, so this step is idempotent and fallback-safe.
+    timeout -k 10 900 $PY bench.py --ingest >> "$LOG" 2>&1
+    on_tpu BENCH_INGEST.json && \
+        commit_if_changed "On-chip BENCH_INGEST: fused ingest+δ vs seed two-pass on the real chip" \
+            BENCH_INGEST.json
+fi
+
 # Always refresh the static roofline model last: it joins measured
 # rates from whatever artifacts the steps above just landed (cheap,
 # no device needed).

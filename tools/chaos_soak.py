@@ -41,7 +41,8 @@ def run_scenario(n_nodes: int, n_elements: int, drop_rate: float,
                  truncate_rate: float, duplicate_rate: float, seed: int,
                  max_rounds: int,
                  partition_rounds: Optional[Tuple[int, int]] = None,
-                 detect_races: bool = False) -> Dict[str, object]:
+                 detect_races: bool = False,
+                 sync_mode: str = "delta") -> Dict[str, object]:
     """One seeded fleet run; returns rounds-to-convergence + fault census.
 
     ``partition_rounds=(a, b)`` asymmetrically partitions node 0 (its
@@ -52,6 +53,10 @@ def run_scenario(n_nodes: int, n_elements: int, drop_rate: float,
     detector (analysis/locksets.py): every Node and SyncSupervisor is
     instrumented, and any shared write with an empty candidate lockset
     lands in the returned ``races`` list (and fails the sweep).
+
+    ``sync_mode="digest"`` drives the fleet on the digest-sync regime
+    (net/digestsync.py) — the SYNC_CURVE.json chaos leg: convergence
+    under the same fault census with digest exchanges on the wire.
     """
     from go_crdt_playground_tpu.net import Node, SyncSupervisor
     from go_crdt_playground_tpu.net.faults import ChaosScenario, fleet_proxies
@@ -91,7 +96,7 @@ def run_scenario(n_nodes: int, n_elements: int, drop_rate: float,
                 nodes[i], peer_addrs, policy=policy,
                 sync_timeout_s=1.0, hello_timeout_s=0.4,
                 breaker_threshold=2, breaker_cooldown_s=0.1,
-                fanout=1, interval_s=0.0,
+                fanout=1, interval_s=0.0, sync_mode=sync_mode,
                 recorder=recorders[i], seed=seed * 100 + i)
             if detector is not None:
                 detector.instrument(sup, label=f"SyncSupervisor#{i}")
@@ -159,6 +164,271 @@ def run_scenario(n_nodes: int, n_elements: int, drop_rate: float,
                     pass
 
 
+# ---------------------------------------------------------------------------
+# SYNC_CURVE.json: digest-sync bytes-on-the-wire adjudication (DESIGN.md §19)
+# ---------------------------------------------------------------------------
+
+
+def _warm_digest(n_elements: int, n_actors: int) -> None:
+    """Compile the digest kernels for this fleet shape BEFORE any timed
+    exchange: the first summary/diff dispatch traces+compiles, and a
+    1s sync deadline must measure the protocol, not XLA."""
+    from go_crdt_playground_tpu.net import digestsync
+    from go_crdt_playground_tpu.net.peer import Node
+
+    digestsync.warm(Node(0, n_elements, n_actors))
+
+
+def _fleet_bytes(recorders) -> int:
+    """Total wire bytes across the fleet, regime-agnostic: every byte
+    is counted once, at its sender (both regimes count served and
+    initiated halves symmetrically)."""
+    total = 0
+    for r in recorders:
+        total += r.counter("sync.bytes_sent")
+        total += r.counter("digest.bytes_sent")
+    return total
+
+
+def _fleet_lanes(recorders) -> int:
+    return sum(r.counter("digest.lanes_sent") for r in recorders)
+
+
+def run_traffic_leg(sync_mode: str, n_nodes: int, n_elements: int,
+                    ops_per_round: int, traffic_rounds: int, seed: int,
+                    quiescent_rounds: int = 4,
+                    settle_rounds: int = 20) -> Dict[str, object]:
+    """One clean-network fleet under a seeded op workload, lockstep
+    rounds, measuring bytes-on-the-wire per round.  The SAME (seed,
+    rate) replays the identical op stream under either regime, so the
+    digest-vs-δ byte comparison is apples to apples.
+
+    Three phases per run: DIVERGENT (ops injected before every round),
+    SETTLE (no ops, rounds until converged — bytes here are part of
+    the divergence cost: a converged round means nothing if reaching
+    it was free-ridden), QUIESCENT (converged fleet keeps syncing —
+    the digest regime must ship ZERO state lanes here)."""
+    import numpy as np
+
+    from go_crdt_playground_tpu.net import Node, SyncSupervisor
+    from go_crdt_playground_tpu.obs import Recorder
+    from go_crdt_playground_tpu.utils.backoff import BackoffPolicy
+
+    if sync_mode == "digest":
+        _warm_digest(n_elements, n_nodes)
+    recorders = [Recorder() for _ in range(n_nodes)]
+    nodes = [Node(i, n_elements, n_nodes, recorder=recorders[i])
+             for i in range(n_nodes)]
+    supervisors: List[SyncSupervisor] = []
+    rng = np.random.default_rng(seed)
+    try:
+        addrs = [n.serve() for n in nodes]
+        policy = BackoffPolicy(base_s=0.005, cap_s=0.05, max_retries=2)
+        for i in range(n_nodes):
+            peer_addrs = [addrs[j] for j in range(n_nodes) if j != i]
+            supervisors.append(SyncSupervisor(
+                nodes[i], peer_addrs, policy=policy,
+                sync_timeout_s=5.0, fanout=1, interval_s=0.0,
+                sync_mode=sync_mode, recorder=recorders[i],
+                seed=seed * 100 + i))
+
+        def lockstep() -> None:
+            for sup in supervisors:
+                sup.sync_round()
+
+        def converged() -> bool:
+            m0 = set(nodes[0].members().tolist())
+            vv0 = nodes[0].vv()
+            return all(set(n.members().tolist()) == m0
+                       and np.array_equal(n.vv(), vv0)
+                       for n in nodes[1:])
+
+        def inject(n_ops: int) -> None:
+            for _ in range(n_ops):
+                node = nodes[int(rng.integers(n_nodes))]
+                if rng.random() < 0.35:
+                    members = node.members()
+                    if len(members):
+                        node.delete(int(rng.choice(members)))
+                        continue
+                node.add(int(rng.integers(n_elements)))
+
+        # seed state + initial convergence (first-contact FULLs land
+        # here, outside the measured window for BOTH regimes)
+        inject(2 * n_nodes)
+        for _ in range(settle_rounds):
+            lockstep()
+            if converged():
+                break
+        assert converged(), "fleet failed to converge on seed state"
+
+        b0 = _fleet_bytes(recorders)
+        measured_rounds = 0
+        for _ in range(traffic_rounds):
+            inject(ops_per_round)
+            lockstep()
+            measured_rounds += 1
+        settle = 0
+        while not converged() and settle < settle_rounds:
+            lockstep()
+            measured_rounds += 1
+            settle += 1
+        conv = converged()
+        divergent_bytes = _fleet_bytes(recorders) - b0
+
+        # every quiescent-section number is a WINDOW delta — the
+        # seed/divergent/settle phases above also tick these counters
+        bq = _fleet_bytes(recorders)
+        lanes_q0 = _fleet_lanes(recorders)
+        q0 = sum(r.counter("digest.quiescent") for r in recorders)
+        fb0 = sum(r.counter("digest.fallback_delta")
+                  for r in recorders)
+        for _ in range(quiescent_rounds):
+            lockstep()
+        quiescent_bytes = _fleet_bytes(recorders) - bq
+        quiescent_lanes = _fleet_lanes(recorders) - lanes_q0
+        quiescent_count = sum(r.counter("digest.quiescent")
+                              for r in recorders) - q0
+        fallbacks = sum(r.counter("digest.fallback_delta")
+                        for r in recorders) - fb0
+        return {
+            "sync_mode": sync_mode,
+            "converged": conv,
+            "rounds": measured_rounds,
+            "settle_rounds": settle,
+            "bytes": divergent_bytes,
+            "bytes_per_round": round(divergent_bytes
+                                     / max(1, measured_rounds), 1),
+            "quiescent_bytes_per_round": round(
+                quiescent_bytes / max(1, quiescent_rounds), 1),
+            "quiescent_state_lanes": quiescent_lanes,
+            "quiescent_exchanges": quiescent_count,
+            "delta_fallbacks": fallbacks,
+        }
+    finally:
+        for sup in supervisors:
+            sup.stop(timeout=1.0)
+        for n in nodes:
+            n.close()
+
+
+def run_sync_curve(args) -> int:
+    """The SYNC_CURVE.json sweep (the digest-sync acceptance gate):
+
+    * QUIESCENT — a converged digest fleet keeps syncing: zero state
+      lanes shipped, bytes/round ≈ digests + vvs, and strictly below
+      the δ regime's quiescent floor;
+    * DIVERGENT — at each seeded op rate, bytes per converged round
+      under the digest regime must drop below the δ baseline on the
+      IDENTICAL op stream;
+    * CHAOS — the digest regime converges behind ChaosProxy faults
+      (drops, truncations, duplicates, a healing partition), with the
+      lockset race detector clean when --detect-races is on.
+    """
+    if args.quick:
+        n_nodes, n_elements = 4, 256
+        rates = [4]
+        traffic_rounds, quiescent_rounds = 5, 4
+        chaos_sev = 0.25
+    else:
+        n_nodes, n_elements = 5, 512
+        rates = [2, 8]
+        traffic_rounds, quiescent_rounds = 8, 6
+        chaos_sev = 0.25
+
+    t0 = time.time()
+    legs = []
+    ok = True
+    for rate in rates:
+        pair = {}
+        for mode in ("digest", "delta"):
+            pair[mode] = run_traffic_leg(
+                mode, n_nodes, n_elements, rate, traffic_rounds,
+                seed=17, quiescent_rounds=quiescent_rounds)
+            print(json.dumps({"rate": rate, **{
+                k: pair[mode][k] for k in
+                ("sync_mode", "converged", "bytes_per_round",
+                 "quiescent_bytes_per_round",
+                 "quiescent_state_lanes")}}), flush=True)
+        win = (pair["digest"]["bytes_per_round"]
+               < pair["delta"]["bytes_per_round"])
+        q_win = (pair["digest"]["quiescent_bytes_per_round"]
+                 < pair["delta"]["quiescent_bytes_per_round"])
+        leg_ok = (pair["digest"]["converged"]
+                  and pair["delta"]["converged"] and win and q_win
+                  and pair["digest"]["quiescent_state_lanes"] == 0)
+        ok = ok and leg_ok
+        legs.append({
+            "ops_per_round": rate,
+            "digest": pair["digest"],
+            "delta": pair["delta"],
+            "digest_bytes_below_delta": win,
+            "quiescent_bytes_below_delta": q_win,
+            "ok": leg_ok,
+        })
+
+    # chaos leg: the digest regime behind the fault proxy
+    _warm_digest(60 if not args.quick else 32, 6 if not args.quick
+                 else 4)
+    chaos = run_scenario(
+        n_nodes=4 if args.quick else 6,
+        n_elements=32 if args.quick else 60,
+        drop_rate=chaos_sev, truncate_rate=chaos_sev / 2,
+        duplicate_rate=0.1, seed=11,
+        max_rounds=args.max_rounds, partition_rounds=(0, 2),
+        detect_races=args.detect_races, sync_mode="digest")
+    ok = ok and chaos["converged"]
+    if args.detect_races:
+        ok = ok and not chaos["races"]
+    print(json.dumps({"chaos": {
+        "converged": chaos["converged"], "rounds": chaos["rounds"],
+        "races": len(chaos["races"])}}), flush=True)
+
+    quiescent_leg = legs[0]
+    artifact = {
+        "metric": ("digest-sync bytes-on-the-wire per converged round "
+                   "vs the δ ladder at the same seeded divergence "
+                   f"rate ({n_nodes}-node Node fleet, lockstep "
+                   "supervisor rounds; plus convergence under "
+                   "ChaosProxy faults with the digest regime active)"),
+        "value": quiescent_leg["digest"]["quiescent_bytes_per_round"],
+        "unit": "bytes/quiescent round (digest regime, fleet-wide)",
+        "fleet": {"nodes": n_nodes, "elements": n_elements,
+                  "group_lanes": 64, "quick": bool(args.quick)},
+        "quiescent": {
+            "digest_bytes_per_round":
+                quiescent_leg["digest"]["quiescent_bytes_per_round"],
+            "delta_bytes_per_round":
+                quiescent_leg["delta"]["quiescent_bytes_per_round"],
+            "digest_state_lanes":
+                quiescent_leg["digest"]["quiescent_state_lanes"],
+            "digest_exchanges":
+                quiescent_leg["digest"]["quiescent_exchanges"],
+        },
+        "divergent": legs,
+        "chaos": {
+            "severity": chaos_sev,
+            "converged": chaos["converged"],
+            "rounds": chaos["rounds"],
+            "faults_injected": chaos["faults"],
+            "breaker_transitions": chaos["breaker"],
+            "retries": chaos["retries"],
+        },
+        "elapsed_s": round(time.time() - t0, 1),
+        "platform": "cpu",
+    }
+    if args.detect_races:
+        artifact["race_detection"] = {
+            "enabled": True,
+            "races": sorted(chaos["races"]),
+        }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"wrote {args.out}")
+    return 0 if ok else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
@@ -171,8 +441,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="run the fleet under the lockset race detector "
                          "(analysis/locksets.py); findings land in the "
                          "curve artifact and fail the sweep")
-    ap.add_argument("--out", default=os.path.join(REPO, "CHAOS_CURVE.json"))
+    ap.add_argument("--sync-curve", action="store_true",
+                    help="run the digest-sync bytes-on-the-wire sweep "
+                         "instead of the fault-severity curve: "
+                         "quiescent/divergent digest-vs-δ byte "
+                         "comparison + a digest-regime chaos leg "
+                         "(writes SYNC_CURVE.json, DESIGN.md §19)")
+    ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    if args.out is None:
+        args.out = os.path.join(
+            REPO, "SYNC_CURVE.json" if args.sync_curve
+            else "CHAOS_CURVE.json")
+    if args.sync_curve:
+        return run_sync_curve(args)
 
     if args.quick:
         n_nodes = args.nodes or 4
