@@ -1387,6 +1387,121 @@ def run_ingest(out=_INGEST_ARTIFACT):
     return artifact
 
 
+_MESH_ARTIFACT = "MESH_CURVE.json"
+
+
+def measure_mesh(num_elements=8192, num_actors=8, batch=32, keys=4,
+                 repeats=30, device_ladder=(1, 2, 4, 8)):
+    """Device-mesh replica tier kernel ladder (ISSUE 10, DESIGN.md
+    §20): per device count, wall-time/batch of the full mesh write
+    path (``MeshApplyTarget.ingest_batch`` — one ``shard_map``
+    dispatch + the single δ ``device_get`` + WAL record encode, fsync
+    off so disk weather stays out of a kernel curve) and the
+    collective digest summary read (the DSUM/member-cache path).  CPU
+    runs under forced host devices measure DISPATCH layering, not
+    speedup — 2 host cores time-slice every "device"; the curve's
+    value off-chip is that the mesh path's overhead vs devices=1 is
+    recorded and bounded, the on-chip capture rides capture_all.sh."""
+    import tempfile
+
+    import jax
+
+    from go_crdt_playground_tpu.net import digestsync
+    from go_crdt_playground_tpu.parallel.meshtarget import MeshApplyTarget
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    avail = jax.device_count()
+    counts = [d for d in device_ladder
+              if d <= avail and num_elements % d == 0]
+    rng = np.random.default_rng(7)
+    add = np.zeros((batch, num_elements), bool)
+    for b in range(batch):
+        add[b, rng.choice(num_elements, size=keys, replace=False)] = True
+    dl = np.zeros((batch, num_elements), bool)
+    dl[batch // 2, rng.integers(num_elements)] = True
+    live = np.ones(batch, bool)
+    curve = []
+    for n in counts:
+        with tempfile.TemporaryDirectory() as d:
+            node = MeshApplyTarget(
+                0, num_elements, num_actors, mesh_devices=n,
+                wal=DeltaWal(os.path.join(d, "wal"), fsync=False))
+            node.ingest_batch(add, dl, live)  # warm/compile
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                node.ingest_batch(add, dl, live)
+            ingest_s = (time.perf_counter() - t0) / repeats
+            digestsync.node_summary(node)  # warm the collective read
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                summary = digestsync.node_summary(node)
+            digest_s = (time.perf_counter() - t0) / repeats
+        curve.append({
+            "devices": n,
+            "ingest_ms_per_batch": round(ingest_s * 1e3, 3),
+            "ops_per_s": round(batch / ingest_s, 1),
+            "digest_read_ms": round(digest_s * 1e3, 3),
+            "digest_summary_bytes": len(summary),
+        })
+    # the config rides back with the curve so the artifact records
+    # what was MEASURED, not a separately-maintained literal
+    return curve, avail, {"elements": num_elements, "batch": batch}
+
+
+def run_mesh(out=_MESH_ARTIFACT):
+    """The `--mesh` verb: measure the mesh kernel ladder and write the
+    kernel half of MESH_CURVE.json.  Same TPU-overwrite guard as
+    run_ingest (a CPU/fallback run refuses to overwrite an on-chip
+    artifact), and MERGE-shaped: the fleet soak's serve-level curve
+    (``serve_curve``/``crash`` keys, tools/fleet_serve_soak.py --mesh)
+    lives in the same artifact and survives a kernel re-measure."""
+    import jax
+
+    platform = jax.default_backend()
+    prior = {}
+    if os.path.exists(out):
+        try:
+            with open(out) as f:
+                prior = json.load(f)
+        except ValueError:
+            prior = {}
+        if not isinstance(prior, dict):
+            prior = {}  # valid-JSON-but-not-an-object: unknown prior
+        if prior.get("platform") == "tpu" and platform != "tpu":
+            print(json.dumps({
+                "metric": "mesh replica tier ladder",
+                "skipped": f"existing {out} kernel curve is an on-chip "
+                           f"artifact; refusing to overwrite it with a "
+                           f"{platform} run",
+                "platform": platform,
+            }))
+            return None
+    curve, avail, config = measure_mesh()
+    # start from the prior artifact and overwrite ONLY the kernel
+    # keys (mirror of fleet_serve_soak's run_mesh_mode): the soak's
+    # serve-level half survives a kernel re-capture without a
+    # hand-maintained allowlist that would silently drop any key the
+    # soak adds later (e.g. the bitwise-parity evidence)
+    artifact = dict(prior)
+    artifact.update({
+        "metric": ("device-mesh replica tier: ms/batch of the one-"
+                   "dispatch lane-sharded ingest+δ write path and the "
+                   "collective digest read, vs mesh device count "
+                   "(parallel/meshtarget.py)"),
+        "platform": platform,
+        "devices_visible": avail,
+        "kernel_curve": curve,
+        **config,
+    })
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    for leg in curve:
+        print(json.dumps(leg))
+    print(f"wrote {out}")
+    return artifact
+
+
 def run_ladder():
     """Configs 1-5, each persisted to BENCH_LADDER.partial.jsonl the
     moment it completes, so a timeout at config 5 costs config 5 — not
@@ -1593,6 +1708,14 @@ def main():
                                   "error": "--out needs a path"}))
                 sys.exit(2)
         run_ingest(out=out)
+        return
+    if "--mesh" in sys.argv:
+        # device-mesh replica tier ladder (seconds on CPU): kernel
+        # half of MESH_CURVE.json, TPU-overwrite-guarded by run_mesh;
+        # CPU multi-device runs need XLA_FLAGS=
+        # --xla_force_host_platform_device_count=N exported BEFORE
+        # launch (jax reads it at init)
+        run_mesh()
         return
     if os.environ.get("CRDT_BENCH_CHILD") == "1":
         _child_main()

@@ -144,7 +144,14 @@ def _cmd_serve_ingest(args) -> int:
         compact_interval_s=args.compact_interval,
         compact_p99_budget_s=args.compact_p99_budget_ms / 1e3,
         gc_participants=args.gc_participants,
-        sync_mode=args.sync_mode)
+        sync_mode=args.sync_mode,
+        mesh_devices=args.mesh_devices)
+    if args.mesh_devices is not None and not args.fused_ingest:
+        print("WARNING: --no-fused-ingest is ignored with "
+              "--mesh-devices — the mesh write path is always the "
+              "one-dispatch fused ingest+δ program (use a plain "
+              "single-device worker for the seed two-dispatch "
+              "comparison)", flush=True)
     if args.gc_participants is not None and args.compact_interval <= 0:
         print("WARNING: --gc-participants has no effect without "
               "--compact-interval > 0 — no compaction scheduler runs, "
@@ -157,6 +164,7 @@ def _cmd_serve_ingest(args) -> int:
           f"durable={'yes' if args.durable_dir else 'NO'} "
           f"fused={'yes' if args.fused_ingest else 'NO'} "
           f"sync={args.sync_mode} "
+          f"mesh={args.mesh_devices or 'off'} "
           f"compaction={args.compact_interval or 'off'})", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -397,6 +405,17 @@ def main(argv=None) -> int:
                    help="seed-comparison mode: two dispatches per batch "
                         "(apply, then delta_extract for the WAL record) "
                         "and dense WAL records")
+    s.add_argument("--mesh-devices", dest="mesh_devices", type=int,
+                   default=None, metavar="N",
+                   help="hold the replica state lane-sharded across a "
+                        "1-D device mesh of N devices "
+                        "(parallel/meshtarget.py, DESIGN.md §20): "
+                        "shard-local batch applies, collective digest "
+                        "reads, lane-gather slice transfers — WAL, "
+                        "checkpoints, sync and resharding unchanged.  "
+                        "E must divide by N.  CPU testing: export "
+                        "XLA_FLAGS=--xla_force_host_platform_device_"
+                        "count=8 before launch")
 
     def _shard_spec(text: str):
         sid, _, addr = text.partition("=")

@@ -48,7 +48,11 @@ LOCK_TARGETS = ["net/peer.py", "net/antientropy.py", "net/digestsync.py",
                 "serve/client.py", "serve/host.py", "serve/compaction.py",
                 "obs/metrics.py",
                 "shard/ring.py", "shard/router.py", "shard/fleet.py",
-                "shard/handoff.py"]
+                "shard/handoff.py",
+                # the mesh replica tier (ISSUE 10): a Node subclass
+                # whose compiled-program caches and re-pin paths run
+                # under the node lock like every other state mutation
+                "parallel/meshtarget.py"]
 # extra files that participate in the lock-ORDER graph (their locks can
 # nest under the runtime's)
 LOCK_ORDER_EXTRA = ["utils/checkpoint.py"]
@@ -59,7 +63,7 @@ PURITY_TARGETS = ["ops/merge.py", "ops/delta.py", "ops/lattices.py",
                   "ops/vv.py", "ops/compact.py", "ops/pallas_merge.py",
                   "ops/pallas_delta.py", "ops/ingest.py",
                   "ops/pallas_ingest.py", "ops/digest.py",
-                  "ops/pallas_digest.py"]
+                  "ops/pallas_digest.py", "parallel/meshtarget.py"]
 # attribute-name -> class hints for cross-class lock-order edges
 ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "recorder": "Recorder", "_store": "CheckpointStore",

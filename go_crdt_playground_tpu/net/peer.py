@@ -286,11 +286,6 @@ class Node:
         payload in a second dispatch.  ``ingest.dispatches`` counts the
         compiled applies per batch (fused: 1; seed path: 2 when a WAL
         is attached)."""
-        import jax
-        import jax.numpy as jnp
-
-        from go_crdt_playground_tpu.ops import ingest as ingest_ops
-
         add_rows = np.asarray(add_rows, bool)
         del_rows = np.asarray(del_rows, bool)
         if add_rows.shape != del_rows.shape or add_rows.ndim != 2 \
@@ -307,39 +302,67 @@ class Node:
         with self._lock:
             pre_vv = (np.asarray(self._state.vv[0]).copy()
                       if self.wal is not None else None)
-            row = jax.tree.map(lambda x: x[0], self._state)
-            if self.ingest_fused and pre_vv is not None:
-                # (without a WAL there is no record to build — the δ
-                # half of the fused dispatch would be computed and
-                # discarded, so the plain apply below is the fast path)
-                if self._fused_regime is None:
-                    self._fused_regime = ingest_ops.ingest_delta_regime(
-                        self.num_elements)
-                fused_fn, k = self._fused_regime
-                merged, payload, compact = fused_fn(
-                    row, jnp.asarray(add_rows), jnp.asarray(del_rows),
-                    jnp.asarray(live), k_changed=k, k_deleted=k)
-                self._state = jax.tree.map(
-                    lambda full, r: full.at[0].set(r), self._state,
-                    merged)
-                self._count("ingest.dispatches")
-                self._append_delta_record(pre_vv, payload, compact)
-            else:
-                merged = ingest_ops.ingest_rows(
-                    row, jnp.asarray(add_rows), jnp.asarray(del_rows),
-                    jnp.asarray(live))
-                self._state = jax.tree.map(
-                    lambda full, r: full.at[0].set(r), self._state,
-                    merged)
-                self._count("ingest.dispatches")
-                if pre_vv is not None:
-                    self._count("ingest.dispatches")  # delta_extract
-                    self._log_local_delta(pre_vv)
+            self._apply_batch_locked(add_rows, del_rows, live, pre_vv)
+
+    # requires-lock: _lock
+    def _apply_batch_locked(self, add_rows: np.ndarray,
+                            del_rows: np.ndarray, live: np.ndarray,
+                            pre_vv: Optional[np.ndarray]) -> None:
+        """The apply+log half of ``ingest_batch`` (validation done):
+        the replica-flavor seam — ``parallel/meshtarget.MeshApplyTarget``
+        overrides this with the mesh-sharded one-dispatch path while
+        the ack-after-durable contract stays in the caller.  Caller
+        holds the lock; ``pre_vv`` is None iff no WAL is attached."""
+        import jax
+        import jax.numpy as jnp
+
+        from go_crdt_playground_tpu.ops import ingest as ingest_ops
+
+        row = jax.tree.map(lambda x: x[0], self._state)
+        if self.ingest_fused and pre_vv is not None:
+            # (without a WAL there is no record to build — the δ
+            # half of the fused dispatch would be computed and
+            # discarded, so the plain apply below is the fast path)
+            if self._fused_regime is None:
+                self._fused_regime = ingest_ops.ingest_delta_regime(
+                    self.num_elements)
+            fused_fn, k = self._fused_regime
+            merged, payload, compact = fused_fn(
+                row, jnp.asarray(add_rows), jnp.asarray(del_rows),
+                jnp.asarray(live), k_changed=k, k_deleted=k)
+            self._state = jax.tree.map(
+                lambda full, r: full.at[0].set(r), self._state,
+                merged)
+            self._count("ingest.dispatches")
+            self._append_delta_record(pre_vv, payload, compact)
+        else:
+            merged = ingest_ops.ingest_rows(
+                row, jnp.asarray(add_rows), jnp.asarray(del_rows),
+                jnp.asarray(live))
+            self._state = jax.tree.map(
+                lambda full, r: full.at[0].set(r), self._state,
+                merged)
+            self._count("ingest.dispatches")
+            if pre_vv is not None:
+                self._count("ingest.dispatches")  # delta_extract
+                self._log_local_delta(pre_vv)
 
     def members(self) -> np.ndarray:
         """Sorted live element ids (SortedValues, awset.go:61-70, on ids)."""
         with self._lock:
             return np.nonzero(np.asarray(self._state.present[0]))[0]
+
+    def members_vv(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Membership + vv under ONE lock hold — the serve QUERY read.
+        Pulls ONLY the ``present`` bitmask and the vv leaves, not the
+        full 9-field state pytree: against a mesh-sharded replica
+        (parallel/meshtarget.py) that is one E-byte mask gather plus a
+        replicated A-word vector instead of every dot/deletion lane in
+        HBM crossing to the host per query."""
+        with self._lock:
+            present = np.asarray(self._state.present[0])
+            vv = np.asarray(self._state.vv[0]).copy()
+        return np.nonzero(present)[0], vv
 
     def vv(self) -> np.ndarray:
         with self._lock:
@@ -995,15 +1018,19 @@ class Node:
     @classmethod
     def restore_durable(cls, dirpath: str, *, recorder=None,
                         min_generation: int = 0, keep: int = 3,
-                        fallback_init=None) -> "Node":
+                        fallback_init=None,
+                        node_kwargs: Optional[dict] = None) -> "Node":
         """Full crash-recovery path: newest VALID checkpoint generation
         (fallback past corrupt ones, fenced by ``min_generation``) plus
         a replay of the WAL tail, with the WAL left attached so the
         recovered node keeps logging.  ``fallback_init`` (a zero-arg
         Node factory) covers the died-before-first-checkpoint case —
         the store is empty but the WAL may still hold the entire
-        history.  The restored node is not serving; call ``serve()``
-        to rejoin."""
+        history.  ``node_kwargs`` are extra constructor kwargs for
+        ``cls`` (subclass plumbing — e.g. ``MeshApplyTarget``'s
+        ``mesh_devices`` — which checkpoint metadata deliberately does
+        not carry: placement is deployment config, not state).  The
+        restored node is not serving; call ``serve()`` to rejoin."""
         import os as _os
 
         from go_crdt_playground_tpu.utils.checkpoint import (
@@ -1047,6 +1074,7 @@ class Node:
                 strict_reference_semantics=meta[
                     "strict_reference_semantics"],
                 recorder=recorder,
+                **(node_kwargs or {}),
             )
             with node._lock:
                 node._state = ck.state

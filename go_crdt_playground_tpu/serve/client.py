@@ -200,6 +200,13 @@ class ServeClient:
         return self._request_reply(protocol.MSG_STATS,
                                    protocol.encode_stats)
 
+    def digest_summary(self) -> bytes:
+        """The replica's digest summary body (opaque bytes): the
+        O(E/16) freshness key the router's member cache compares
+        before deciding whether a full ``members()`` pull is needed."""
+        return self._request_reply(protocol.MSG_DSUM,
+                                   protocol.encode_dsum)
+
     # -- fleet-aware GC (router aggregation, DESIGN.md §17) -----------------
 
     def frontier(self) -> Tuple[np.ndarray, np.ndarray, bool]:
@@ -307,6 +314,11 @@ class ServeClient:
                         protocol.decode_gc_reply(body)
                     with self._lock:
                         self._replies[req_id] = (dropped, remaining)
+                    self._finish(req_id, None, now)
+                elif msg_type == protocol.MSG_DSUM_REPLY:
+                    req_id, summary = protocol.decode_dsum_reply(body)
+                    with self._lock:
+                        self._replies[req_id] = summary
                     self._finish(req_id, None, now)
                 else:
                     err = framing.ProtocolError(

@@ -65,23 +65,41 @@ class ServeFrontend:
                  compact_interval_s: float = 0.0,
                  compact_p99_budget_s: float = 0.25,
                  gc_participants: Optional[Sequence[int]] = None,
-                 sync_mode: str = "delta"):
+                 sync_mode: str = "delta",
+                 mesh_devices: Optional[int] = None):
         from go_crdt_playground_tpu.obs import Recorder
 
         self.recorder = recorder if recorder is not None else Recorder()
         self.durable_dir = durable_dir
+        # the replica flavor: a plain single-device Node, or the
+        # device-mesh target (parallel/meshtarget.py, DESIGN.md §20)
+        # with the SAME durability/dissemination surface — everything
+        # below this constructor line is flavor-agnostic
+        node_cls = Node
+        node_kwargs: dict = {}
+        if mesh_devices is not None:
+            from go_crdt_playground_tpu.parallel.meshtarget import \
+                MeshApplyTarget
+
+            node_cls = MeshApplyTarget
+            node_kwargs = {"mesh_devices": mesh_devices}
+        # the flavor seam, kept for every later scratch construction
+        # (_warmup must build the SAME class with the SAME kwargs or
+        # it warms a program the serving node never runs)
+        self._node_kwargs = node_kwargs
         if durable_dir is not None:
             os.makedirs(durable_dir, exist_ok=True)
-            self.node = Node.restore_durable(
+            self.node = node_cls.restore_durable(
                 durable_dir, recorder=self.recorder,
-                fallback_init=lambda: Node(
+                node_kwargs=node_kwargs,
+                fallback_init=lambda: node_cls(
                     actor, num_elements, num_actors,
-                    recorder=self.recorder))
+                    recorder=self.recorder, **node_kwargs))
         else:
             # non-durable regime (benchmarks/tests): acks are NOT backed
             # by an fsync — production serving always passes durable_dir
-            self.node = Node(actor, num_elements, num_actors,
-                             recorder=self.recorder)
+            self.node = node_cls(actor, num_elements, num_actors,
+                                 recorder=self.recorder, **node_kwargs)
         # serve-ladder knobs (plain config attrs — restore_durable
         # rebuilds the node from checkpoint metadata, which does not
         # carry them): fused one-dispatch ingest+δ and compact WAL
@@ -209,13 +227,15 @@ class ServeFrontend:
             # worker must warm the seed two-dispatch programs, not the
             # fused one it will never run (the first batch would
             # otherwise pay the compile stall the warmup exists to
-            # prevent — and skew any seed-vs-fused comparison)
-            scratch = Node(self.node.actor, E, self.node.num_actors,
-                           ingest_fused=self.node.ingest_fused,
-                           wal_compact_records=self.node.
-                           wal_compact_records,
-                           wal=DeltaWal(os.path.join(d, "wal"),
-                                        fsync=False))
+            # prevent — and skew any seed-vs-fused comparison).  Same
+            # CLASS + flavor kwargs too: a mesh-sharded replica must
+            # warm the shard_map programs on its own mesh shape
+            scratch = type(self.node)(
+                self.node.actor, E, self.node.num_actors,
+                ingest_fused=self.node.ingest_fused,
+                wal_compact_records=self.node.wal_compact_records,
+                wal=DeltaWal(os.path.join(d, "wal"), fsync=False),
+                **self._node_kwargs)
             add = np.zeros((B, E), bool)
             add[0, 0] = True  # one live lane: the δ-extract path runs
             scratch.ingest_batch(add, np.zeros((B, E), bool),
@@ -302,6 +322,8 @@ class ServeFrontend:
             return self._handle_frontier(session, body)
         if msg_type == protocol.MSG_GC:
             return self._handle_gc(session, body)
+        if msg_type == protocol.MSG_DSUM:
+            return self._handle_dsum(session, body)
         session.send(framing.MSG_ERROR,
                      f"unexpected frame type {msg_type}".encode())
         return False
@@ -357,16 +379,14 @@ class ServeFrontend:
             session.send(framing.MSG_ERROR, str(e).encode())
             return
         self._count("serve.queries")
-        # ONE lock hold for membership + vv: separate members()/vv()
+        # ONE lock hold for membership + vv (separate members()/vv()
         # calls could interleave with a batch commit and reply with a
         # vv covering an add the membership doesn't show — a state no
-        # replica ever held
-        import numpy as np
-
-        snap = self.node.state_slice()
-        members = np.nonzero(np.asarray(snap.present))[0]
+        # replica ever held), pulling ONLY the present mask + vv: on a
+        # mesh-sharded replica the dot/deletion lanes stay on-device
+        members, vv = self.node.members_vv()
         session.send(protocol.MSG_MEMBERS, protocol.encode_members(
-            req_id, [int(e) for e in members], np.asarray(snap.vv)))
+            req_id, [int(e) for e in members], vv))
 
     def _handle_stats(self, session: Session, body: bytes) -> None:
         """The SLO read-out: the recorder snapshot (ingest latency
@@ -379,6 +399,25 @@ class ServeFrontend:
             return
         session.send(protocol.MSG_STATS_REPLY, protocol.encode_stats_reply(
             req_id, self.recorder.snapshot()))
+
+    def _handle_dsum(self, session: Session, body: bytes) -> bool:
+        """The digest-summary read (protocol.MSG_DSUM): this replica's
+        ``net/digestsync`` summary body — the O(E/16)-byte freshness
+        key the router's member cache compares instead of re-pulling
+        O(membership) MEMBERS replies.  On a mesh-sharded replica the
+        digests come off the collective kernel; either way no state
+        lane crosses to the host for this read."""
+        from go_crdt_playground_tpu.net import digestsync
+
+        try:
+            req_id = protocol.decode_dsum(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return False
+        self._count("serve.digest_reads")
+        session.send(protocol.MSG_DSUM_REPLY, protocol.encode_dsum_reply(
+            req_id, digestsync.node_summary(self.node)))
+        return True
 
     # -- keyspace handoff (live resharding, DESIGN.md §18) ------------------
 

@@ -94,6 +94,17 @@ MSG_FRONTIER = 28
 MSG_FRONTIER_REPLY = 29
 MSG_GC = 30
 MSG_GC_REPLY = 31
+# digest-summary read (ROADMAP digest rung b — the router's member
+# cache): DSUM asks a frontend for its replica's digest summary — the
+# ``net/digestsync.py`` summary body (vv, processed, packed per-lane-
+# group digests), opaque here — which is a few dozen bytes against a
+# MEMBERS reply's O(membership).  Two equal summaries imply equal
+# membership AND vv (present bits are fingerprinted, the vv is
+# explicit; the 2^-32-per-group collision bound is ops/digest.py's),
+# so a router can cache per-shard member sets keyed by the summary and
+# re-pull only on mismatch: repeated fleet reads become O(diff).
+MSG_DSUM = 32
+MSG_DSUM_REPLY = 33
 
 OP_ADD = 0
 OP_DEL = 1
@@ -602,6 +613,41 @@ def decode_gc_reply(body: bytes) -> Tuple[int, int, int]:
     if pos != len(body):
         raise ProtocolError("trailing bytes after GC_REPLY")
     return req_id, dropped, remaining
+
+
+def encode_dsum(req_id: int) -> bytes:
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    return bytes(out)
+
+
+def decode_dsum(body: bytes) -> int:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos != len(body):
+        raise ProtocolError("trailing bytes after DSUM")
+    return req_id
+
+
+def encode_dsum_reply(req_id: int, summary: bytes) -> bytes:
+    """``summary`` is a ``net/digestsync.py`` summary body — opaque to
+    this dialect (the router compares it byte-for-byte as a cache key;
+    only digest-sync peers ever parse one)."""
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    return bytes(out) + summary
+
+
+def decode_dsum_reply(body: bytes) -> Tuple[int, bytes]:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos >= len(body):
+        raise ProtocolError("empty DSUM_REPLY summary")
+    return req_id, body[pos:]
 
 
 def decode_members(body: bytes) -> Tuple[int, List[int], np.ndarray]:

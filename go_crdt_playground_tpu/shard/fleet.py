@@ -139,6 +139,11 @@ class FleetSpec:
     # extra `serve --ingest` CLI flags appended verbatim to every shard
     # (the serve soak's seed-comparison / compaction legs ride these)
     extra_args: Tuple[str, ...] = ()
+    # extra env for every shard subprocess, as (key, value) pairs (the
+    # mesh soak exports XLA_FLAGS=--xla_force_host_platform_device_
+    # count=N — jax only honors it at process init, so it must ride
+    # the worker env, not the CLI)
+    extra_env: Tuple[Tuple[str, str], ...] = ()
 
     def __post_init__(self) -> None:
         if self.n_shards < 1:
@@ -162,7 +167,7 @@ class ShardProc(_Proc):
         self.port = port
         self.dirpath = dirpath
         os.makedirs(dirpath, exist_ok=True)
-        env = {}
+        env = dict(spec.extra_env)
         if crash_after_batches is not None:
             env["CRDT_SERVE_CRASH_AFTER_BATCHES"] = str(crash_after_batches)
         if crash_on_slice is not None:

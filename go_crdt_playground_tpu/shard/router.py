@@ -87,6 +87,15 @@ class _Unreachable(Exception):
     handler."""
 
 
+class _DsumUnsupported(Exception):
+    """Internal: the shard ANSWERED the DSUM probe with the legacy
+    unknown-frame ``MSG_ERROR`` reply (a ``framing.RemoteError`` — the
+    server really said it, as opposed to a locally-synthesized
+    desync/teardown message that merely CONTAINS the same text).  The
+    caller pins the sid to the uncached path; every other probe
+    failure is transient and must stay re-probeable."""
+
+
 class _Relay:
     """One upstream OP's fan-out accounting: ack upstream only when
     every sub-op acked; the FIRST reject wins otherwise (deterministic
@@ -308,6 +317,42 @@ class _ShardLink:
     def members(self) -> Tuple[List[int], np.ndarray]:
         return self._request("members")
 
+    def digest_summary(self) -> bytes:
+        return self._request("digest_summary")
+
+    def digest_summary_probe(self) -> bytes:
+        """First-ever DSUM against this shard, on a THROWAWAY dial: a
+        pre-digest frontend answers an unknown frame by ENDING the
+        connection (the ConnHost dispatch-False contract), which on
+        the shared pipelined client would also tear down every
+        in-flight OP and charge the breaker for a healthy shard — so
+        classification pays its one possible failure on its own
+        socket.  Never touches the breaker.  Classification is by
+        exception TYPE: only a ``framing.RemoteError`` (the server's
+        own MSG_ERROR reply) proves the shard is a pre-digest build —
+        a torn/desynced reply surfaces as a locally-synthesized
+        ``ConnectionError`` that may CONTAIN the same "unexpected
+        frame type" text and must stay transient/re-probeable."""
+        try:
+            probe = ServeClient(self.addr, timeout=self.timeout_s,
+                                connect_timeout=self.DIAL_TIMEOUT_S)
+        except (OSError, ConnectionError) as e:
+            raise _Unreachable(
+                f"shard {self.sid} dsum probe dial failed: {e}") from e
+        try:
+            return probe.digest_summary()
+        except framing.RemoteError as e:
+            if "unexpected frame type" in str(e):
+                raise _DsumUnsupported(
+                    f"shard {self.sid} is pre-digest: {e}") from e
+            raise _Unreachable(
+                f"shard {self.sid} dsum probe: {e}") from e
+        except Exception as e:  # noqa: BLE001 — transient
+            raise _Unreachable(
+                f"shard {self.sid} dsum probe: {e}") from e
+        finally:
+            probe.close()
+
     def stats(self) -> dict:
         return self._request("stats")
 
@@ -429,6 +474,35 @@ class ShardRouter:
         # can dial dead shards for seconds without wedging a handoff
         self._op_epoch = 0  # guarded-by: _lock
         self._inflight_by_epoch: Dict[int, int] = {}  # guarded-by: _lock
+        # digest-guarded member cache (ROADMAP digest rung b): per
+        # shard, the last MEMBERS reply keyed by the digest summary it
+        # was fresh under.  QUERY fan-out fetches the O(E/16)-byte
+        # summary first and re-pulls the O(membership) member set only
+        # on mismatch — a quiescent fleet's repeated reads become
+        # O(diff).  Safe because a replica's vv is monotone and rides
+        # the summary: a stale summary key can never recur, so a
+        # hit proves the cached reply is the one the shard would give
+        # (to ops/digest.py's 2^-32-per-group collision bound).
+        self._member_cache_lock = threading.Lock()
+        self._member_cache: Dict[
+            str, Tuple[bytes, List[int], np.ndarray]] = {}  # guarded-by: _member_cache_lock
+        # bumped on every membership drop: a QUERY fan-out worker that
+        # snapshotted its links BEFORE a reshard-leave can finish its
+        # (seconds-long) members() pull AFTER the leave's eviction ran
+        # — stores stamped with an older epoch are dropped, so a
+        # departed sid can never be resurrected into the cache or the
+        # DSUM classification (a rejoining sid may be a different
+        # binary)
+        self._member_cache_epoch = 0  # guarded-by: _member_cache_lock
+        # DSUM classification, per sid until it leaves the ring:
+        # supported sids ride the shared link client; sids that
+        # answered the probe with the legacy "unexpected frame type"
+        # error are queried uncached for good.  Unclassified sids
+        # probe on a THROWAWAY dial (a legacy frontend ENDS the
+        # connection on the unknown frame — on the shared client that
+        # would tear down every in-flight OP).
+        self._dsum_supported: set = set()  # guarded-by: _member_cache_lock
+        self._dsum_unsupported: set = set()  # guarded-by: _member_cache_lock
         self._fleet_gc_interval_s = float(fleet_gc_interval_s)
         # race-ok: serve() owner thread only
         self._fleet_gc_thread: Optional[threading.Thread] = None
@@ -550,6 +624,19 @@ class ShardRouter:
                 self._links[add_sid] = add_link
             if drop_sid is not None:
                 retired = self._links.pop(drop_sid, None)
+        if drop_sid is not None:
+            # a left shard's cached member set must not linger (its
+            # link is gone, so nothing would ever refresh the entry),
+            # and its DSUM classification resets with it — the sid
+            # may rejoin as a different (upgraded or downgraded)
+            # binary on the same id.  The epoch bump (same lock hold)
+            # invalidates any in-flight fan-out worker's pending store
+            # for the departed sid.
+            with self._member_cache_lock:
+                self._member_cache.pop(drop_sid, None)
+                self._dsum_unsupported.discard(drop_sid)
+                self._dsum_supported.discard(drop_sid)
+                self._member_cache_epoch += 1
         if retired is not None:
             retired.close()
         return gen
@@ -728,6 +815,13 @@ class ShardRouter:
         QUERY plumbing through ServeClient or long-lived fan-out
         workers) buys nothing until read fan-out is a measured cost —
         revisit if dashboards ever poll hot."""
+        return self._fan_out_fn(
+            lambda sid, link: getattr(link, call)(*args))
+
+    def _fan_out_fn(self, fn) -> Dict[str, object]:
+        """The fan-out engine behind ``_fan_out``: run ``fn(sid, link)``
+        per shard concurrently (the member-cache read needs a two-step
+        per-shard call, not a single link method)."""
         links = self.links_snapshot()
         # pre-seeded: a worker that dies unexpectedly or outlives the
         # join bound leaves its sentinel in place, so the shard reads
@@ -740,12 +834,12 @@ class ShardRouter:
 
         def one(sid: str, link: _ShardLink) -> None:
             try:
-                r = getattr(link, call)(*args)
+                r = fn(sid, link)
             except _Unreachable as e:
                 r = e
             except Exception as e:  # noqa: BLE001 — any escape still
                 # counts as unreachable rather than a vanished shard
-                r = _Unreachable(f"shard {sid} {call} raised: {e}")
+                r = _Unreachable(f"shard {sid} fan-out raised: {e}")
             with lock:
                 results[sid] = r
 
@@ -758,6 +852,63 @@ class ShardRouter:
             t.join(timeout=self._downstream_timeout_s + 5.0)
         with lock:
             return dict(results)
+
+    def _members_cached(self, sid: str, link: _ShardLink):
+        """One shard's QUERY read through the digest-guarded member
+        cache: fetch the summary (cheap), serve the cached member set
+        on a byte-identical key, re-pull MEMBERS only on mismatch.
+        Counters: ``router.member_cache.hits`` / ``.refreshes``.  A
+        shard that cannot answer DSUM (pre-digest build) is pinned to
+        the uncached path so one legacy shard costs one failed probe,
+        not a doomed extra round-trip per query."""
+        with self._member_cache_lock:
+            epoch0 = self._member_cache_epoch
+            unsupported = sid in self._dsum_unsupported
+            supported = sid in self._dsum_supported
+        summ = None
+        if not unsupported:
+            try:
+                if supported:
+                    summ = link.digest_summary()
+                else:
+                    # unclassified: probe on a throwaway dial (a
+                    # legacy frontend closes the connection on the
+                    # unknown frame — never risk the shared client)
+                    summ = link.digest_summary_probe()
+                    with self._member_cache_lock:
+                        if self._member_cache_epoch == epoch0:
+                            self._dsum_supported.add(sid)
+            except _DsumUnsupported:
+                with self._member_cache_lock:
+                    if self._member_cache_epoch == epoch0:
+                        self._dsum_unsupported.add(sid)
+            except _Unreachable:
+                # transient (dead shard / torn link / desynced reply):
+                # let members() classify it — both paths share the
+                # breaker — and re-probe next query
+                summ = None
+        if summ is not None:
+            with self._member_cache_lock:
+                cached = self._member_cache.get(sid)
+            if cached is not None and cached[0] == summ:
+                self._count("router.member_cache.hits")
+                return cached[1], cached[2]
+        m, vv = link.members()
+        if summ is not None:
+            # keyed by the summary fetched BEFORE the member pull: if
+            # the shard advanced in between, the stored key is stale
+            # and the next query refreshes — never serves wrong data
+            # (a replica's vv is monotone, so an old key cannot recur).
+            # Epoch-guarded: if a reshard dropped membership while we
+            # were pulling, this store would resurrect a dead entry.
+            stored = False
+            with self._member_cache_lock:
+                if self._member_cache_epoch == epoch0:
+                    self._member_cache[sid] = (summ, m, vv)
+                    stored = True
+            if stored:
+                self._count("router.member_cache.refreshes")
+        return m, vv
 
     def _handle_query(self, session: Session, body: bytes) -> None:
         try:
@@ -772,7 +923,7 @@ class ShardRouter:
         # owner map while the recipient's reply predates its slice (one
         # query transiently missing the whole moved slice)
         rt = self.route()
-        results = self._fan_out("members")
+        results = self._fan_out_fn(self._members_cached)
         # ownership filter (no-double-serve): each shard contributes
         # ONLY the elements the active ring assigns it — a donor's
         # stale copy of a moved slice must not shadow the new owner

@@ -735,6 +735,10 @@ def test_gate_fast(tmp_path):
     # the per-peer negotiation cache crosses the supervisor's round
     # thread and any caller marking a peer legacy
     assert "DigestNegotiator" in covered, covered
+    # ... and the device-mesh replica tier (the mesh ISSUE): the mesh
+    # target's compiled-program caches and re-pin paths run under the
+    # node lock across batcher/sync/compaction threads
+    assert "MeshApplyTarget" in covered, covered
 
 
 def test_report_shape_roundtrips(tmp_path):

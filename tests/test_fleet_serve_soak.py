@@ -78,3 +78,45 @@ def test_fleet_serve_soak_quick_mode(tmp_path):
     assert reshard["lost_acked_ops"] == []
     assert reshard["phantom_members"] == []
     assert reshard["final_members"] == reshard["elements"]
+
+
+@pytest.mark.slow
+def test_fleet_serve_soak_mesh_quick_mode(tmp_path):
+    """The device-mesh soak (--mesh --quick, DESIGN.md §20): real
+    ``serve --mesh-devices`` workers through the router — every op
+    resolves ack-or-typed-reject per device count, lockstep bitwise
+    parity vs a single-device worker on the same op log, and zero
+    acked-op loss across SIGKILL + restore_durable of the mesh
+    worker."""
+    import fleet_serve_soak
+
+    out = str(tmp_path / "MESH_CURVE.json")
+    rc = fleet_serve_soak.main(["--mesh", "--quick", "--out", out])
+    assert rc == 0, "mesh soak failed (unresolved ops, parity " \
+                    "mismatch, or acked-op loss)"
+    with open(out) as f:
+        artifact = json.load(f)
+
+    curve = artifact["serve_curve"]
+    assert [leg["mesh_devices"] for leg in curve] == [1, 2]
+    for leg in curve:
+        assert leg["unresolved"] == 0, leg
+        assert leg["goodput"] > 0, leg
+        # the worker's own banner proves the subprocess really ran the
+        # requested mesh width (a silently-single-device worker would
+        # make every other assertion vacuous)
+        assert leg["worker_banner_mesh"] == str(leg["mesh_devices"])
+
+    parity = artifact["parity"]
+    assert parity["bitwise_equal"], parity
+    assert parity["mismatched_fields"] == []
+    assert parity["ops"] > parity["elements"]  # deletes rode along
+
+    crash = artifact["crash"]
+    assert crash["outage"]["typed_unavailable"] > 0, crash
+    assert crash["outage"]["unresolved"] == 0, crash
+    assert crash["victim_acked_before_kill"] > 0
+    assert crash["lost_acked_ops"] == []
+    assert crash["phantom_members"] == []
+    assert crash["unfinished"] == []
+    assert crash["final_members"] == crash["elements"]

@@ -1,0 +1,338 @@
+"""Device-mesh replica tier (parallel/meshtarget.py, DESIGN.md §20).
+
+The correctness story is BITWISE: a ``MeshApplyTarget`` fed the same
+batches as a plain single-device ``Node`` must produce identical state,
+identical WAL record bytes, identical digest summaries, and identical
+slice-transfer payloads — on every mesh size, including the 1-device
+degenerate case.  The multi-device coverage is real: tests/conftest.py
+forces ``--xla_force_host_platform_device_count=8`` before jax loads,
+and ``test_mesh_tests_saw_multiple_devices`` pins that the flag
+actually took (skip-not-pass when absent, so a stripped-down runner
+can't silently demote every mesh test to single-device).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from go_crdt_playground_tpu.net.peer import Node
+from go_crdt_playground_tpu.parallel.meshtarget import (BATCH_AXIS,
+                                                        MeshApplyTarget,
+                                                        make_batch_mesh)
+
+E, A, B = 1024, 4, 8
+
+
+def _random_batches(rng, n, e=E, add_p=0.01, del_p=0.005):
+    for _ in range(n):
+        yield (rng.random((B, e)) < add_p,
+               rng.random((B, e)) < del_p,
+               rng.random(B) < 0.85)
+
+
+def _assert_states_equal(a, b, context=""):
+    for name in a._fields:
+        xa, xb = np.asarray(getattr(a, name)), np.asarray(getattr(b, name))
+        assert np.array_equal(xa, xb), (context, name, xa, xb)
+
+
+# ---------------------------------------------------------------------------
+# the multi-device guarantee itself
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_tests_saw_multiple_devices():
+    """The whole file proves nothing about sharding if the forced
+    host-device-count flag silently failed to take: pin >1 device
+    whenever the flag is present, SKIP (never pass) when it is not —
+    a runner without the flag must show a skip in its report, not a
+    green checkmark over single-device runs."""
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        pytest.skip("forced host device count flag absent — mesh tests "
+                    "ran single-device")
+    assert jax.device_count() > 1, (
+        "XLA_FLAGS requested forced host devices but jax saw "
+        f"{jax.device_count()} — the flag was set after jax "
+        "initialized?")
+
+
+def test_make_batch_mesh_shapes_and_bounds():
+    mesh = make_batch_mesh(1)
+    assert mesh.shape[BATCH_AXIS] == 1
+    n = jax.device_count()
+    assert make_batch_mesh(None).shape[BATCH_AXIS] == n
+    with pytest.raises(ValueError):
+        make_batch_mesh(n + 1)
+    with pytest.raises(ValueError):
+        make_batch_mesh(0)
+
+
+def test_mesh_requires_divisible_universe():
+    if jax.device_count() < 2:
+        pytest.skip("needs >1 device")
+    with pytest.raises(ValueError):
+        MeshApplyTarget(0, 1023, A, mesh_devices=2)
+
+
+def test_state_actually_sharded():
+    if jax.device_count() < 2:
+        pytest.skip("needs >1 device")
+    mesh = MeshApplyTarget(0, E, A, mesh_devices=2)
+    spec = mesh._state.present.sharding.spec
+    assert tuple(spec) == (None, BATCH_AXIS)
+    # lane fields shard; the clocks replicate
+    assert tuple(mesh._state.vv.sharding.spec) in ((None, None), ())
+    # two devices actually hold lane data
+    assert len(mesh._state.present.sharding.device_set) == 2
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity vs the single-device node
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("devices", [1, 2, 4, 8])
+def test_ingest_bitwise_parity(devices):
+    if jax.device_count() < devices:
+        pytest.skip(f"needs {devices} devices")
+    rng = np.random.default_rng(11)
+    plain = Node(0, E, A)
+    mesh = MeshApplyTarget(0, E, A, mesh_devices=devices)
+    for add, dl, live in _random_batches(rng, 5):
+        plain.ingest_batch(add, dl, live)
+        mesh.ingest_batch(add, dl, live)
+    _assert_states_equal(plain.state_slice(), mesh.state_slice(),
+                         f"devices={devices}")
+
+
+def test_wal_records_bitwise_identical(tmp_path):
+    """Same batches ⇒ byte-identical WAL records: the mesh δ pull +
+    host-side compact/dense ladder must encode exactly what the fused
+    single-device path logs (replay compatibility is free once the
+    bytes match)."""
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    devices = min(jax.device_count(), 8)
+    rng = np.random.default_rng(12)
+    plain = Node(0, E, A, wal=DeltaWal(str(tmp_path / "wp")))
+    mesh = MeshApplyTarget(0, E, A, mesh_devices=devices,
+                           wal=DeltaWal(str(tmp_path / "wm")))
+    for add, dl, live in _random_batches(rng, 4, add_p=0.02):
+        plain.ingest_batch(add, dl, live)
+        mesh.ingest_batch(add, dl, live)
+    with plain._lock:
+        rp = list(plain.wal.records())
+    with mesh._lock:
+        rm = list(mesh.wal.records())
+    assert rp == rm and len(rm) == 4
+    # one compiled dispatch per batch on the mesh path
+    # (the recorder was None here; pin via a fresh recorded node)
+    from go_crdt_playground_tpu.obs import Recorder
+
+    rec = Recorder()
+    m2 = MeshApplyTarget(0, E, A, mesh_devices=devices, recorder=rec,
+                         wal=DeltaWal(str(tmp_path / "w2")))
+    add, dl, live = next(_random_batches(rng, 1))
+    m2.ingest_batch(add, dl, live)
+    assert rec.snapshot()["counters"]["ingest.dispatches"] == 1
+
+
+def test_digest_summary_parity_and_collective_kernel():
+    """The collective digest read must be bitwise the single-device
+    kernel's output — on the aligned path (shard-local folds) AND the
+    misaligned fallback (E/devices not a multiple of the group)."""
+    from go_crdt_playground_tpu.ops.digest import state_group_digests
+
+    devices = min(jax.device_count(), 8)
+    rng = np.random.default_rng(13)
+    plain = Node(0, E, A)
+    mesh = MeshApplyTarget(0, E, A, mesh_devices=devices)
+    for add, dl, live in _random_batches(rng, 3):
+        plain.ingest_batch(add, dl, live)
+        mesh.ingest_batch(add, dl, live)
+    sp, sm = plain.state_slice(), mesh.state_slice()
+    for gs in (64, 128):
+        assert np.array_equal(np.asarray(state_group_digests(sp, gs)),
+                              np.asarray(mesh._digest_fn(sm, gs))), gs
+    # misaligned: 8 devices over E=256 leaves 32-lane shards under a
+    # 64-lane group — the fallback must still match bitwise
+    if devices >= 2:
+        p2, m2 = Node(0, 256, A), MeshApplyTarget(0, 256, A,
+                                                  mesh_devices=devices)
+        for add, dl, live in _random_batches(rng, 2, e=256, add_p=0.05):
+            p2.ingest_batch(add, dl, live)
+            m2.ingest_batch(add, dl, live)
+        assert np.array_equal(
+            np.asarray(state_group_digests(p2.state_slice(), 64)),
+            np.asarray(m2._digest_fn(m2.state_slice(), 64)))
+    # the summary frame itself round-trips through the digestsync codec
+    from go_crdt_playground_tpu.net import digestsync
+
+    body = mesh.digest_summary()
+    actor, gs, vv, processed, digests = digestsync.decode_summary(
+        body, E, A)
+    assert actor == 0 and gs == 64
+    assert np.array_equal(vv, np.asarray(sm.vv))
+
+
+def test_slice_extract_and_apply_parity():
+    """Handoff both halves: the mesh donor's lane-gather payload must
+    be byte-identical to the dense single-device extraction, and a
+    mesh recipient applying it must land bitwise where a plain node
+    lands (including the re-pin to canonical placement)."""
+    devices = min(jax.device_count(), 8)
+    rng = np.random.default_rng(14)
+    plain = Node(0, E, A)
+    mesh = MeshApplyTarget(0, E, A, mesh_devices=devices)
+    for add, dl, live in _random_batches(rng, 3, add_p=0.03):
+        plain.ingest_batch(add, dl, live)
+        mesh.ingest_batch(add, dl, live)
+    mask = np.zeros(E, bool)
+    mask[rng.choice(E, 100, replace=False)] = True
+    body_plain = plain.extract_slice(mask)
+    body_mesh = mesh.extract_slice(mask)
+    assert body_plain == body_mesh
+    # recipients (fresh, different actor) apply the same bytes
+    rp = Node(1, E, A)
+    rm = MeshApplyTarget(1, E, A, mesh_devices=devices)
+    rp.apply_payload_body(body_plain)
+    rm.apply_payload_body(body_mesh)
+    _assert_states_equal(rp.state_slice(), rm.state_slice(), "recipient")
+    assert tuple(rm._state.present.sharding.spec) == (None, BATCH_AXIS)
+
+
+def test_sync_exchange_between_mesh_and_plain(tmp_path):
+    """Anti-entropy runs UNCHANGED against the mesh target: a mesh
+    node and a plain node converge over a real socket exchange in both
+    the delta and digest regimes."""
+    from go_crdt_playground_tpu.net import digestsync
+
+    devices = min(jax.device_count(), 8)
+    mesh = MeshApplyTarget(0, E, A, mesh_devices=devices)
+    plain = Node(1, E, A)
+    mesh.add(1, 2, 3)
+    plain.add(500, 501)
+    plain.delete(501)
+    addr = plain.serve()
+    try:
+        mesh.sync_with(addr)
+        mesh.sync_with(addr)  # second round: plain absorbed ours
+        assert mesh.members().tolist() == [1, 2, 3, 500]
+        assert plain.members().tolist() == [1, 2, 3, 500]
+        # digest regime over the same listener
+        mesh.add(7)
+        stats = digestsync.sync_digest(mesh, addr)
+        assert stats.groups_mismatched >= 1
+        stats = digestsync.sync_digest(mesh, addr)
+        assert stats.quiescent
+    finally:
+        plain.close()
+
+
+# ---------------------------------------------------------------------------
+# the 1-device degenerate case (satellite): frontend slice verbs ride
+# the same code path the CRDT_SERVE_CRASH_ON_SLICE hooks arm
+# ---------------------------------------------------------------------------
+
+
+def test_single_device_frontend_degenerates_bitwise(tmp_path):
+    """A ``--mesh-devices 1`` frontend must be observationally AND
+    bitwise the plain frontend: same acks, same members, same durable
+    state on disk, and the slice-transfer verbs (the path the
+    ``CRDT_SERVE_CRASH_ON_SLICE`` kill hooks arm in the reshard soak)
+    produce identical payload bytes."""
+    from go_crdt_playground_tpu.serve.client import ServeClient
+    from go_crdt_playground_tpu.serve.frontend import ServeFrontend
+
+    fes = {}
+    for name, mesh_devices in (("plain", None), ("mesh1", 1)):
+        fe = ServeFrontend(256, A, actor=0,
+                           durable_dir=str(tmp_path / name),
+                           mesh_devices=mesh_devices, flush_ms=1.0)
+        fes[name] = (fe, fe.serve())
+    try:
+        for name, (fe, addr) in fes.items():
+            with ServeClient(addr) as c:
+                c.add(3, 9, 27)
+                c.add(81)
+                c.delete(9)
+                assert c.members()[0] == [3, 27, 81], name
+        # the slice verbs (SLICE_PULL donor read) — hook-armed path
+        elements = [3, 9, 27, 81, 100]
+        pulls = {}
+        for name, (fe, addr) in fes.items():
+            with ServeClient(addr) as c:
+                pulls[name] = c.slice_pull(elements)
+        assert pulls["plain"] == pulls["mesh1"]
+        # push the slice into both; states stay identical
+        for name, (fe, addr) in fes.items():
+            with ServeClient(addr) as c:
+                c.slice_push(pulls["plain"])
+        _assert_states_equal(fes["plain"][0].node.state_slice(),
+                             fes["mesh1"][0].node.state_slice(),
+                             "post-push")
+    finally:
+        for fe, _ in fes.values():
+            fe.close()
+    # durable restore of the mesh store with the PLAIN class (and vice
+    # versa) lands on the same state: the disk format carries no
+    # placement
+    r_plain = Node.restore_durable(str(tmp_path / "mesh1"))
+    r_mesh = MeshApplyTarget.restore_durable(
+        str(tmp_path / "plain"), node_kwargs={"mesh_devices": 1})
+    _assert_states_equal(r_plain.state_slice(), r_mesh.state_slice(),
+                         "cross-restore")
+
+
+def test_mesh_frontend_crash_on_slice_hook_subprocess(tmp_path):
+    """The kill-mid-handoff hook against a REAL mesh worker: a
+    ``serve --mesh-devices 2`` subprocess armed with
+    ``CRDT_SERVE_CRASH_ON_SLICE=pull`` dies at the donor read without
+    shipping state, and its durable restart serves every previously
+    acked op — the degenerate-fleet version of the reshard soak's
+    donor-death leg."""
+    import subprocess
+    import sys
+
+    from go_crdt_playground_tpu.serve.client import ServeClient
+    from go_crdt_playground_tpu.shard.fleet import _Proc, free_port
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = free_port()
+    argv = [sys.executable, "-m", "go_crdt_playground_tpu", "serve",
+            "--ingest", "--port", str(port), "--elements", "256",
+            "--actors", "2", "--mesh-devices", "2",
+            "--durable-dir", str(tmp_path / "state"),
+            "--flush-ms", "1"]
+    env = {"CRDT_SERVE_CRASH_ON_SLICE": "pull",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+    proc = _Proc(argv, cwd=repo, log_path=str(tmp_path / "w.log"),
+                 env=env)
+    try:
+        addr = proc.await_address()
+        with ServeClient(addr) as c:
+            c.add(1, 2, 3)
+            c.add(42)
+        with pytest.raises((ConnectionError, OSError)):
+            with ServeClient(addr) as c:
+                c.slice_pull([1, 2])
+        proc.proc.wait(timeout=30)
+    finally:
+        proc.close()
+    # restart WITHOUT the hook: durable acks must all be there
+    env2 = {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+    proc2 = _Proc(argv, cwd=repo, log_path=str(tmp_path / "w2.log"),
+                  env=env2, env_drop=("CRDT_SERVE_CRASH_ON_SLICE",))
+    try:
+        addr = proc2.await_address()
+        with ServeClient(addr) as c:
+            members, _ = c.members()
+            assert members == [1, 2, 3, 42]
+            # and the slice path now serves
+            assert len(c.slice_pull([1, 2])) > 0
+    finally:
+        proc2.close()

@@ -344,6 +344,10 @@ def test_live_join_and_leave_zero_loss(tmp_path, capsys):
                            "--leave", "s2"])
             assert rc == 0
             capsys.readouterr()  # swallow the CLI's JSON print
+            # the left shard's member-cache entry is evicted with its
+            # link (nothing would ever refresh it)
+            with fleet.router._member_cache_lock:
+                assert "s2" not in fleet.router._member_cache
             m2, _ = c.members()
             assert m2 == m, "leave lost/invented members"
             assert victim not in m2, "leave resurrected a deleted element"
@@ -487,3 +491,105 @@ def test_reshard_staging_failures_are_typed(fleet):
         with pytest.raises(ValueError):
             c.reshard(protocol.RESHARD_LEAVE, "s1", timeout=999.0)
         assert c.stats()["ring"] == ring0
+
+
+# ---------------------------------------------------------------------------
+# digest-guarded member cache (ROADMAP digest rung b, DESIGN.md §20)
+# ---------------------------------------------------------------------------
+
+
+def _cache_counters(router):
+    snap = router.recorder.snapshot()["counters"]
+    return (snap.get("router.member_cache.hits", 0),
+            snap.get("router.member_cache.refreshes", 0))
+
+
+def test_member_cache_hits_quiescent_refreshes_on_change(fleet):
+    """The O(diff) read contract: the first QUERY populates one cache
+    entry per shard, quiescent repeats serve every shard from cache
+    (summary compare only — no MEMBERS pull), and a write touching ONE
+    shard's keyspace refreshes exactly that shard's entry."""
+    with ServeClient(fleet.addr) as c:
+        c.add(1, 2, 3)
+        m1, vv1 = c.members()
+        assert _cache_counters(fleet.router) == (0, N_SHARDS)
+        # quiescent repeat: identical reply, all shards hit
+        m2, vv2 = c.members()
+        assert m2 == m1
+        np.testing.assert_array_equal(np.asarray(vv2), np.asarray(vv1))
+        assert _cache_counters(fleet.router) == (N_SHARDS, N_SHARDS)
+        # advance ONE shard: its key is stale, the others still hit
+        lone = fleet.owned_by("s0")[0]
+        c.add(lone)
+        m3, _ = c.members()
+        assert lone in m3
+        assert _cache_counters(fleet.router) == (
+            2 * N_SHARDS - 1, N_SHARDS + 1)
+
+
+def test_member_cache_legacy_shard_pinned_uncached(fleet):
+    """A pre-digest shard (DSUM answered with the legacy unexpected-
+    frame error) costs ONE failed probe — on a THROWAWAY dial, never
+    the shared link client (the legacy frontend ends the connection
+    on the unknown frame, which would tear down in-flight ops) — is
+    pinned to the uncached path for good, and never poisons the
+    other shards' caching.  The pin requires the TYPED classification
+    (_DsumUnsupported: the server's own MSG_ERROR) — a transient
+    error whose text merely contains the same words must not pin
+    (covered by the transient test below)."""
+    from go_crdt_playground_tpu.shard.router import _DsumUnsupported
+
+    link = fleet.router.links_snapshot()["s0"]
+    calls = {"n": 0}
+
+    def legacy_dsum():
+        calls["n"] += 1
+        raise _DsumUnsupported("shard s0 is pre-digest: unexpected "
+                               "frame type 32")
+
+    link.digest_summary_probe = legacy_dsum
+    with ServeClient(fleet.addr) as c:
+        c.add(1, 2, 3)
+        m1, _ = c.members()
+        assert calls["n"] == 1
+        assert "s0" in fleet.router._dsum_unsupported
+        m2, _ = c.members()
+        assert m2 == m1
+        assert calls["n"] == 1, "legacy shard probed more than once"
+    with fleet.router._member_cache_lock:
+        assert set(fleet.router._member_cache) == {"s1", "s2"}
+    assert _cache_counters(fleet.router) == (
+        N_SHARDS - 1, N_SHARDS - 1)
+
+
+def test_member_cache_transient_dsum_failure_stays_cacheable(fleet):
+    """A TRANSIENT summary failure (dead shard, torn link — anything
+    without the legacy-frame signature) must NOT pin the shard
+    uncached: the query falls through to members() for that round and
+    the next round probes the summary again."""
+    from go_crdt_playground_tpu.shard.router import _Unreachable
+
+    link = fleet.router.links_snapshot()["s0"]
+    real_probe = link.digest_summary_probe
+    calls = {"n": 0}
+
+    def flaky_probe():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # a desynced reply's locally-synthesized message CONTAINS
+            # the legacy text — the typed classification must still
+            # treat it as transient, never pin
+            raise _Unreachable("shard s0 dsum probe: server went "
+                               "away: unexpected frame type 9")
+        return real_probe()
+
+    link.digest_summary_probe = flaky_probe
+    with ServeClient(fleet.addr) as c:
+        c.add(1, 2, 3)
+        m1, _ = c.members()
+        assert "s0" not in fleet.router._dsum_unsupported
+        m2, _ = c.members()  # second round probes again and caches
+        assert m2 == m1
+    assert calls["n"] == 2
+    with fleet.router._member_cache_lock:
+        assert "s0" in fleet.router._member_cache

@@ -174,6 +174,20 @@ else
             BENCH_INGEST.json
 fi
 
+if on_tpu MESH_CURVE.json; then
+    step "mesh curve: already on chip, skipping"
+else
+    step "mesh curve (device-mesh replica tier kernels)"
+    # ISSUE 10: the kernel half of MESH_CURVE.json on real devices
+    # (the committed artifact records the CPU regime; run_mesh refuses
+    # a CPU-fallback overwrite once a TPU capture lands, and the
+    # soak's serve_curve/crash keys survive the merge)
+    timeout -k 10 900 $PY bench.py --mesh >> "$LOG" 2>&1
+    on_tpu MESH_CURVE.json && \
+        commit_if_changed "On-chip MESH_CURVE: lane-sharded ingest+δ and collective digest read vs device count" \
+            MESH_CURVE.json
+fi
+
 # Always refresh the static roofline model last: it joins measured
 # rates from whatever artifacts the steps above just landed (cheap,
 # no device needed).

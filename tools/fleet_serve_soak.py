@@ -39,12 +39,24 @@ single-node load generator runs against the fleet as-is.
   ack-or-typed-reject (``KeyspaceMoving`` during fences is the typed
   retryable contract), zero acked-op loss, zero phantoms.
 
-Output: SHARD_CURVE.json next to the other curves.
+* **mesh mode** (``--mesh``, DESIGN.md §20) — the device-mesh replica
+  tier at fleet scope: real ``serve --mesh-devices N`` workers behind
+  the router.  Per device count an open-loop goodput/p99 point; a
+  lockstep bitwise-parity leg (mesh worker vs single-device worker fed
+  the same op log — durable states diffed field-by-field after a
+  graceful drain); and a crash leg (SIGKILL the mesh worker
+  mid-stream, typed rejects during the outage, ``restore_durable``
+  restart, zero acked-op loss, zero phantoms).  Results merge into
+  MESH_CURVE.json alongside bench.py --mesh's kernel curve.
+
+Output: SHARD_CURVE.json next to the other curves (MESH_CURVE.json in
+--mesh mode).
 
 Usage:
     python tools/fleet_serve_soak.py            # full sweep
     python tools/fleet_serve_soak.py --quick    # CI-sized (slow-marked
                                                 # pytest wraps this)
+    python tools/fleet_serve_soak.py --mesh [--quick]   # mesh soak
     python tools/fleet_serve_soak.py --out P    # default SHARD_CURVE.json
 """
 
@@ -455,6 +467,312 @@ def adjudicate_reshard(leg: Dict[str, object], quick: bool) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# mesh legs (device-mesh replica tier, DESIGN.md §20) — `--mesh` mode
+# ---------------------------------------------------------------------------
+
+
+def _mesh_spec(devices: int, elements: int, seed: int,
+               **kw) -> FleetSpec:
+    """A 1-shard fleet whose worker runs ``serve --mesh-devices N``.
+    CPU workers need the forced host-device-count flag in their OWN
+    env (jax honors it only at process init); a worker that comes up
+    and prints its address PROVES the devices existed — mesh
+    construction refuses a mesh wider than the visible device set."""
+    extra_env = ()
+    if devices > 1:
+        extra_env = (("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count="
+                      f"{devices}"),)
+    return FleetSpec(n_shards=1, elements=elements, seed=seed,
+                     extra_args=("--mesh-devices", str(devices)),
+                     extra_env=extra_env, **kw)
+
+
+def _worker_mesh_banner(fleet: ShardFleet) -> str:
+    """The worker's self-reported mesh width, parsed from its serve
+    banner (the ``mesh=N`` field) — the artifact records what the
+    subprocess actually ran, not what we asked for."""
+    import re as _re
+
+    proc = fleet.shards[0]
+    with proc._line_cond:
+        lines = list(proc._lines)
+    for ln in lines:
+        m = _re.search(rb"mesh=(\w+)", ln)
+        if m:
+            return m.group(1).decode()
+    return ""
+
+
+def mesh_sweep_leg(root: str, devices: int, elements: int, rate: float,
+                   duration_s: float, seed: int) -> Dict[str, object]:
+    """One device count's open-loop point: a real ``serve
+    --mesh-devices N`` worker behind a real router, unmodified
+    ServeClient load.  On a 2-core CI box the CPU "devices" time-slice
+    the same cores, so the CURVE records the mesh path's goodput/p99
+    per width (regime documentation), not a scaling claim — the
+    on-chip capture rides tools/capture_all.sh."""
+    spec = _mesh_spec(devices, elements, seed)
+    fleet = ShardFleet(REPO, os.path.join(root, f"mesh-{devices}"), spec)
+    try:
+        addr = fleet.start()
+        leg = serve_soak.open_loop_leg(addr, rate, duration_s, elements)
+        leg["mesh_devices"] = devices
+        leg["worker_banner_mesh"] = _worker_mesh_banner(fleet)
+        return leg
+    finally:
+        fleet.close()
+
+
+def mesh_parity_leg(root: str, devices: int, elements: int,
+                    seed: int) -> Dict[str, object]:
+    """The bitwise pin at fleet scope: a mesh worker and a
+    single-device worker fed the SAME deterministic op log (serially,
+    through their routers) must land on byte-identical durable state
+    after a graceful drain.  The fleets run SEQUENTIALLY, one at a
+    time — run concurrently on a 2-core box, ack latency can cross the
+    router's downstream read deadline, and a slow-but-applied op comes
+    back as a typed reject whose retry applies it TWICE on one worker
+    (an at-least-once wrinkle the open-loop legs tolerate but a
+    bitwise-counter pin cannot).  Serial submission with generous
+    deadlines keeps every ack unambiguous; any retry is reported so a
+    mismatch stays diagnosable.  Compared by restoring BOTH durable
+    stores in-process — the disk format carries no placement — and
+    diffing every state field."""
+    import random
+
+    specs = {"mesh": _mesh_spec(devices, elements, seed, flush_ms=1.0),
+             "plain": FleetSpec(n_shards=1, elements=elements,
+                                seed=seed, flush_ms=1.0)}
+    roots = {k: os.path.join(root, f"parity-{k}") for k in specs}
+    rng = random.Random(seed + 1)
+    order = list(range(elements))
+    rng.shuffle(order)
+    ops: List = []
+    added: List[int] = []
+    for e in order:
+        ops.append((protocol.OP_ADD, e))
+        added.append(e)
+        if len(added) % 5 == 0:
+            # deletes ride along: the deletion-record lanes and their
+            # δ/WAL encoding are part of the parity surface
+            ops.append((protocol.OP_DEL,
+                        added[rng.randrange(len(added))]))
+    retries = 0
+    banner = ""
+    for name in ("mesh", "plain"):
+        fleet = ShardFleet(REPO, roots[name], specs[name])
+        try:
+            addr = fleet.start()
+            if name == "mesh":
+                banner = _worker_mesh_banner(fleet)
+            with ServeClient(addr, timeout=60.0) as c:
+                for kind, e in ops:
+                    while True:
+                        try:
+                            c.submit_async(
+                                kind, [e], deadline_s=30.0).wait(60.0)
+                            break
+                        except protocol.ServeError:
+                            retries += 1
+                            time.sleep(0.05)
+        finally:
+            fleet.close()  # graceful SIGTERM: drain + save_durable
+    # restore both stores in-process and diff bitwise
+    import numpy as np
+
+    from go_crdt_playground_tpu.net.peer import Node
+
+    states = {k: Node.restore_durable(
+        os.path.join(r, "s0", "state")).state_slice()
+        for k, r in roots.items()}
+    mismatched = [
+        name for name in states["mesh"]._fields
+        if not np.array_equal(np.asarray(getattr(states["mesh"], name)),
+                              np.asarray(getattr(states["plain"], name)))]
+    return {"mesh_devices": devices, "worker_banner_mesh": banner,
+            "elements": elements, "ops": len(ops), "retries": retries,
+            "bitwise_equal": not mismatched,
+            "mismatched_fields": mismatched}
+
+
+def mesh_crash_leg(root: str, devices: int, elements: int,
+                   seed: int) -> Dict[str, object]:
+    """The §14 contract against a mesh worker: ledgered add-only
+    traffic through the router, SIGKILL the worker MID-STREAM (its
+    keyspace degrades to typed ShardUnavailable), restart it on the
+    same port + durable dir (``restore_durable``: checkpoint ⊔ WAL
+    tail re-placed onto the mesh), resubmit, and adjudicate zero
+    acked-op loss + zero phantoms."""
+    import random
+
+    rng = random.Random(seed + 2)
+    spec = _mesh_spec(devices, elements, seed, flush_ms=1.0)
+    fleet = ShardFleet(REPO, os.path.join(root, "mesh-crash"), spec)
+    acked: Set[int] = set()
+    submitted: Set[int] = set()
+    outage = {"typed_unavailable": 0, "typed_other": 0, "unresolved": 0}
+    try:
+        addr = fleet.start()
+        todo = list(range(elements))
+        rng.shuffle(todo)
+        n_pre = int(0.4 * len(todo))
+        kill_at = n_pre + 1 + rng.randrange(max(1, len(todo) // 10))
+        client = ServeClient(addr, timeout=30.0)
+        try:
+            for n, e in enumerate(todo):
+                if n == kill_at:
+                    fleet.kill_shard(0)
+                submitted.add(e)
+                try:
+                    client.add(e, deadline_s=5.0)
+                    acked.add(e)
+                except protocol.ShardUnavailable:
+                    outage["typed_unavailable"] += 1
+                except protocol.ServeError:
+                    outage["typed_other"] += 1
+                except (OSError, ConnectionError, socket.timeout):
+                    outage["unresolved"] += 1
+        finally:
+            client.close()
+        acked_before_kill = len(acked)
+
+        fleet.restart_shard(0)
+        retry_deadline = time.monotonic() + 60.0
+        remaining = [e for e in todo if e not in acked]
+        retries = 0
+        while remaining and time.monotonic() < retry_deadline:
+            client = ServeClient(addr, timeout=30.0)
+            try:
+                still: List[int] = []
+                for e in remaining:
+                    try:
+                        client.add(e, deadline_s=5.0)
+                        acked.add(e)
+                    except (protocol.ServeError, OSError,
+                            ConnectionError, socket.timeout):
+                        still.append(e)
+                remaining = still
+            finally:
+                client.close()
+            if remaining:
+                retries += 1
+                time.sleep(0.25)  # breaker half-open probe cadence
+
+        with ServeClient(addr, timeout=60.0) as c:
+            members, _ = c.members()
+        members_set = set(members)
+        return {
+            "mesh_devices": devices,
+            "elements": elements,
+            "victim_acked_before_kill": acked_before_kill,
+            "outage": outage,
+            "resubmit_rounds": retries,
+            "acked_ops": len(acked),
+            "submitted_ops": len(submitted),
+            "final_members": len(members_set),
+            # MUST be []: an acked (fsync'd) op vanished across the
+            # SIGKILL + restore_durable restart of the mesh worker
+            "lost_acked_ops": sorted(acked - members_set),
+            # MUST be []: a member nobody submitted
+            "phantom_members": sorted(members_set - submitted),
+            "unfinished": sorted(set(todo) - acked),
+        }
+    finally:
+        fleet.close()
+
+
+def run_mesh_mode(args) -> int:
+    """`--mesh`: the device-mesh soak — goodput/p99 vs device count
+    through the router, the lockstep bitwise-parity leg, and the
+    SIGKILL + restore_durable crash leg.  Results MERGE into
+    MESH_CURVE.json alongside the kernel curve bench.py --mesh wrote
+    (the ``platform`` key stays the kernel capture's — the serve half
+    records its regime under ``serve_platform``: always "cpu", because
+    the fleet spawners force ``JAX_PLATFORMS=cpu`` into every worker
+    subprocess — the harness process's own backend says nothing about
+    what the workers meshed over)."""
+    if args.quick:
+        elements = 144
+        device_counts = [1, 2]
+        rate, duration_s = 400.0, 3.0
+    else:
+        elements = 288
+        device_counts = [1, 2, 4]
+        rate, duration_s = 800.0, 6.0
+    deep = device_counts[-1]
+
+    t0 = time.time()
+    root = tempfile.mkdtemp(prefix="mesh-serve-soak-")
+    serve_curve: List[Dict] = []
+    try:
+        for n in device_counts:
+            leg = mesh_sweep_leg(root, n, elements, rate, duration_s,
+                                 args.seed)
+            serve_curve.append(leg)
+            print(json.dumps(leg), flush=True)
+        parity = mesh_parity_leg(root, deep, elements, args.seed)
+        print(json.dumps({"mesh_parity": parity}), flush=True)
+        crash = mesh_crash_leg(root, deep, elements, args.seed)
+        print(json.dumps({"mesh_crash": {
+            k: crash[k] for k in ("outage", "acked_ops",
+                                  "victim_acked_before_kill",
+                                  "lost_acked_ops", "phantom_members",
+                                  "resubmit_rounds")}}), flush=True)
+    finally:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+    out = args.out or os.path.join(REPO, "MESH_CURVE.json")
+    prior: Dict = {}
+    if os.path.exists(out):
+        try:
+            with open(out) as f:
+                prior = json.load(f)
+        except ValueError:
+            prior = {}
+        if not isinstance(prior, dict):
+            prior = {}
+    artifact = dict(prior)
+    artifact.update({
+        "serve_metric": (
+            "mesh replica tier at fleet scope: goodput/p99 vs mesh "
+            "device count through a real router over a real `serve "
+            "--mesh-devices` worker, lockstep bitwise state parity vs "
+            "a single-device worker fed the same op log, and zero "
+            "acked-op loss across SIGKILL + restore_durable"),
+        # the worker regime, not the harness's backend (fleet.py and
+        # this file's proc spawners force JAX_PLATFORMS=cpu into every
+        # worker env)
+        "serve_platform": "cpu",
+        "serve_fleet": {"elements": elements, "offered_rate": rate,
+                        "duration_s": duration_s, "seed": args.seed,
+                        "quick": bool(args.quick)},
+        "serve_curve": serve_curve,
+        "parity": parity,
+        "crash": crash,
+        "serve_elapsed_s": round(time.time() - t0, 1),
+    })
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"wrote {out}")
+
+    ok = all(leg["unresolved"] == 0 and leg["goodput"] > 0
+             and leg["worker_banner_mesh"] == str(leg["mesh_devices"])
+             for leg in serve_curve)
+    ok = ok and parity["bitwise_equal"] and parity["ops"] > 0
+    ok = ok and crash["outage"]["typed_unavailable"] > 0
+    ok = ok and crash["outage"]["unresolved"] == 0
+    ok = ok and crash["victim_acked_before_kill"] > 0
+    ok = ok and crash["lost_acked_ops"] == []
+    ok = ok and crash["phantom_members"] == []
+    ok = ok and crash["unfinished"] == []
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
 # sweep
 # ---------------------------------------------------------------------------
 
@@ -465,9 +783,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--quick", action="store_true",
                     help="CI-sized sweep (the slow-marked pytest wrapper)")
-    ap.add_argument("--out", default=os.path.join(REPO, "SHARD_CURVE.json"))
+    ap.add_argument("--mesh", action="store_true",
+                    help="device-mesh soak instead of the shard sweep: "
+                         "goodput/p99 vs mesh device count + bitwise "
+                         "parity + crash leg, merged into "
+                         "MESH_CURVE.json (DESIGN.md §20)")
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default SHARD_CURVE.json, or "
+                         "MESH_CURVE.json with --mesh)")
     ap.add_argument("--seed", type=int, default=29)
     args = ap.parse_args(argv)
+
+    if args.mesh:
+        return run_mesh_mode(args)
+    args.out = args.out or os.path.join(REPO, "SHARD_CURVE.json")
 
     if args.quick:
         elements = 144
