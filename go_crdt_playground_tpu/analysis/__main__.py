@@ -4,7 +4,7 @@
     python -m go_crdt_playground_tpu.analysis --fast     # tier-1 budget
     python -m go_crdt_playground_tpu.analysis --out P    # report path
 
-Runs all four passes and writes ``ANALYSIS_REPORT.json``:
+Runs every registered pass and writes ``ANALYSIS_REPORT.json``:
 
 1. lock-discipline lint (``# guarded-by:`` + lock-order cycles) over
    the threaded runtime files;
@@ -13,11 +13,22 @@ Runs all four passes and writes ``ANALYSIS_REPORT.json``:
    covered on every gate run, not only under the opt-in soaks;
 3. durability-ordering lint over the WAL/checkpoint modules and the
    JAX-purity lint over ``ops/``;
-4. lattice-law property checks of every registered join.
+4. lattice-law property checks of every registered join (each family's
+   declared law subset — non-idempotent merge strategies like the
+   model-merging mean register fewer laws, never zero checks);
+5. the wire-contract suite: W001 dispatch exhaustiveness + W002
+   reject-code discipline + W004 frame-cap discipline
+   (``protocol_contract``), W003 codec symmetry with the seeded
+   roundtrip/truncation/garble harness (``codec_symmetry``), and the
+   M001 metrics contract (``metrics_contract``);
+6. report freshness: the COMMITTED ``ANALYSIS_REPORT.json``'s pass
+   list must match the registered passes — a new pass cannot land
+   while the committed artifact silently claims full coverage.
 
 Exit status: 0 iff no ERROR finding.  ``--fast`` trims the lattice
-seeds and the lockset exercise, not the pass list — every pass runs in
-every mode (tier-1 wires ``--fast`` in as a non-slow test).
+seeds, the codec sample counts, and the lockset exercise, not the
+pass list — every pass runs in every mode (tier-1 wires ``--fast`` in
+as a non-slow test).
 """
 
 from __future__ import annotations
@@ -76,6 +87,15 @@ ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "_route": "RouteState",
                 "compactor": "CompactionScheduler",
                 "_negotiator": "DigestNegotiator"}
+
+# the full pass list (report keys): the report-freshness lint pins the
+# COMMITTED artifact's pass list to this — landing a new pass without
+# regenerating ANALYSIS_REPORT.json fails the gate instead of letting
+# the committed artifact silently claim full coverage
+REGISTERED_PASSES = ("lockdiscipline", "locksets", "durability",
+                     "purity", "lattice_laws", "protocol_contract",
+                     "codec_symmetry", "metrics_contract",
+                     "report_freshness")
 
 
 def _paths(rel: List[str], root: str) -> List[str]:
@@ -149,10 +169,68 @@ def run_lockset_exercise(report, *, rounds: int = 200) -> None:
     report.add_stats("locksets", mode="gate-exercise", **stats)
 
 
+def check_report_freshness(report, committed_path: str,
+                           out_path: Optional[str] = None) -> None:
+    """The committed artifact's pass list must match the registered
+    passes (F001) — stale coverage claims fail the gate; regenerating
+    the artifact with the full gate is the documented fix.  When THIS
+    run's ``--out`` is the committed path itself, the run IS the
+    regeneration: the pre-run file is about to be superseded, so it is
+    recorded (mode=regenerating), never flagged — without this, the
+    documented fix command would exit 1 on its own first run and embed
+    a spurious stale-against-itself finding in the fresh artifact.
+    CI and the tier-1 test write to a separate --out, so staleness of
+    the committed file stays enforced where it matters."""
+    import json
+
+    from go_crdt_playground_tpu.analysis.report import (REPORT_STALE,
+                                                        SEVERITY_ERROR,
+                                                        Finding)
+
+    stats = {"registered": sorted(REGISTERED_PASSES),
+             "committed_path": committed_path}
+    if (out_path is not None and os.path.abspath(out_path)
+            == os.path.abspath(committed_path)):
+        report.add_stats("report_freshness", mode="regenerating",
+                         **stats)
+        return
+    if not os.path.exists(committed_path):
+        # a fresh clone mid-regeneration: the write at the end of this
+        # very run creates it — absence is not a stale claim
+        report.add_stats("report_freshness", committed=None, **stats)
+        return
+    try:
+        with open(committed_path) as f:
+            committed = sorted(json.load(f).get("passes", {}))
+    except (ValueError, OSError) as e:
+        report.extend([Finding(
+            analyzer="report_freshness", code=REPORT_STALE,
+            severity=SEVERITY_ERROR, path=committed_path,
+            message=f"committed ANALYSIS_REPORT.json unreadable: {e}")])
+        report.add_stats("report_freshness", committed=None, **stats)
+        return
+    report.add_stats("report_freshness", committed=committed, **stats)
+    if set(committed) != set(REGISTERED_PASSES):
+        missing = sorted(set(REGISTERED_PASSES) - set(committed))
+        extra = sorted(set(committed) - set(REGISTERED_PASSES))
+        report.extend([Finding(
+            analyzer="report_freshness", code=REPORT_STALE,
+            severity=SEVERITY_ERROR, path=committed_path,
+            message=(f"committed report's pass list is stale "
+                     f"(missing {missing}, extra {extra}) — "
+                     "regenerate it with the full gate: "
+                     "python -m go_crdt_playground_tpu.analysis"))])
+
+
 def build_report(fast: bool, root: str = PKG_ROOT,
-                 skip_runtime: bool = False):
-    from go_crdt_playground_tpu.analysis import (durability, lattice_laws,
-                                                 lockdiscipline, purity)
+                 skip_runtime: bool = False,
+                 committed_report: Optional[str] = None,
+                 out_path: Optional[str] = None):
+    from go_crdt_playground_tpu.analysis import (codec_symmetry,
+                                                 durability, lattice_laws,
+                                                 lockdiscipline,
+                                                 metrics_contract,
+                                                 protocol_contract, purity)
     from go_crdt_playground_tpu.analysis.report import Report
 
     report = Report()
@@ -184,6 +262,24 @@ def build_report(fast: bool, root: str = PKG_ROOT,
     report.extend(f4)
     report.add_stats("lattice_laws", **s4)
 
+    # the wire-contract suite (DESIGN.md §15 W001-W004 + M001)
+    f5, s5 = protocol_contract.analyze(root)
+    report.extend(f5)
+    report.add_stats("protocol_contract", **s5)
+
+    f6, s6 = codec_symmetry.analyze(root, fast=fast)
+    report.extend(f6)
+    report.add_stats("codec_symmetry", **s6)
+
+    f7, s7 = metrics_contract.analyze(root)
+    report.extend(f7)
+    report.add_stats("metrics_contract", **s7)
+
+    if committed_report is None:
+        committed_report = os.path.join(os.path.dirname(root),
+                                        "ANALYSIS_REPORT.json")
+    check_report_freshness(report, committed_report, out_path)
+
     if skip_runtime:
         report.add_stats("locksets", mode="skipped")
     else:
@@ -207,10 +303,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--skip-runtime", action="store_true",
                     help="skip the in-process lockset exercise (pass is "
                          "reported as skipped, not covered)")
+    ap.add_argument("--committed-report", default=None,
+                    help="committed ANALYSIS_REPORT.json the freshness "
+                         "lint checks (default: <repo>/"
+                         "ANALYSIS_REPORT.json next to the package)")
     args = ap.parse_args(argv)
 
     report = build_report(args.fast, root=args.root,
-                          skip_runtime=args.skip_runtime)
+                          skip_runtime=args.skip_runtime,
+                          committed_report=args.committed_report,
+                          out_path=args.out)
     report.write_json(args.out)
     for f in report.findings:
         print(f.render())

@@ -23,6 +23,21 @@ comment's line):
     # durable-on-return         function annotation: the durability lint
                                 requires an fsync to dominate the end of
                                 this function (its return IS the ack).
+    # protocol-ignore: <what> — <reason>
+                                wire-contract annotation (W001, analysis/
+                                protocol_contract.py).  On a ``MSG_*``
+                                constant's definition line, ``<what>`` is
+                                a direction keyword: ``reply`` (client-
+                                inbound — must have an arm in the client
+                                reader instead of the servers) or
+                                ``internal`` (consumed below dispatch,
+                                e.g. MSG_ERROR raised inside recv_frame).
+                                Inside a dispatcher function, ``<what>``
+                                names the MSG_* constant this dispatcher
+                                deliberately does not serve.  The reason
+                                is required either way — an unexplained
+                                hole in dispatch coverage is exactly the
+                                drift the pass exists to catch.
 
 ``<lock>`` names an attribute of the same object (``_lock``,
 ``_conn_slots``).  Parsing is tokenize-based so annotations survive any
@@ -43,15 +58,18 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 _ANNOT_RE = re.compile(
-    r"#\s*(guarded-by|requires-lock|race-ok|durable-on-return)\s*"
+    r"#\s*(guarded-by|requires-lock|race-ok|durable-on-return"
+    r"|protocol-ignore)\s*"
     r"(?::\s*(?P<arg>\S[^#]*?))?\s*$")
 
 KIND_GUARDED_BY = "guarded-by"
 KIND_REQUIRES_LOCK = "requires-lock"
 KIND_RACE_OK = "race-ok"
 KIND_DURABLE_ON_RETURN = "durable-on-return"
+KIND_PROTOCOL_IGNORE = "protocol-ignore"
 
-_ARG_REQUIRED = {KIND_GUARDED_BY, KIND_REQUIRES_LOCK, KIND_RACE_OK}
+_ARG_REQUIRED = {KIND_GUARDED_BY, KIND_REQUIRES_LOCK, KIND_RACE_OK,
+                 KIND_PROTOCOL_IGNORE}
 
 
 @dataclass
@@ -63,21 +81,35 @@ class Annotation:
 
 @dataclass
 class AnnotationSet:
-    """All annotations of one source file, indexed by line."""
+    """All annotations of one source file, indexed by line.
+
+    ``every`` keeps all annotations in source order and is what
+    ``on_lines`` searches — a statement can carry annotations of
+    different kinds (a guarded-by plus a trailing protocol-ignore),
+    and the wire-contract pass reads stacked ``protocol-ignore``
+    comments that attach to the same statement.  ``by_line`` keeps the
+    LAST annotation per line, retained for diagnostics only."""
 
     by_line: Dict[int, Annotation] = field(default_factory=dict)
+    every: List[Annotation] = field(default_factory=list)
     malformed: List[str] = field(default_factory=list)
 
     def on_lines(self, first: int, last: int,
                  kind: Optional[str] = None) -> Optional[Annotation]:
         """The annotation attached to a statement spanning [first, last]
-        (first match wins; statements conventionally annotate their
-        first line)."""
-        for ln in range(first, last + 1):
-            a = self.by_line.get(ln)
-            if a is not None and (kind is None or a.kind == kind):
-                return a
-        return None
+        (earliest line wins; statements conventionally annotate their
+        first line).  Searches ``every``, not the single-slot
+        ``by_line``: a statement can legitimately carry annotations of
+        DIFFERENT kinds (a guarded-by above it plus a trailing
+        protocol-ignore), and a kind-filtered lookup must never be
+        shadowed by the other kind landing on the same line."""
+        best: Optional[Annotation] = None
+        for a in self.every:
+            if (first <= a.line <= last
+                    and (kind is None or a.kind == kind)
+                    and (best is None or a.line < best.line)):
+                best = a
+        return best
 
 
 def parse_annotations(source: str, path: str = "<string>") -> AnnotationSet:
@@ -120,7 +152,8 @@ def parse_annotations(source: str, path: str = "<string>") -> AnnotationSet:
             # typo'd contract — silent skip would un-check the very
             # invariant the author tried to state.  Prose merely
             # mentioning a keyword mid-comment is left alone.
-            if re.match(r"#\s*(guarded-by|requires-lock|race-ok)\b",
+            if re.match(r"#\s*(guarded-by|requires-lock|race-ok"
+                        r"|protocol-ignore)\b",
                         text):
                 out.malformed.append(
                     f"{path}:{line}: malformed annotation {text.strip()!r}"
@@ -133,5 +166,7 @@ def parse_annotations(source: str, path: str = "<string>") -> AnnotationSet:
             out.malformed.append(
                 f"{path}:{line}: annotation '# {kind}:' needs an argument")
             continue
-        out.by_line[line] = Annotation(kind=kind, arg=arg, line=line)
+        ann = Annotation(kind=kind, arg=arg, line=line)
+        out.by_line[line] = ann
+        out.every.append(ann)
     return out

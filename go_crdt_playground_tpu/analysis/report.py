@@ -26,6 +26,15 @@ PURITY_VIOLATION = "P001"       # jit/Pallas-reachable host side effect
 LAW_COMMUTATIVITY = "J001"
 LAW_ASSOCIATIVITY = "J002"
 LAW_IDEMPOTENCE = "J003"
+LAW_DECLARATION = "J004"        # JoinSpec.laws empty or unknown
+# wire-contract passes (analysis/protocol_contract.py,
+# analysis/codec_symmetry.py, analysis/metrics_contract.py):
+DISPATCH_HOLE = "W001"          # MSG_* constant with no dispatcher arm
+REJECT_UNDISCIPLINED = "W002"   # reject code/exception registry drift
+CODEC_ASYMMETRY = "W003"        # encode/decode pair broke its contract
+FRAME_CAP_MISSING = "W004"      # recv_frame call site without max_body
+METRICS_CONTRACT = "M001"       # metric name referenced/emitted drift
+REPORT_STALE = "F001"           # committed report's pass list is stale
 
 
 @dataclass

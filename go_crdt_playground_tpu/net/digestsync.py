@@ -357,7 +357,8 @@ def serve_digest_exchange(node, conn: socket.socket,
     recv = framing.frame_size(len(summary_body))
     node.note_peer_processed(peer_actor, peer_processed)
     msg_type, body = framing.recv_frame(conn,
-                                        timeout=node.conn_timeout_s)
+                                        timeout=node.conn_timeout_s,
+                                        max_body=node._frame_cap)
     if msg_type != MSG_PAYLOAD:
         framing.send_frame(conn, framing.MSG_ERROR,
                            f"expected PAYLOAD, got {msg_type}".encode())
@@ -417,8 +418,8 @@ def sync_digest(node, addr: Addr, timeout: float = 30.0, *,
         try:
             sent = framing.send_frame(sock, MSG_DIGEST, my_summary)
             try:
-                msg_type, body = framing.recv_frame(sock,
-                                                    timeout=timeout)
+                msg_type, body = framing.recv_frame(
+                    sock, timeout=timeout, max_body=node._frame_cap)
             except framing.RemoteError as e:
                 if "expected HELLO" in str(e):
                     # a pre-digest peer: negotiation outcome, not a
@@ -441,7 +442,8 @@ def sync_digest(node, addr: Addr, timeout: float = 30.0, *,
                     node, peer_vv, peer_digests, group_size)
             phase = "payload"
             sent += framing.send_frame(sock, MSG_PAYLOAD, out)
-            msg_type, body = framing.recv_frame(sock, timeout=timeout)
+            msg_type, body = framing.recv_frame(
+                sock, timeout=timeout, max_body=node._frame_cap)
             if msg_type != MSG_PAYLOAD:
                 raise ProtocolError(f"expected PAYLOAD, got {msg_type}")
             recv += framing.frame_size(len(body))

@@ -29,6 +29,8 @@ MAGIC = b"\xc7\xd1"
 
 MSG_HELLO = 1
 MSG_PAYLOAD = 2
+# protocol-ignore: internal — recv_frame raises it as RemoteError
+# before any dispatcher sees a frame type
 MSG_ERROR = 3
 # digest-driven anti-entropy (DESIGN.md §19): the opening frame of a
 # digest exchange carries a compact summary — vv + processed + packed
@@ -57,6 +59,17 @@ MODE_SLICE = 2
 MODE_DIGEST = 3
 
 _MAX_BODY = 1 << 30
+
+
+def peer_frame_cap(num_elements: int, num_actors: int) -> int:
+    """The explicit ``max_body`` for peer-dialect frames (W004 frame-cap
+    discipline, DESIGN.md §15): the largest legal body is a dense FULL
+    payload — two E/8-byte section bitmasks plus at most ~10 varint
+    bytes per set lane per section, plus vv sections — so
+    ``32·E + 8·A + 64KB`` bounds every legal HELLO / DIGEST summary /
+    PAYLOAD body with slack while keeping a hostile length header from
+    committing a reader to the 1GB codec ceiling."""
+    return 32 * int(num_elements) + 8 * int(num_actors) + (1 << 16)
 
 
 class ProtocolError(RuntimeError):

@@ -213,6 +213,13 @@ class Node:
             self.HELLO_TIMEOUT_S if hello_timeout_s is None
             else hello_timeout_s,
             self.conn_timeout_s)
+        # explicit per-frame body cap for every peer-dialect read (W004
+        # frame-cap discipline): sized to the dense FULL payload, so a
+        # hostile length header can never balloon a reader to the codec
+        # ceiling.
+        # race-ok: read-only after __init__
+        self._frame_cap = framing.peer_frame_cap(num_elements,
+                                                 num_actors)
         self._conn_slots = threading.BoundedSemaphore(
             self.MAX_CONNS if max_conns is None else max_conns)
 
@@ -841,7 +848,8 @@ class Node:
                 # timeout window — must release their slot quickly (a
                 # real client sends HELLO immediately on connect)
                 msg_type, body = framing.recv_frame(
-                    conn, timeout=self.hello_timeout_s)
+                    conn, timeout=self.hello_timeout_s,
+                    max_body=self._frame_cap)
                 if msg_type == framing.MSG_DIGEST:
                     # digest-driven anti-entropy (DESIGN.md §19): the
                     # whole exchange is the tier's job — summary for
@@ -873,7 +881,8 @@ class Node:
                 # every byte, so a post-HELLO trickler would otherwise
                 # hold the slot indefinitely
                 msg_type, body = framing.recv_frame(
-                    conn, timeout=self.conn_timeout_s)
+                    conn, timeout=self.conn_timeout_s,
+                    max_body=self._frame_cap)
                 if msg_type != MSG_PAYLOAD:
                     framing.send_frame(conn, framing.MSG_ERROR,
                                        f"expected PAYLOAD, got {msg_type}"
@@ -1182,7 +1191,8 @@ class Node:
                 sent = framing.send_frame(
                     sock, MSG_HELLO, framing.encode_hello(
                         self.actor, self.num_elements, adv_vv))
-                msg_type, body = framing.recv_frame(sock, timeout=hello_t)
+                msg_type, body = framing.recv_frame(
+                    sock, timeout=hello_t, max_body=self._frame_cap)
                 if msg_type != MSG_HELLO:
                     raise ProtocolError(f"expected HELLO, got {msg_type}")
                 _, peer_vv = framing.decode_hello(
@@ -1192,7 +1202,8 @@ class Node:
                     mode_sent, out = self._extract_msg(peer_vv)
                 phase = "payload"
                 sent += framing.send_frame(sock, MSG_PAYLOAD, out)
-                msg_type, body = framing.recv_frame(sock, timeout=timeout)
+                msg_type, body = framing.recv_frame(
+                    sock, timeout=timeout, max_body=self._frame_cap)
                 if msg_type != MSG_PAYLOAD:
                     raise ProtocolError(f"expected PAYLOAD, got {msg_type}")
                 recv += framing.frame_size(len(body))

@@ -14,7 +14,7 @@ config 2 measures it at 1K replicas.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, NamedTuple
+from typing import Any, Callable, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -360,6 +360,86 @@ def ormap_join(dst: ORMapState, src: ORMapState) -> ORMapState:
 
 
 # ---------------------------------------------------------------------------
+# Model-merging joins over float weight lanes (ROADMAP: "CRDTs for
+# Neural Network Model Merging", arxiv 2605.19373)
+# ---------------------------------------------------------------------------
+#
+# Weight merging treats a model's parameter tensor as CRDT state and a
+# merge strategy as the join — the first genuinely TPU-shaped workload
+# on this substrate (float lanes sharded like the AWSet element axis,
+# PR 10's mesh target).  Three strategies register here, each with its
+# HONEST law subset (JoinSpec.laws):
+#
+# * elementwise max  — a true lattice join (all three laws, exact):
+#   convergent under any gossip schedule, the analyzer's full J001-J003
+#   treatment for free.
+# * elementwise mean — commutative ONLY: mean(mean(a,b),c) weights a
+#   and b at 1/4 against c's 1/2, and mean(a,a) == a holds but
+#   re-merging a stale copy mid-stream re-weights history.  Usable as a
+#   pairwise merge STEP (the paper's iterative schedules), not as
+#   anti-entropy: delivery order and multiplicity are semantics.
+# * weighted average — the running-sum form (Σwᵢxᵢ, Σwᵢ): commutative
+#   and associative (up to IEEE rounding — checked at atol), NOT
+#   idempotent: joining a state with itself double-counts every
+#   contribution.  Convergent under EXACTLY-ONCE op delivery (each
+#   contribution applied once per replica — the op-based regime of the
+#   semidirect-product composition line, arxiv 2004.04303), which is
+#   what the serve frontend's idempotence story must NOT be assumed to
+#   cover; the declared law subset records exactly that.
+
+
+class TensorMergeState(NamedTuple):
+    w: jnp.ndarray  # float32[R, D] weight lanes
+
+
+def tensormerge_init(num_replicas: int, dim: int) -> TensorMergeState:
+    return TensorMergeState(
+        w=jnp.zeros((num_replicas, dim), jnp.float32))
+
+
+def tensor_max_join(dst: TensorMergeState,
+                    src: TensorMergeState) -> TensorMergeState:
+    """Elementwise max over weight lanes — a real lattice join."""
+    return dst._replace(w=jnp.maximum(dst.w, src.w))
+
+
+def tensor_mean_join(dst: TensorMergeState,
+                     src: TensorMergeState) -> TensorMergeState:
+    """Pairwise elementwise mean — a merge STEP, not a lattice join
+    (commutative only; see the section comment)."""
+    return dst._replace(w=(dst.w + src.w) * jnp.float32(0.5))
+
+
+class WeightedMergeState(NamedTuple):
+    """Weighted-average merging in running-sum form: ``acc`` carries
+    Σ weightᵢ·xᵢ per lane, ``weight`` Σ weightᵢ per replica — the
+    grow-only-pair shape that makes the average order-free."""
+
+    acc: jnp.ndarray     # float32[R, D]
+    weight: jnp.ndarray  # float32[R, 1]
+
+
+def weightedmerge_init(num_replicas: int, dim: int) -> WeightedMergeState:
+    return WeightedMergeState(
+        acc=jnp.zeros((num_replicas, dim), jnp.float32),
+        weight=jnp.zeros((num_replicas, 1), jnp.float32))
+
+
+def weighted_mean_join(dst: WeightedMergeState,
+                       src: WeightedMergeState) -> WeightedMergeState:
+    return WeightedMergeState(acc=dst.acc + src.acc,
+                              weight=dst.weight + src.weight)
+
+
+def weighted_mean_value(state: WeightedMergeState) -> np.ndarray:
+    """The merged model: acc/weight per lane (host-side observer;
+    zero-weight replicas read as zero, not NaN)."""
+    acc = np.asarray(state.acc, np.float64)
+    w = np.asarray(state.weight, np.float64)
+    return np.where(w > 0, acc / np.maximum(w, 1e-30), 0.0)
+
+
+# ---------------------------------------------------------------------------
 # Generic batched rounds (any of the joins above)
 # ---------------------------------------------------------------------------
 
@@ -381,6 +461,9 @@ def gossip_round(join_fn, state, perm):
 # ---------------------------------------------------------------------------
 
 
+ALL_LAWS = ("commutativity", "associativity", "idempotence")
+
+
 class JoinSpec(NamedTuple):
     """One registered join, packaged for property checking.
 
@@ -393,12 +476,25 @@ class JoinSpec(NamedTuple):
     on; families whose non-observable metadata is order-sensitive by
     documented design (the AWSet stale-dot-overwrite quirk, merge.py)
     exclude it here, exactly as the crash soak's convergence digest
-    does."""
+    does.
+
+    ``laws`` is the family's DECLARED law subset — the model-merging
+    strategies (arxiv 2605.19373) register joins that are deliberately
+    not lattice joins (mean is not associative or idempotent; weighted
+    accumulation is not idempotent), and recording the subset keeps
+    them inside the J001-J003 pass instead of skipping it: the laws a
+    family claims are still property-checked, and the report shows
+    which were claimed.  ``atol`` switches the comparison to a
+    float tolerance (0 = exact) for joins whose claimed laws hold only
+    up to IEEE rounding (float addition is bitwise commutative but not
+    bitwise associative)."""
 
     name: str
     sample: Callable[[np.random.Generator, int, int], Any]
     join: Callable[[Any, Any], Any]
     project: Callable[[Any], Dict[str, np.ndarray]]
+    laws: Tuple[str, ...] = ALL_LAWS
+    atol: float = 0.0
 
 
 JOIN_REGISTRY: Dict[str, JoinSpec] = {}
@@ -522,6 +618,53 @@ def _sample_ormap(rng: np.random.Generator, n: int, n_ops: int):
     return state
 
 
+_SAMPLE_DIM = 16  # weight-lane universe of the model-merging samplers
+
+
+def _sample_tensor_merge(join_fn):
+    """Reachable-state sampler for the float-lane families: seeded
+    local 'train steps' (row perturbations) interleaved with gossip
+    mixing through the join itself."""
+
+    def sample(rng: np.random.Generator, n: int, n_ops: int):
+        state = TensorMergeState(w=jnp.asarray(
+            rng.normal(0.0, 1.0, (n, _SAMPLE_DIM)).astype(np.float32)))
+        for _ in range(n_ops):
+            if rng.random() < 0.6:
+                r = int(rng.integers(n))
+                step = jnp.asarray(
+                    rng.normal(0.0, 0.5, _SAMPLE_DIM)
+                    .astype(np.float32))
+                state = state._replace(w=state.w.at[r].add(step))
+            else:
+                state = mix_rows(join_fn, state, rng)
+        return state
+
+    return sample
+
+
+def _sample_weighted_merge(rng: np.random.Generator, n: int,
+                           n_ops: int):
+    # start from one weighted contribution per replica, then keep
+    # contributing (acc += w·x, weight += w — the op) and mixing
+    w0 = rng.uniform(0.1, 2.0, (n, 1)).astype(np.float32)
+    x0 = rng.normal(0.0, 1.0, (n, _SAMPLE_DIM)).astype(np.float32)
+    state = WeightedMergeState(acc=jnp.asarray(w0 * x0),
+                               weight=jnp.asarray(w0))
+    for _ in range(n_ops):
+        if rng.random() < 0.6:
+            r = int(rng.integers(n))
+            w = float(rng.uniform(0.1, 2.0))
+            x = rng.normal(0.0, 1.0, _SAMPLE_DIM).astype(np.float32)
+            state = WeightedMergeState(
+                acc=state.acc.at[r].add(jnp.asarray(
+                    (w * x).astype(np.float32))),
+                weight=state.weight.at[r, 0].add(jnp.float32(w)))
+        else:
+            state = mix_rows(weighted_mean_join, state, rng)
+    return state
+
+
 def _np_fields(state, names) -> Dict[str, np.ndarray]:
     return {f: np.asarray(getattr(state, f)) for f in names}
 
@@ -545,3 +688,17 @@ register_join(JoinSpec(
     "ormap", _sample_ormap, ormap_join,
     # membership + cells; dot metadata excluded (AWSet overwrite quirk)
     lambda s: _np_fields(s, ("vv", "present", "ts", "wr_actor", "val"))))
+# model-merging strategies, each with its HONEST law subset (the
+# section comment above documents why mean/weighted claim fewer laws —
+# recorded via JoinSpec.laws, never by skipping the pass)
+register_join(JoinSpec(
+    "tensor_max", _sample_tensor_merge(tensor_max_join),
+    tensor_max_join, lambda s: _np_fields(s, ("w",))))
+register_join(JoinSpec(
+    "tensor_mean", _sample_tensor_merge(tensor_mean_join),
+    tensor_mean_join, lambda s: _np_fields(s, ("w",)),
+    laws=("commutativity",)))
+register_join(JoinSpec(
+    "weighted_mean", _sample_weighted_merge, weighted_mean_join,
+    lambda s: _np_fields(s, ("acc", "weight")),
+    laws=("commutativity", "associativity"), atol=1e-3))

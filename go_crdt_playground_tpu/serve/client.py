@@ -76,13 +76,30 @@ class PendingOp:
 class ServeClient:
     """One pipelined connection to a ``ServeFrontend``."""
 
+    # explicit reply-body cap (W004 frame-cap discipline): the largest
+    # legal reply is a SLICE_STATE payload, which scales with the
+    # universe the client does not know — 64MB covers a dense slice of
+    # an ~E=3M universe with slack, while a garbled/hostile length
+    # header can no longer commit the reader thread to buffering the
+    # codec's 1GB ceiling (pre-fix, this was the ONLY serve-dialect
+    # endpoint reading frames with no cap at all)
+    MAX_REPLY_BODY = 64 << 20
+
     def __init__(self, addr: Tuple[str, int], timeout: float = 30.0,
                  on_result: Optional[Callable[[PendingOp], None]] = None,
-                 connect_timeout: Optional[float] = None):
+                 connect_timeout: Optional[float] = None,
+                 max_reply_body: Optional[int] = None):
         """``connect_timeout`` bounds the DIAL separately from the
         reply ``timeout`` (a router probing a blackholed shard needs a
-        short dial bound without shortening reply waits)."""
+        short dial bound without shortening reply waits).
+        ``max_reply_body`` overrides ``MAX_REPLY_BODY`` for
+        deployments whose slice replies outgrow the default (the cap
+        is a DoS bound, not a protocol limit — size it to the
+        universe like the server side's per-verb caps)."""
         self.timeout = timeout
+        self.max_reply_body = (self.MAX_REPLY_BODY
+                               if max_reply_body is None
+                               else int(max_reply_body))
         self._on_result = on_result
         self._sock = socket.create_connection(
             addr, timeout=timeout if connect_timeout is None
@@ -274,7 +291,8 @@ class ServeClient:
         err: BaseException = ConnectionError("connection closed")
         try:
             while True:
-                msg_type, body = framing.recv_frame(self._sock)
+                msg_type, body = framing.recv_frame(
+                    self._sock, max_body=self.max_reply_body)
                 now = time.monotonic()
                 if msg_type == protocol.MSG_ACK:
                     req_id = protocol.decode_ack(body)

@@ -324,6 +324,8 @@ class ServeFrontend:
             return self._handle_gc(session, body)
         if msg_type == protocol.MSG_DSUM:
             return self._handle_dsum(session, body)
+        # protocol-ignore: MSG_RESHARD — router-only admin verb; a
+        # frontend answers it with the typed unknown-frame error below
         session.send(framing.MSG_ERROR,
                      f"unexpected frame type {msg_type}".encode())
         return False

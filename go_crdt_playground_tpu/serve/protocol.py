@@ -58,14 +58,19 @@ import numpy as np
 from go_crdt_playground_tpu.net.framing import ProtocolError
 from go_crdt_playground_tpu.utils import wire
 
-# message types (>= 16: disjoint from net/framing's HELLO/PAYLOAD/ERROR)
+# message types (>= 16: disjoint from net/framing's HELLO/PAYLOAD/ERROR).
+# Direction is machine-checked (W001, analysis/protocol_contract.py):
+# a constant carrying the reply-direction ignore annotation is
+# client-inbound and must have an arm in the ServeClient reader;
+# everything unannotated is server-inbound and must have an arm (or a
+# dispatcher-scoped ignore) in EVERY registered server dispatcher.
 MSG_OP = 16
-MSG_ACK = 17
-MSG_REJECT = 18
+MSG_ACK = 17  # protocol-ignore: reply — op acked (ServeClient reader)
+MSG_REJECT = 18  # protocol-ignore: reply — typed shed (client reader)
 MSG_QUERY = 19
-MSG_MEMBERS = 20
+MSG_MEMBERS = 20  # protocol-ignore: reply — QUERY answer (client reader)
 MSG_STATS = 21
-MSG_STATS_REPLY = 22
+MSG_STATS_REPLY = 22  # protocol-ignore: reply — STATS answer
 # live-resharding verbs (DESIGN.md §18).  RESHARD is the router-side
 # admin verb (join/leave a shard); SLICE_PULL/SLICE_STATE/SLICE_PUSH are
 # the keyspace-handoff transfer the router drives against shard
@@ -76,9 +81,9 @@ MSG_STATS_REPLY = 22
 # to the new owner, which applies it through the normal WAL-logged
 # payload path and acks only once it is as durable as any client op.
 MSG_RESHARD = 23
-MSG_RESHARD_REPLY = 24
+MSG_RESHARD_REPLY = 24  # protocol-ignore: reply — handoff verdict
 MSG_SLICE_PULL = 25
-MSG_SLICE_STATE = 26
+MSG_SLICE_STATE = 26  # protocol-ignore: reply — pulled slice payload
 MSG_SLICE_PUSH = 27
 # fleet-aware deletion-record GC (DESIGN.md §16/§17): shards of a
 # sharded fleet never anti-entropy with each other (disjoint
@@ -91,9 +96,9 @@ MSG_SLICE_PUSH = 27
 # and pushes it back via GC, which each shard clamps to its own
 # frontier before applying — conservative on both hops.
 MSG_FRONTIER = 28
-MSG_FRONTIER_REPLY = 29
+MSG_FRONTIER_REPLY = 29  # protocol-ignore: reply — GC evidence
 MSG_GC = 30
-MSG_GC_REPLY = 31
+MSG_GC_REPLY = 31  # protocol-ignore: reply — GC accounting
 # digest-summary read (ROADMAP digest rung b — the router's member
 # cache): DSUM asks a frontend for its replica's digest summary — the
 # ``net/digestsync.py`` summary body (vv, processed, packed per-lane-
@@ -104,7 +109,7 @@ MSG_GC_REPLY = 31
 # so a router can cache per-shard member sets keyed by the summary and
 # re-pull only on mismatch: repeated fleet reads become O(diff).
 MSG_DSUM = 32
-MSG_DSUM_REPLY = 33
+MSG_DSUM_REPLY = 33  # protocol-ignore: reply — digest summary body
 
 OP_ADD = 0
 OP_DEL = 1
@@ -652,19 +657,24 @@ def decode_dsum_reply(body: bytes) -> Tuple[int, bytes]:
 
 def decode_members(body: bytes) -> Tuple[int, List[int], np.ndarray]:
     """Self-describing (carries its own lengths): the client needs no
-    out-of-band universe/actor-axis configuration to read a reply."""
+    out-of-band universe/actor-axis configuration to read a reply.
+    Counts are checked against the remaining body BEFORE any
+    allocation and vv entries against uint32 range — the W003 codec
+    harness found this decoder shipped without the guards every
+    sibling (``_get_u32_array``, ``wire._decode_vv_py``) carries: a
+    5-byte varint in a garbled reply raised ``OverflowError`` through
+    the client reader thread instead of the typed error."""
     try:
         req_id, pos = wire._get_varint(body, 0)
         n, pos = wire._get_varint(body, pos)
+        if n > len(body) - pos:
+            raise ValueError(f"member count {n} exceeds body")
         members = []
         for _ in range(n):
             e, pos = wire._get_varint(body, pos)
             members.append(e)
         a, pos = wire._get_varint(body, pos)
-        vv = np.zeros(a, np.uint32)
-        for i in range(a):
-            v, pos = wire._get_varint(body, pos)
-            vv[i] = v
+        vv, pos = _get_u32_array(body, pos, a)
     except ValueError as err:
         raise ProtocolError(str(err)) from err
     if pos != len(body):

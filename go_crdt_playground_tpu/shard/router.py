@@ -141,10 +141,18 @@ class _ShardLink:
 
     def __init__(self, sid: str, addr: Addr, *, timeout_s: float,
                  breaker_threshold: int, breaker_cooldown_s: float,
-                 policy: BackoffPolicy, seed: int, on_reply) -> None:
+                 policy: BackoffPolicy, seed: int, on_reply,
+                 max_reply_body: Optional[int] = None) -> None:
         self.sid = sid
         self.addr = (addr[0], int(addr[1]))
         self.timeout_s = timeout_s
+        # reply-body cap for every client this link dials: the router
+        # drives SLICE_PULL against shard frontends, and a donor slice
+        # reply scales with the universe — the default 64MB ServeClient
+        # ceiling would make a large-universe reshard permanently
+        # impossible (every retry fails identically), so the router
+        # sizes it from E like the frontend sizes its SLICE_PUSH cap
+        self.max_reply_body = max_reply_body  # race-ok: read-only
         self._on_reply = on_reply  # router._relay_reply (thread-safe)
         self._lock = threading.Lock()
         self._client: Optional[ServeClient] = None  # guarded-by: _lock
@@ -195,6 +203,7 @@ class _ShardLink:
             client = ServeClient(
                 self.addr, timeout=self.timeout_s,
                 connect_timeout=self.DIAL_TIMEOUT_S,
+                max_reply_body=self.max_reply_body,
                 on_result=lambda op: self._downstream_result(gen, op))
         except (OSError, ConnectionError) as e:
             self.breaker.record_failure()
@@ -335,7 +344,8 @@ class _ShardLink:
         frame type" text and must stay transient/re-probeable."""
         try:
             probe = ServeClient(self.addr, timeout=self.timeout_s,
-                                connect_timeout=self.DIAL_TIMEOUT_S)
+                                connect_timeout=self.DIAL_TIMEOUT_S,
+                                max_reply_body=self.max_reply_body)
         except (OSError, ConnectionError) as e:
             raise _Unreachable(
                 f"shard {self.sid} dsum probe dial failed: {e}") from e
@@ -543,7 +553,12 @@ class ShardRouter:
             breaker_threshold=self._breaker_threshold,
             breaker_cooldown_s=self._breaker_cooldown_s,
             policy=self._policy, seed=self._seed * 1000 + self._link_seq,
-            on_reply=self._relay_reply)
+            on_reply=self._relay_reply,
+            # slice replies scale with the universe (the frontend's
+            # SLICE_PUSH cap formula, §18); the 64MB floor keeps
+            # MEMBERS/STATS bounded on small universes
+            max_reply_body=max(ServeClient.MAX_REPLY_BODY,
+                               16 * self.num_elements + 4096))
 
     def make_link(self, sid: str, addr: Addr) -> _ShardLink:
         """A STAGED link for a joining shard: full breaker/backoff
@@ -700,6 +715,13 @@ class ShardRouter:
             return True
         if msg_type == protocol.MSG_RESHARD:
             return self._handle_reshard(session, body)
+        # The router DRIVES the verbs below against shard frontends; it
+        # never serves them itself (W001 dispatcher-scoped exclusions):
+        # protocol-ignore: MSG_SLICE_PULL — handoff donor read, driven
+        # protocol-ignore: MSG_SLICE_PUSH — handoff recipient write, driven
+        # protocol-ignore: MSG_FRONTIER — GC evidence read, driven
+        # protocol-ignore: MSG_GC — fleet-frontier push, driven
+        # protocol-ignore: MSG_DSUM — member-cache freshness read, driven
         session.send(framing.MSG_ERROR,
                      f"unexpected frame type {msg_type}".encode())
         return False

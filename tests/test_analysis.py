@@ -692,8 +692,9 @@ def test_supervisor_round_counter_locked():
 
 def test_gate_fast(tmp_path):
     """The tier-1 hook: the full --fast gate must exit 0 on this tree
-    and cover all four passes in ANALYSIS_REPORT.json (acceptance
-    criterion of the analyzer ISSUE)."""
+    and cover every registered pass in ANALYSIS_REPORT.json
+    (acceptance criterion of the analyzer + protocol-contract
+    ISSUEs)."""
     import json
 
     from go_crdt_playground_tpu.analysis.__main__ import main
@@ -739,6 +740,33 @@ def test_gate_fast(tmp_path):
     # target's compiled-program caches and re-pin paths run under the
     # node lock across batcher/sync/compaction threads
     assert "MeshApplyTarget" in covered, covered
+    # the wire-contract suite (the protocol-contract ISSUE): W001-W004
+    # + M001 must have swept the dialect modules, every registered
+    # dispatcher, the full codec registry, and the metric-name surface
+    assert {"protocol_contract", "codec_symmetry", "metrics_contract",
+            "report_freshness"} <= set(report["passes"])
+    pc = report["passes"]["protocol_contract"]["stats"]
+    assert set(pc["dispatchers"]) == {"frontend", "router", "peer",
+                                      "serve-client"}, pc
+    for d in pc["dispatchers"].values():
+        assert d["required"], d  # no dispatcher checked an empty set
+    assert pc["recv_frame_sites"] >= 9, pc
+    assert pc["reject_sites"] >= 16, pc
+    assert pc["codes"] >= 6, pc
+    cs = report["passes"]["codec_symmetry"]["stats"]
+    assert cs["codecs"] >= 24 and cs["codec_functions"] >= 40, cs
+    mc = report["passes"]["metrics_contract"]["stats"]
+    assert mc["emitted"] >= 60 and mc["referenced"] >= 20, mc
+    # model-merging joins ride the lattice pass with their declared
+    # law subsets (never a skip)
+    laws = report["passes"]["lattice_laws"]["stats"]["laws_by_family"]
+    assert laws["tensor_mean"] == ["commutativity"], laws
+    assert laws["weighted_mean"] == ["commutativity",
+                                     "associativity"], laws
+    # freshness: the gate itself verified the committed artifact
+    # matches the registered pass list
+    rf = report["passes"]["report_freshness"]["stats"]
+    assert set(rf["registered"]) == set(report["passes"]), rf
 
 
 def test_report_shape_roundtrips(tmp_path):

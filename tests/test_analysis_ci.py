@@ -8,6 +8,7 @@ contract, CI images that have ruff enforce it), and the repo-root
 ``tools/analyze.py`` wrapper staying in lockstep with the module CLI.
 """
 
+import importlib.util
 import json
 import os
 import re
@@ -18,6 +19,21 @@ import sys
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ruff_cmd():
+    """How to invoke ruff HERE, or None if this environment has none.
+    Two resolution paths, because the dev extra installs ruff as a
+    module that is not necessarily a PATH binary: the ``ruff``
+    executable if present, else ``python -m ruff`` when the module is
+    importable.  The old PATH-only probe half-skipped: an environment
+    with the dev extra installed into a venv (module importable, no
+    binary on PATH) silently skipped the baseline it could have run."""
+    if shutil.which("ruff") is not None:
+        return ["ruff"]
+    if importlib.util.find_spec("ruff") is not None:
+        return [sys.executable, "-m", "ruff"]
+    return None
 
 
 def test_ruff_baseline_is_configured():
@@ -35,12 +51,14 @@ def test_ruff_baseline_is_configured():
 
 
 @pytest.mark.skipif(
-    shutil.which("ruff") is None,
-    reason="ruff not installed in this image — `pip install -e .[dev]` "
-           "(the pyproject dev extra) provides it; CI images that have "
-           "it enforce the baseline")
+    _ruff_cmd() is None,
+    reason="ruff is absent from this environment (no `ruff` binary on "
+           "PATH and no importable module) — this image does not ship "
+           "the dev extra; installing it, `pip install -e '.[dev]'`, "
+           "provides ruff, and CI images that have it enforce the "
+           "baseline")
 def test_ruff_baseline_clean():
-    proc = subprocess.run(["ruff", "check", "."], cwd=REPO,
+    proc = subprocess.run(_ruff_cmd() + ["check", "."], cwd=REPO,
                           capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
 

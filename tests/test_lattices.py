@@ -368,3 +368,101 @@ def test_gcounter_gossip_convergence_1k_replicas():
     assert rounds == 10
     expected = np.asarray(counts).max(axis=0)
     assert (np.asarray(st.counts) == expected[None, :]).all()
+
+# ---------------------------------------------------------------------------
+# Model-merging joins (ROADMAP: weight merging as lattice joins)
+# ---------------------------------------------------------------------------
+
+
+def test_model_merging_joins_registered_with_law_subsets():
+    """The analyzer-verified first step of the mesh-scale model-merging
+    workload: all three strategies are in JOIN_REGISTRY with their
+    HONEST law subsets (mean/weighted are not idempotent joins — they
+    declare fewer laws via JoinSpec.laws, they do not skip the pass)."""
+    reg = L.JOIN_REGISTRY
+    assert reg["tensor_max"].laws == L.ALL_LAWS
+    assert reg["tensor_mean"].laws == ("commutativity",)
+    assert reg["weighted_mean"].laws == ("commutativity",
+                                         "associativity")
+    assert reg["weighted_mean"].atol > 0
+
+
+def test_model_merging_joins_pass_their_declared_laws():
+    from go_crdt_playground_tpu.analysis import lattice_laws
+
+    for name in ("tensor_max", "tensor_mean", "weighted_mean"):
+        findings, stats = lattice_laws.check_join_spec(
+            L.JOIN_REGISTRY[name], seeds=(3, 4), n_rows=6, n_ops=20)
+        assert not findings, [f.render() for f in findings]
+        assert stats["laws_checked"] == 2 * len(
+            L.JOIN_REGISTRY[name].laws)
+
+
+def test_invalid_law_declaration_is_its_own_code():
+    """A typo'd or empty law subset is a J004 registration error —
+    never mislabeled as a commutativity counterexample, never a
+    silent skip."""
+    from go_crdt_playground_tpu.analysis import lattice_laws
+
+    bad = L.JoinSpec("planted", lambda rng, n, ops: None,
+                     lambda a, b: a, lambda s: {}, laws=("cmutativity",))
+    findings, stats = lattice_laws.check_join_spec(bad, seeds=(1,))
+    assert findings and findings[0].code == "J004"
+    assert stats["laws_checked"] == 0
+    empty = bad._replace(laws=())
+    findings, _ = lattice_laws.check_join_spec(empty, seeds=(1,))
+    assert findings and findings[0].code == "J004"
+
+
+def test_tensor_max_gossip_converges():
+    """The true-lattice strategy rides the existing gossip machinery:
+    a ring dissemination drives every replica to the elementwise max."""
+    R, D = 8, 16
+    w = jnp.asarray(np.random.default_rng(0)
+                    .normal(0, 1, (R, D)).astype(np.float32))
+    st = L.TensorMergeState(w=w)
+    for off in gossip.dissemination_offsets(R):
+        st = L.gossip_round(L.tensor_max_join, st,
+                            gossip.ring_perm(R, off))
+    expected = np.asarray(w).max(axis=0)
+    assert np.array_equal(np.asarray(st.w),
+                          np.broadcast_to(expected, (R, D)))
+
+
+def test_weighted_mean_value_is_order_free():
+    """Σwx/Σw is the same whatever merge tree produced it — the
+    property that makes weighted averaging shippable over gossip
+    (under exactly-once contribution delivery)."""
+    rng = np.random.default_rng(1)
+    D = 8
+    ws = rng.uniform(0.5, 2.0, 4)
+    xs = rng.normal(0, 1, (4, D)).astype(np.float32)
+    states = [L.WeightedMergeState(
+        acc=jnp.asarray((w * x).astype(np.float32)[None]),
+        weight=jnp.asarray(np.float32(w).reshape(1, 1)))
+        for w, x in zip(ws, xs)]
+    left = states[0]
+    for s in states[1:]:
+        left = L.weighted_mean_join(left, s)
+    right = L.weighted_mean_join(
+        L.weighted_mean_join(states[3], states[2]),
+        L.weighted_mean_join(states[1], states[0]))
+    expected = (ws[:, None] * xs).sum(0) / ws.sum()
+    assert np.allclose(L.weighted_mean_value(left)[0], expected,
+                       atol=1e-5)
+    assert np.allclose(L.weighted_mean_value(right)[0], expected,
+                       atol=1e-5)
+
+
+def test_weighted_mean_join_is_not_idempotent_by_design():
+    """Why the law subset excludes idempotence: join(a, a) double-
+    counts every contribution — the documented exactly-once delivery
+    contract (ops/lattices.py section comment)."""
+    st = L.WeightedMergeState(acc=jnp.ones((1, 4), jnp.float32),
+                              weight=jnp.ones((1, 1), jnp.float32))
+    twice = L.weighted_mean_join(st, st)
+    assert float(twice.weight[0, 0]) == 2.0  # not a lattice join
+    # ... but the OBSERVABLE value is unchanged — self-merge corrupts
+    # the accounting, not the average (why the paper can iterate)
+    assert np.allclose(L.weighted_mean_value(twice),
+                       L.weighted_mean_value(st))
