@@ -33,6 +33,13 @@ a fleet-scale taste:
                                               # swap the ring atomically;
                                               # --leave ID drains a shard
                                               # out instead
+  python -m go_crdt_playground_tpu autopilot --router H:P \\
+                                             --standby s9=H:P
+                                              # closed-loop controller
+                                              # (DESIGN.md §21): watches
+                                              # STATS, drives reshard
+                                              # itself — split hot
+                                              # keyspaces, drain cold ones
 """
 
 from __future__ import annotations
@@ -290,6 +297,65 @@ def _cmd_reshard(args) -> int:
     return 0 if ok else 1
 
 
+def _cmd_autopilot(args) -> int:
+    """The fleet autopilot as a process (DESIGN.md §21): watch one
+    router's STATS fan-out, split hot keyspaces onto standby shards /
+    drain cold ones, one action in flight, every decision in the JSONL
+    log.  SIGTERM/ctrl-C stops the loop; the fleet keeps serving —
+    the controller is an OPERATOR, never a dependency."""
+    import signal
+    import threading
+
+    from go_crdt_playground_tpu.control import (FleetAutopilot,
+                                                PolicyConfig)
+
+    config = PolicyConfig(
+        p99_budget_s=args.p99_budget_ms / 1e3,
+        queue_watermark=args.queue_watermark,
+        hot_windows=args.hot_windows,
+        cold_windows=args.cold_windows,
+        cooldown_s=args.cooldown,
+        abort_cooldown_s=args.abort_cooldown,
+        min_shards=args.min_shards,
+        max_shards=args.max_shards,
+        cold_rate_per_shard=args.cold_rate)
+    pilot = FleetAutopilot(
+        tuple(args.router), args.standby, config=config,
+        poll_interval_s=args.poll_interval,
+        reshard_timeout_s=args.reshard_timeout,
+        decision_log=args.decision_log, seed=args.seed)
+    try:
+        resumed = pilot.start()
+    except ConnectionError as e:
+        print(f"error: {e}", file=sys.stderr, flush=True)
+        return 1
+    print(f"Fleet autopilot engaged over router "
+          f"{args.router[0]}:{args.router[1]} "
+          f"(ring gen={resumed['generation']} "
+          f"shards={resumed['shards']} "
+          f"standbys={resumed['standbys']} "
+          f"adopted={resumed['deployed_adopted']} "
+          f"p99-budget={args.p99_budget_ms}ms "
+          f"queue-watermark={args.queue_watermark:g} "
+          f"poll={args.poll_interval}s "
+          f"log={args.decision_log or 'off'})", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    pilot.stop()
+    snap = pilot.recorder.snapshot()["counters"]
+    print(f"autopilot stopped: {snap.get('control.polls', 0)} polls, "
+          f"{snap.get('control.decisions.split', 0)} splits, "
+          f"{snap.get('control.decisions.merge', 0)} merges, "
+          f"{snap.get('control.actions.committed', 0)} committed, "
+          f"{snap.get('control.actions.aborted', 0)} aborted",
+          flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="go_crdt_playground_tpu")
     p.add_argument("--platform", default="auto",
@@ -479,6 +545,67 @@ def main(argv=None) -> int:
                           "shard process itself keeps running)")
     rs.add_argument("--timeout", type=float, default=120.0,
                     help="whole-handoff reply budget in seconds")
+
+    ap_p = sub.add_parser(
+        "autopilot",
+        help="closed-loop fleet controller (DESIGN.md §21): watch a "
+             "router's STATS fan-out and drive reshard --join/--leave "
+             "itself — split hot keyspaces onto standby shards, drain "
+             "cold ones, one action in flight, typed aborts cool down")
+    ap_p.add_argument("--router", required=True, metavar="HOST:PORT",
+                      type=_peer_addr, help="the router's client address")
+    ap_p.add_argument("--standby", action="append", default=[],
+                      type=_shard_spec, metavar="ID=HOST:PORT",
+                      help="one standby serve --ingest frontend the "
+                           "controller may deploy (repeatable; splits "
+                           "deploy in roster order, merges drain LIFO; "
+                           "the controller never drains the operator's "
+                           "initial fleet)")
+    ap_p.add_argument("--poll-interval", dest="poll_interval",
+                      type=float, default=1.0,
+                      help="seconds between STATS polls (the signal "
+                           "window unit)")
+    ap_p.add_argument("--p99-budget-ms", dest="p99_budget_ms",
+                      type=float, default=250.0,
+                      help="windowed per-shard ingest p99 above this "
+                           "burns the budget (a hot sample)")
+    ap_p.add_argument("--queue-watermark", dest="queue_watermark",
+                      type=float, default=48.0,
+                      help="admission-queue depth at/above this is a "
+                           "hot sample")
+    ap_p.add_argument("--hot-windows", dest="hot_windows", type=int,
+                      default=3,
+                      help="consecutive hot polls before a split fires "
+                           "(hysteresis)")
+    ap_p.add_argument("--cold-windows", dest="cold_windows", type=int,
+                      default=8,
+                      help="consecutive cold polls before a merge fires")
+    ap_p.add_argument("--cooldown", type=float, default=10.0,
+                      help="post-commit hold window in seconds")
+    ap_p.add_argument("--abort-cooldown", dest="abort_cooldown",
+                      type=float, default=20.0,
+                      help="post-abort hold window (longer: the fleet "
+                           "just proved it was not ready)")
+    ap_p.add_argument("--min-shards", dest="min_shards", type=int,
+                      default=1)
+    ap_p.add_argument("--max-shards", dest="max_shards", type=int,
+                      default=8)
+    ap_p.add_argument("--cold-rate", dest="cold_rate", type=float,
+                      default=100.0,
+                      help="fleet offered ops/s per REMAINING shard "
+                           "under which a merge is considered")
+    ap_p.add_argument("--reshard-timeout", dest="reshard_timeout",
+                      type=float, default=120.0,
+                      help="whole-handoff budget per action")
+    ap_p.add_argument("--decision-log", dest="decision_log",
+                      default=None,
+                      help="append every decision/outcome as one JSONL "
+                           "record here (the replayable audit trail "
+                           "CONTROL_CURVE.json adjudicates)")
+    ap_p.add_argument("--seed", type=int, default=0,
+                      help="policy/actuator seed (decisions are a "
+                           "deterministic function of the signal trace "
+                           "given config + seed)")
     args = p.parse_args(argv)
     if args.platform != "auto":
         import jax
@@ -503,6 +630,8 @@ def main(argv=None) -> int:
         return _cmd_router(args)
     if args.cmd == "reshard":
         return _cmd_reshard(args)
+    if args.cmd == "autopilot":
+        return _cmd_autopilot(args)
     return 2
 
 

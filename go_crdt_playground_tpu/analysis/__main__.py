@@ -63,7 +63,14 @@ LOCK_TARGETS = ["net/peer.py", "net/antientropy.py", "net/digestsync.py",
                 # the mesh replica tier (ISSUE 10): a Node subclass
                 # whose compiled-program caches and re-pin paths run
                 # under the node lock like every other state mutation
-                "parallel/meshtarget.py"]
+                "parallel/meshtarget.py",
+                # the fleet autopilot (ISSUE 12): the controller loop
+                # thread owns most state (race-ok-annotated), but the
+                # signal poller, standby pool and actuator cross the
+                # loop thread with start/stop owners and post-stop
+                # readers — swept like every other runtime tier
+                "control/signals.py", "control/policy.py",
+                "control/actuator.py", "control/controller.py"]
 # extra files that participate in the lock-ORDER graph (their locks can
 # nest under the runtime's)
 LOCK_ORDER_EXTRA = ["utils/checkpoint.py"]
@@ -86,7 +93,13 @@ ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "host": "ConnHost", "handoff": "HandoffCoordinator",
                 "_route": "RouteState",
                 "compactor": "CompactionScheduler",
-                "_negotiator": "DigestNegotiator"}
+                "_negotiator": "DigestNegotiator",
+                "_group_adapter": "AdaptiveGroupSize",
+                "policy": "AutopilotPolicy",
+                "actuator": "ReshardActuator",
+                "signals": "FleetSignals",
+                "pool": "StandbyPool",
+                "pilot": "FleetAutopilot"}
 
 # the full pass list (report keys): the report-freshness lint pins the
 # COMMITTED artifact's pass list to this — landing a new pass without

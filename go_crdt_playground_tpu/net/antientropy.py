@@ -300,11 +300,16 @@ class SyncSupervisor:
                 "converge bitwise, so their digests mismatch forever")
         self.sync_mode = sync_mode
         self._negotiator = None
+        self._group_adapter = None
         if sync_mode == "digest":
-            from go_crdt_playground_tpu.net.digestsync import \
-                DigestNegotiator
+            from go_crdt_playground_tpu.net.digestsync import (
+                AdaptiveGroupSize, DigestNegotiator)
 
             self._negotiator = DigestNegotiator()
+            # per-peer online group-size tuning (digest rung b): the
+            # tuner is thread-safe; its streak evidence comes from the
+            # stats each exchange returns below
+            self._group_adapter = AdaptiveGroupSize(node.num_elements)
         self.node = node
         self.policy = policy if policy is not None else BackoffPolicy()
         self.sync_timeout_s = sync_timeout_s
@@ -500,10 +505,38 @@ class SyncSupervisor:
                 and not self.node.full_resync_is_pending()):
             from go_crdt_playground_tpu.net import digestsync
 
+            gs = self._group_adapter.size(addr)
             try:
-                digestsync.sync_digest(
-                    self.node, addr, timeout=self.sync_timeout_s,
-                    connect_timeout_s=self.connect_timeout_s)
+                try:
+                    stats = digestsync.sync_digest(
+                        self.node, addr, timeout=self.sync_timeout_s,
+                        connect_timeout_s=self.connect_timeout_s,
+                        group_size=gs)
+                except (PeerProtocolError, framing.RemoteError) as e:
+                    # a pre-adaptive server rejects any non-default
+                    # size with its group-size-mismatch error (served
+                    # as MSG_ERROR → RemoteError): pin the default for
+                    # this peer's lifetime and complete the SAME
+                    # attempt at it — negotiation costs one extra dial
+                    # once, like the legacy-ladder fallback
+                    if (gs == digestsync.DIGEST_GROUP_LANES
+                            or "group-size mismatch" not in str(e)):
+                        raise
+                    self._group_adapter.pin(
+                        addr, digestsync.DIGEST_GROUP_LANES)
+                    self._count("digest.group_pinned")
+                    stats = digestsync.sync_digest(
+                        self.node, addr, timeout=self.sync_timeout_s,
+                        connect_timeout_s=self.connect_timeout_s,
+                        group_size=digestsync.DIGEST_GROUP_LANES)
+                move = self._group_adapter.observe(addr, stats)
+                if move != "hold":
+                    self._count(f"digest.group_{move}")
+                if self.recorder is not None and hasattr(
+                        self.recorder, "set_gauge"):
+                    self.recorder.set_gauge(
+                        "digest.group_size",
+                        self._group_adapter.size(addr))
                 return
             except digestsync.DigestUnsupported:
                 self._negotiator.mark_legacy(addr)

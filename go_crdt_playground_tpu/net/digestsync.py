@@ -96,6 +96,15 @@ Addr = Tuple[str, int]
 # deterministic config error, like an element-universe mismatch)
 DIGEST_V1 = 1
 
+# group sizes a server will ADOPT from a client's summary (ROADMAP
+# digest rung b, adaptive group size): each must divide the Pallas
+# lane width (ops/pallas_merge._LANE = 128) so both kernel forms pad
+# to identical group boundaries at every rung.  The server answers at
+# the CLIENT's size — the client owns the adaptation (it measures the
+# tradeoff from its own exchanges); anything outside this set is a
+# deterministic config error, like a universe mismatch.
+ALLOWED_GROUP_SIZES = (8, 16, 32, 64, 128)
+
 
 class DigestUnsupported(Exception):
     """The peer answered MSG_DIGEST with the legacy ladder's "expected
@@ -141,6 +150,104 @@ class DigestNegotiator:
     def legacy_peers(self) -> Set[Addr]:
         with self._lock:
             return set(self._legacy)
+
+
+class AdaptiveGroupSize:
+    """Per-peer online tuning of the digest group size (ROADMAP digest
+    rung b): the summary costs ``4·E/gs`` bytes EVERY round while a
+    mismatched group ships up to ``gs`` lanes — so the right size is a
+    property of the PEER'S divergence pattern, measurable from the
+    ``digest.groups_mismatched`` evidence each exchange returns.
+
+    Deterministic rung ladder (``ALLOWED_GROUP_SIZES``), moved one
+    rung at a time on streak evidence (the hysteresis that stops a
+    single noisy round from thrashing the compile cache):
+
+    * ``GROW_AFTER`` consecutive CLEAN digest rounds (zero mismatched
+      groups — the quiescent regime, where the summary is the whole
+      cost) ⇒ grow: halves the every-round summary bytes;
+    * ``SHRINK_AFTER`` consecutive SPARSE-divergence rounds (some
+      groups mismatch, but ≤ 1/8 of them — localized churn) ⇒ shrink:
+      each divergent lane drags at most a quarter as many innocent
+      group-mates onto the wire.  DENSE divergence (a genuinely
+      different peer) moves nothing: coarse groups are already right
+      when most of the state ships anyway.
+
+    δ-fallback rounds carry no digest evidence and leave the streaks
+    untouched.  ``pin`` fixes a peer at one size forever — the
+    negotiation outcome for a pre-adaptive server that answers any
+    non-default size with its group-size-mismatch error.
+
+    Thread-safe (supervisor round thread + any observer); counters
+    ``digest.group_grow`` / ``digest.group_shrink`` ride the caller's
+    recorder via the returned transition."""
+
+    GROW_AFTER = 4
+    SHRINK_AFTER = 2
+    SPARSE_FRACTION = 1 / 8
+
+    def __init__(self, num_elements: int,
+                 initial: int = DIGEST_GROUP_LANES,
+                 ladder: Tuple[int, ...] = ALLOWED_GROUP_SIZES):
+        if initial not in ladder:
+            raise ValueError(f"initial group size {initial} not on the "
+                             f"ladder {ladder}")
+        self.num_elements = int(num_elements)
+        self.ladder = tuple(sorted(ladder))
+        self.initial = int(initial)
+        self._lock = threading.Lock()
+        self._size: dict = {}          # guarded-by: _lock
+        self._clean: dict = {}         # guarded-by: _lock
+        self._sparse: dict = {}        # guarded-by: _lock
+        self._pinned: Set[Addr] = set()  # guarded-by: _lock
+
+    @staticmethod
+    def _key(addr: Addr) -> Addr:
+        return (addr[0], int(addr[1]))
+
+    def size(self, addr: Addr) -> int:
+        with self._lock:
+            return self._size.get(self._key(addr), self.initial)
+
+    def pin(self, addr: Addr, size: int) -> None:
+        """Fix a peer at ``size`` for its lifetime in this process
+        (the pre-adaptive-server negotiation outcome)."""
+        with self._lock:
+            k = self._key(addr)
+            self._size[k] = int(size)
+            self._pinned.add(k)
+
+    def observe(self, addr: Addr, stats: "DigestSyncStats") -> str:
+        """Advance the peer's streaks with one exchange's evidence;
+        returns "grow" / "shrink" / "hold" (the caller counts)."""
+        k = self._key(addr)
+        with self._lock:
+            if k in self._pinned or stats.mode_sent != MODE_DIGEST:
+                return "hold"
+            size = self._size.get(k, self.initial)
+            i = self.ladder.index(size)
+            if stats.groups_mismatched == 0:
+                self._sparse[k] = 0
+                c = self._clean.get(k, 0) + 1
+                if c >= self.GROW_AFTER and i + 1 < len(self.ladder):
+                    self._size[k] = self.ladder[i + 1]
+                    self._clean[k] = 0
+                    return "grow"
+                self._clean[k] = c
+                return "hold"
+            self._clean[k] = 0
+            total = num_groups(self.num_elements, size)
+            if stats.groups_mismatched <= max(1, int(
+                    total * self.SPARSE_FRACTION)):
+                s = self._sparse.get(k, 0) + 1
+                if s >= self.SHRINK_AFTER and i > 0:
+                    self._size[k] = self.ladder[i - 1]
+                    self._sparse[k] = 0
+                    return "shrink"
+                self._sparse[k] = s
+            else:
+                self._sparse[k] = 0
+            return "hold"
 
 
 # ---------------------------------------------------------------------------
@@ -339,19 +446,25 @@ def serve_digest_exchange(node, conn: socket.socket,
     """Answer one inbound digest exchange.  Mirrors the legacy server
     flow: summary-for-summary, then payload-for-payload with apply and
     extract under ONE lock hold.  Protocol errors reply MSG_ERROR and
-    return (connection-scoped; the dialing supervisor classifies)."""
-    group_size = DIGEST_GROUP_LANES
+    return (connection-scoped; the dialing supervisor classifies).
+
+    The server ADOPTS the client's group size (any rung of
+    ``ALLOWED_GROUP_SIZES``) — the client tunes it per peer online
+    from its own measured summary/payload tradeoff (adaptive group
+    size, ``AdaptiveGroupSize``); a server that pinned one size would
+    veto the whole mechanism."""
     try:
         peer_actor, peer_gs, peer_vv, peer_processed, peer_digests = \
             decode_summary(summary_body, node.num_elements,
                            node.num_actors)
-        if peer_gs != group_size:
+        if peer_gs not in ALLOWED_GROUP_SIZES:
             raise ProtocolError(
-                f"digest group-size mismatch: peer {peer_gs}, ours "
-                f"{group_size}")
+                f"digest group-size mismatch: peer {peer_gs} not in "
+                f"{ALLOWED_GROUP_SIZES}")
     except ProtocolError as e:
         framing.send_frame(conn, framing.MSG_ERROR, str(e).encode())
         return
+    group_size = peer_gs
     sent = framing.send_frame(conn, MSG_DIGEST,
                               node_summary(node, group_size))
     recv = framing.frame_size(len(summary_body))

@@ -170,13 +170,19 @@ class Recorder:
     def snapshot(self) -> Dict[str, object]:
         """Point-in-time copy: {"counters": {...}, "observations": {...},
         "gauges": {...}} with per-stream mean and histogram-derived
-        p50/p95/p99 added."""
+        p50/p95/p99 added, plus the raw cumulative ``buckets`` vector —
+        a REMOTE poller (the fleet autopilot reading STATS over the
+        wire, control/signals.py) windows a quantile exactly like the
+        in-process compaction scheduler does: diff two snapshots'
+        buckets and feed ``percentile_of_counts``.  64 ints per stream,
+        bounded like the histogram itself."""
         with self._lock:
             obs = {
                 name: {**o, "mean": o["sum"] / o["n"],
                        "p50": self._percentile_locked(name, 0.50),
                        "p95": self._percentile_locked(name, 0.95),
-                       "p99": self._percentile_locked(name, 0.99)}
+                       "p99": self._percentile_locked(name, 0.99),
+                       "buckets": list(self._histograms[name])}
                 for name, o in self._observations.items()
             }
             return {"counters": dict(self._counters), "observations": obs,

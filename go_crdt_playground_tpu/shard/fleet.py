@@ -75,7 +75,14 @@ class _Proc:
             if not line:
                 return
 
-    def await_address(self, timeout_s: float = 120.0) -> Addr:
+    def await_match(self, regex, timeout_s: float = 120.0):
+        """Wait for the first stdout line matching ``regex``; returns
+        the match.  The ONE banner-handshake implementation — the
+        address handshake and the autopilot soak's engagement banner
+        both ride it, so the deadline discipline (enforced on
+        NON-matching lines too: a subprocess spamming warnings without
+        ever printing its banner must still time out, not pin the soak
+        forever) lives once."""
         deadline = time.monotonic() + timeout_s
         seen = 0
         while True:
@@ -84,26 +91,28 @@ class _Proc:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         raise RuntimeError(
-                            f"no address line within {timeout_s}s "
+                            f"no line matching {regex.pattern!r} "
+                            f"within {timeout_s}s "
                             f"(argv={self.proc.args[:6]}...)")
                     self._line_cond.wait(timeout=remaining)
                 line = self._lines[seen]
                 seen += 1
             if not line:
                 raise RuntimeError(
-                    f"process exited before address "
-                    f"(rc={self.proc.poll()})")
-            m = _ADDR_RE.search(line)
+                    f"process exited before a line matching "
+                    f"{regex.pattern!r} (rc={self.proc.poll()})")
+            m = regex.search(line)
             if m:
-                self.addr = (m.group(1).decode(), int(m.group(2)))
-                return self.addr
+                return m
             if time.monotonic() > deadline:
-                # enforced on NON-matching lines too: a subprocess
-                # spamming warnings without ever printing its address
-                # must still time out, not pin the soak forever
                 raise RuntimeError(
-                    f"no address line within {timeout_s}s; last output "
-                    f"line: {line!r}")
+                    f"no line matching {regex.pattern!r} within "
+                    f"{timeout_s}s; last output line: {line!r}")
+
+    def await_address(self, timeout_s: float = 120.0) -> Addr:
+        m = self.await_match(_ADDR_RE, timeout_s)
+        self.addr = (m.group(1).decode(), int(m.group(2)))
+        return self.addr
 
     def sigkill(self) -> None:
         if self.proc.poll() is None:

@@ -74,7 +74,7 @@ from go_crdt_playground_tpu.shard.handoff import (PHASE_COMMITTED,
                                                   HandoffCoordinator,
                                                   HandoffError, RouteState,
                                                   load_ring_file)
-from go_crdt_playground_tpu.shard.ring import HashRing
+from go_crdt_playground_tpu.shard.ring import HashRing, load_stats
 from go_crdt_playground_tpu.utils.backoff import Backoff, BackoffPolicy
 
 Addr = Tuple[str, int]
@@ -94,6 +94,55 @@ class _DsumUnsupported(Exception):
     desync/teardown message that merely CONTAINS the same text).  The
     caller pins the sid to the uncached path; every other probe
     failure is transient and must stay re-probeable."""
+
+
+class _OpRateWindow:
+    """Per-shard forwarded-op counts in coarse time buckets — the
+    windowed op-rate the fleet autopilot reads from STATS (DESIGN.md
+    §21).  One-second buckets, a bounded ring of them per sid; readers
+    get ops/s over the last ``window_s`` whole buckets (the current
+    partial bucket is excluded so a poll landing early in a second
+    cannot read an artificially low rate).  Cheap enough for the OP
+    hot path: one lock hold + one dict update per sub-op group."""
+
+    BUCKET_S = 1.0
+    KEEP = 32  # bounded history: > any sane window_s
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # sid -> {bucket_epoch: count}, pruned to the last KEEP buckets
+        self._buckets: Dict[str, Dict[int, int]] = {}  # guarded-by: _lock
+
+    def note(self, sid: str, n: int = 1,
+             now: Optional[float] = None) -> None:
+        t = time.monotonic() if now is None else now
+        epoch = int(t / self.BUCKET_S)
+        with self._lock:
+            b = self._buckets.setdefault(sid, {})
+            b[epoch] = b.get(epoch, 0) + n
+            if len(b) > self.KEEP:
+                for old in sorted(b)[:len(b) - self.KEEP]:
+                    del b[old]
+
+    def drop(self, sid: str) -> None:
+        """A shard that left the ring must not linger in the read-out."""
+        with self._lock:
+            self._buckets.pop(sid, None)
+
+    def rates(self, window_s: float = 5.0,
+              now: Optional[float] = None) -> Dict[str, float]:
+        """sid -> forwarded ops/s over the last ``window_s`` COMPLETE
+        buckets."""
+        t = time.monotonic() if now is None else now
+        current = int(t / self.BUCKET_S)
+        n_buckets = max(1, int(window_s / self.BUCKET_S))
+        lo = current - n_buckets
+        with self._lock:
+            return {
+                sid: sum(c for ep, c in b.items()
+                         if lo <= ep < current) / (n_buckets
+                                                   * self.BUCKET_S)
+                for sid, b in self._buckets.items()}
 
 
 class _Relay:
@@ -513,6 +562,9 @@ class ShardRouter:
         # would tear down every in-flight OP).
         self._dsum_supported: set = set()  # guarded-by: _member_cache_lock
         self._dsum_unsupported: set = set()  # guarded-by: _member_cache_lock
+        # per-shard windowed op-rate (the autopilot's imbalance signal,
+        # exposed in STATS — no new wire verb); internally locked
+        self._op_rates = _OpRateWindow()
         self._fleet_gc_interval_s = float(fleet_gc_interval_s)
         # race-ok: serve() owner thread only
         self._fleet_gc_thread: Optional[threading.Thread] = None
@@ -640,6 +692,7 @@ class ShardRouter:
             if drop_sid is not None:
                 retired = self._links.pop(drop_sid, None)
         if drop_sid is not None:
+            self._op_rates.drop(drop_sid)
             # a left shard's cached member set must not linger (its
             # link is gone, so nothing would ever refresh the entry),
             # and its DSUM classification resets with it — the sid
@@ -783,6 +836,11 @@ class ShardRouter:
             deadline_s = deadline_us / 1e6 if deadline_us > 0 else None
             relay = _Relay(session, req_id, len(groups))
             for sid, elems in groups.items():
+                # imbalance signal: forwarded SUB-OPS per shard per
+                # second (counted at forward, not ack — the autopilot
+                # watches offered pressure, which exists even while a
+                # saturated shard sheds)
+                self._op_rates.note(sid)
                 # per-group lookup, not a dict copy per op: the common
                 # single-shard op pays one lock hold, no allocation
                 link = self.link(sid)
@@ -1008,6 +1066,14 @@ class ShardRouter:
         # meaningless, so observations stay router-local (empty today).
         counters = dict(aggregate)
         counters.update(snap.get("counters", {}))
+        # the autopilot's observability surface (DESIGN.md §21): the
+        # active ring's keyspace balance and the per-shard windowed
+        # forwarded-op rate ride the EXISTING stats verb — imbalance is
+        # observable with no new wire verb, by any dialect client
+        rt = self.route()
+        ring_info = rt.info()
+        ring_info["load_stats"] = load_stats(rt.owner,
+                                             len(rt.ring.shards))
         session.send(protocol.MSG_STATS_REPLY, protocol.encode_stats_reply(
             req_id, {"counters": counters,
                      "observations": {},
@@ -1018,7 +1084,11 @@ class ShardRouter:
                      # which ring this router is ACTUALLY serving —
                      # generation + owner-map digest (the soak asserts
                      # a failed handoff left these untouched)
-                     "ring": self.route().info()}))
+                     "ring": ring_info,
+                     "autopilot": {
+                         "op_rates": self._op_rates.rates(),
+                         "op_rate_window_s": 5.0,
+                     }}))
 
     # -- fleet-aware deletion-record GC (ROADMAP item c, DESIGN.md §17) -----
 

@@ -740,6 +740,14 @@ def test_gate_fast(tmp_path):
     # target's compiled-program caches and re-pin paths run under the
     # node lock across batcher/sync/compaction threads
     assert "MeshApplyTarget" in covered, covered
+    # ... and the fleet autopilot (the control-loop ISSUE): the
+    # controller loop thread, signal poller, standby pool, actuator,
+    # and the per-peer adaptive digest-group tuner are all inside the
+    # sweep — "0 findings on control/" only means something if the
+    # classes were actually covered
+    assert {"FleetAutopilot", "AutopilotPolicy", "ReshardActuator",
+            "FleetSignals", "StandbyPool"} <= covered, covered
+    assert "AdaptiveGroupSize" in covered, covered
     # the wire-contract suite (the protocol-contract ISSUE): W001-W004
     # + M001 must have swept the dialect modules, every registered
     # dispatcher, the full codec registry, and the metric-name surface

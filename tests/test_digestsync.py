@@ -352,3 +352,139 @@ def test_supervisor_refuses_digest_on_reference_semantics():
         SyncSupervisor(node, [], sync_mode="digest")
     with pytest.raises(ValueError, match="sync_mode"):
         SyncSupervisor(Node(0, 32, 2), [], sync_mode="bogus")
+
+
+# ---------------------------------------------------------------------------
+# adaptive group size (ROADMAP digest rung b)
+# ---------------------------------------------------------------------------
+
+
+def test_server_adopts_client_group_size():
+    """The server answers at the CLIENT's group size (any allowed
+    rung) — the client owns the adaptation; a divergent pair converges
+    identically at every rung."""
+    for gs in (16, 32, 128):
+        a, b, _ = _pair()
+        b.add(3, 70, 200)
+        addr = b.serve("127.0.0.1", 0)
+        try:
+            st = sync_digest(a, addr, group_size=gs)
+            assert st.groups_mismatched > 0
+            st = sync_digest(a, addr, group_size=gs)
+            assert st.quiescent, (gs, st)
+            assert sorted(a.members()) == [3, 70, 200]
+        finally:
+            b.close()
+
+
+def test_server_refuses_off_ladder_group_size():
+    """A size outside ALLOWED_GROUP_SIZES is a deterministic config
+    error (it may not divide the Pallas lane width), answered as a
+    protocol failure like a universe mismatch."""
+    a, b, _ = _pair()
+    addr = b.serve("127.0.0.1", 0)
+    try:
+        with pytest.raises(framing.RemoteError, match="group-size"):
+            sync_digest(a, addr, group_size=48)
+    finally:
+        b.close()
+
+
+def test_group_size_tradeoff_moves_the_right_way():
+    """The tradeoff the tuner exists to walk, pinned mechanically:
+    growing the group size SHRINKS the every-round summary bytes,
+    while for one divergent lane amid a dense live region it GROWS
+    the lanes dragged onto the wire (the whole mismatched group
+    ships)."""
+    seed_node = Node(2, E, A)
+    for e in range(0, 120):
+        seed_node.add(e)
+    body = seed_node.extract_slice(np.ones(E, bool))
+
+    assert len(digestsync.node_summary(seed_node, 128)) < \
+        len(digestsync.node_summary(seed_node, 32)) < \
+        len(digestsync.node_summary(seed_node, 16))
+
+    lanes = {}
+    for gs in (16, 128):
+        server = Node(3, E, A)
+        server.apply_payload_body(body)
+        addr = server.serve("127.0.0.1", 0)
+        try:
+            client = Node(2, E, A)
+            client.apply_payload_body(body)
+            client.add(121)  # one divergent lane beside the live block
+            st = sync_digest(client, addr, group_size=gs)
+            assert st.groups_mismatched == 1
+            lanes[gs] = st.lanes_sent
+        finally:
+            server.close()
+    assert lanes[128] > lanes[16] > 0, lanes
+
+
+def test_adaptive_ladder_streaks():
+    """Grow on sustained quiescence, shrink on sustained sparse
+    divergence, ignore δ-fallback rounds, respect pins and bounds."""
+    from go_crdt_playground_tpu.net.digestsync import (AdaptiveGroupSize,
+                                                       DigestSyncStats)
+
+    ad = AdaptiveGroupSize(E)
+    p = ("127.0.0.1", 9999)
+
+    def stats(groups, lanes, mode=MODE_DIGEST):
+        return DigestSyncStats(0, 0, mode, mode, lanes, groups,
+                               groups == 0 and lanes == 0)
+
+    assert ad.size(p) == 64  # DIGEST_GROUP_LANES default
+    moves = [ad.observe(p, stats(0, 0)) for _ in range(4)]
+    assert moves == ["hold"] * 3 + ["grow"] and ad.size(p) == 128
+    # at the top rung, further quiescence holds
+    assert [ad.observe(p, stats(0, 0)) for _ in range(5)] \
+        == ["hold"] * 5
+    assert ad.size(p) == 128
+    # sustained sparse divergence (1 of 2 groups at gs=128 is NOT
+    # sparse; 1 of 16 at gs=16 is — use the fraction rule at 128:
+    # total groups = 2, max(1, 2//8)=1, so 1 mismatched group counts)
+    moves = [ad.observe(p, stats(1, 3)) for _ in range(2)]
+    assert moves == ["hold", "shrink"] and ad.size(p) == 64
+    # DENSE divergence moves nothing (coarse is right when most of
+    # the state ships anyway)
+    total = digestsync.num_groups(E, 64)
+    assert ad.observe(p, stats(total, 200)) == "hold"
+    assert ad.size(p) == 64
+    # δ-fallback rounds carry no digest evidence
+    assert ad.observe(p, stats(0, 50, mode=MODE_DELTA)) == "hold"
+    # pin wins forever (the pre-adaptive-server negotiation outcome)
+    ad.pin(p, 64)
+    for _ in range(10):
+        assert ad.observe(p, stats(0, 0)) == "hold"
+    assert ad.size(p) == 64
+    # a second peer adapts independently
+    q = ("127.0.0.1", 9998)
+    assert ad.size(q) == 64
+    with pytest.raises(ValueError):
+        AdaptiveGroupSize(E, initial=48)
+
+
+def test_supervisor_adapts_group_size_online():
+    """End to end through the supervisor: a quiescent peer's group
+    size grows (summary bytes per round shrink), and the gauge +
+    transition counters record it."""
+    from go_crdt_playground_tpu.net.antientropy import SyncSupervisor
+
+    rec = Recorder()
+    a = Node(0, E, A, recorder=rec)
+    b = Node(1, E, A)
+    b.add(1, 2, 3)
+    addr = b.serve("127.0.0.1", 0)
+    sup = SyncSupervisor(a, [addr], sync_mode="digest", recorder=rec)
+    try:
+        for _ in range(8):
+            sup.sync_round()
+        assert rec.counter("digest.group_grow") >= 1
+        assert sup._group_adapter.size(addr) > 64
+        assert rec.snapshot()["gauges"]["digest.group_size"] > 64
+        assert sorted(a.members()) == [1, 2, 3]
+    finally:
+        sup.stop(timeout=1.0)
+        b.close()

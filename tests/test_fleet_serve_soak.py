@@ -120,3 +120,48 @@ def test_fleet_serve_soak_mesh_quick_mode(tmp_path):
     assert crash["phantom_members"] == []
     assert crash["unfinished"] == []
     assert crash["final_members"] == crash["elements"]
+
+
+@pytest.mark.slow
+def test_fleet_serve_soak_autopilot_quick_mode(tmp_path):
+    """The fleet-autopilot soak (--autopilot --quick, DESIGN.md §21):
+    a REAL ``autopilot`` CLI subprocess watching a real fleet must
+    split a flash-crowded keyspace onto standby shards (convergence:
+    windowed p99 + op-rate imbalance back inside the declared budgets),
+    keep no fleet dependency on itself (SIGKILL leg), resume from the
+    router's persisted committed ring, and drain cold — with zero
+    acked-op loss, zero phantoms, and every committed action present
+    in the decision log with its triggering signals."""
+    import fleet_serve_soak
+
+    out = str(tmp_path / "CONTROL_CURVE.json")
+    rc = fleet_serve_soak.main(["--autopilot", "--quick", "--out", out])
+    assert rc == 0, "autopilot soak failed (no split, no convergence, " \
+                    "controller dependency, or acked-op loss)"
+    with open(out) as f:
+        artifact = json.load(f)
+
+    # every leg: ack-or-typed-reject through the live handoffs
+    for name, leg in artifact["legs"].items():
+        assert leg["unresolved"] == 0, (name, leg)
+        assert leg["goodput"] > 0, (name, leg)
+    # the controller held at a healthy fleet, split under the crowd,
+    # and the harness's own windowed timeline converged
+    assert artifact["rings"]["after_baseline_generation"] == 0
+    assert artifact["actions"]["splits_committed"] >= 1
+    assert artifact["convergence"]["converged"], artifact["convergence"]
+    # controller SIGKILL: the fleet is never a hostage
+    ck = artifact["controller_kill"]
+    assert ck["acked_during_outage"] > 0
+    assert ck["unresolved_during_outage"] == 0
+    assert ck["ring_generation_stable"]
+    assert ck["resumed_generation_matches"]
+    assert ck["adopted_nonempty"]
+    # the restarted controller drained its predecessor's standby, and
+    # the decision logs account for every generation bump with signals
+    assert artifact["actions"]["merge_after_restart"]
+    assert artifact["actions"]["committed_matches_generation"]
+    assert artifact["actions"]["with_trigger_signals"] == \
+        artifact["actions"]["committed_total"]
+    assert artifact["lost_acked_ops"] == []
+    assert artifact["phantom_members"] == []

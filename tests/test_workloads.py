@@ -1,0 +1,68 @@
+"""Named seeded workload generators (tools/workloads.py): determinism,
+skew shape, flash-crowd scheduling — the distributions every soak leg
+now declares in its artifact."""
+
+import os
+import sys
+from collections import Counter
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import workloads  # noqa: E402
+
+
+def _draw(picker, n, frac_of=None):
+    return [picker.pick(i, (i / n) if frac_of is None else frac_of)
+            for i in range(n)]
+
+
+def test_cycle_is_the_historical_picker():
+    p = workloads.CycleKeys(7)
+    assert p.name == "uniform-cycle"
+    assert _draw(p, 15) == [i % 7 for i in range(15)]
+
+
+def test_seeded_pickers_replay():
+    for make in (lambda s: workloads.UniformKeys(64, seed=s),
+                 lambda s: workloads.ZipfKeys(64, s=1.1, seed=s),
+                 lambda s: workloads.FlashCrowd(
+                     workloads.ZipfKeys(64, seed=s), [1, 2, 3],
+                     hot_prob=0.4, seed=s)):
+        assert _draw(make(9), 200) == _draw(make(9), 200)
+        assert _draw(make(9), 200) != _draw(make(10), 200)
+
+
+def test_zipf_skew_and_rank_shuffle():
+    z = workloads.ZipfKeys(256, s=1.0, seed=3)
+    draws = Counter(_draw(z, 8000))
+    top = z.hottest(1)[0]
+    # rank 1 carries ~1/H(256) ≈ 16% of the mass; far above uniform
+    assert draws[top] / 8000 > 0.08
+    # the hot keys are a seed property, not always the low ids
+    assert workloads.ZipfKeys(256, s=1.0, seed=3).hottest(5) != \
+        workloads.ZipfKeys(256, s=1.0, seed=4).hottest(5)
+    assert all(0 <= k < 256 for k in draws)
+
+
+def test_flash_crowd_window():
+    hot = [200, 201, 202]
+    f = workloads.FlashCrowd(workloads.CycleKeys(64), hot,
+                             start_frac=0.5, stop_frac=1.0,
+                             hot_prob=1.0, seed=1)
+    before = [f.pick(i, 0.2) for i in range(100)]
+    during = [f.pick(i, 0.7) for i in range(100)]
+    assert not any(k in hot for k in before)
+    assert all(k in hot for k in during)
+    assert "flash" in f.name and f.base.name in f.name
+    with pytest.raises(ValueError):
+        workloads.FlashCrowd(workloads.CycleKeys(4), [])
+
+
+def test_shuffled_universe():
+    a = workloads.shuffled_universe(50, 7)
+    assert sorted(a) == list(range(50))
+    assert a == workloads.shuffled_universe(50, 7)
+    assert a != workloads.shuffled_universe(50, 8)

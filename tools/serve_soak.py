@@ -55,6 +55,9 @@ from typing import Dict, List, Optional, Set, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import workloads  # noqa: E402  (tools/workloads.py: named seeded pickers)
 
 from go_crdt_playground_tpu.serve import protocol  # noqa: E402
 from go_crdt_playground_tpu.serve.client import ServeClient  # noqa: E402
@@ -132,9 +135,20 @@ class _Tally:
 
 def open_loop_leg(addr, rate: float, duration_s: float, elements: int,
                   n_conns: int = 4, deadline_s: float = 1.0,
-                  del_every: int = 10) -> Dict[str, object]:
+                  del_every: int = 10,
+                  keys: Optional[workloads.KeyPicker] = None,
+                  ledgered: bool = False) -> Dict[str, object]:
     """Offer ops at ``rate`` for ``duration_s`` (pipelined, paced);
-    measure goodput/shed/latency from the client side."""
+    measure goodput/shed/latency from the client side.  ``keys`` names
+    the key distribution (tools/workloads.py; default the historical
+    ``uniform-cycle``) and is recorded in the leg.  ``ledgered`` adds
+    ``submitted_elements`` / ``acked_elements`` to the result — the
+    per-element ack ledger the autopilot soak's zero-loss adjudication
+    reads (computed AFTER the grace wait by walking the resolved ops,
+    so it never races the reader threads)."""
+    if keys is None:
+        keys = workloads.CycleKeys(elements)
+    ledger: List[Tuple[int, int, object]] = []  # (kind, element, op)
     tally = _Tally()
     clients = [ServeClient(addr, timeout=30.0, on_result=tally.on_result)
                for _ in range(n_conns)]
@@ -153,10 +167,13 @@ def open_loop_leg(addr, rate: float, duration_s: float, elements: int,
             kind = (protocol.OP_DEL
                     if del_every and i % del_every == del_every - 1
                     else protocol.OP_ADD)
+            e = keys.pick(i, (now - t0) / duration_s)
             try:
-                clients[i % n_conns].submit_async(
-                    kind, [i % elements], deadline_s=deadline_s)
+                op = clients[i % n_conns].submit_async(
+                    kind, [e], deadline_s=deadline_s)
                 submitted += 1
+                if ledgered:
+                    ledger.append((kind, e, op))
             except (OSError, ConnectionError):
                 send_errors += 1
             i += 1
@@ -203,10 +220,25 @@ def open_loop_leg(addr, rate: float, duration_s: float, elements: int,
         }
     except (OSError, ConnectionError):
         pass
+    extra: Dict[str, object] = {}
+    if ledgered:
+        # walked AFTER every op resolved (or the grace cap hit): adds
+        # that acked must appear in the final membership, and nothing
+        # outside the submitted set may
+        extra["submitted_elements"] = sorted(
+            {e for k, e, _ in ledger if k == protocol.OP_ADD})
+        extra["acked_elements"] = sorted(
+            {e for k, e, op in ledger
+             if k == protocol.OP_ADD and op.acked})
+        extra["acked_deletes"] = sorted(
+            {e for k, e, op in ledger
+             if k == protocol.OP_DEL and op.acked})
     with tally.lock:
         shed = tally.overloaded
         resolved = tally.acked + shed + tally.expired + tally.other
         return {
+            "workload": keys.name,
+            **extra,
             "offered_rate": rate,
             "achieved_offer_rate": round(submitted / elapsed, 1),
             "submitted": submitted,
@@ -472,8 +504,7 @@ def crash_leg(root: str, elements: int, *, queue_depth: int,
         finally:
             client.close()
 
-    todo = list(range(elements))
-    rng.shuffle(todo)
+    todo = workloads.shuffled_universe(elements, seed, rng=rng)
 
     # cycle 1: the deterministic between-fsync-and-ack window — the
     # worker SIGKILLs ITSELF right after batch #window_batches' WAL
@@ -524,6 +555,7 @@ def crash_leg(root: str, elements: int, *, queue_depth: int,
     phantom = sorted(members_set - submitted)
     return {
         "elements": elements,
+        "workload": workloads.SHUFFLED_UNIVERSE,
         "kills": kills,
         # the final incarnation's WAL record-mode census: with compact
         # records on (the default worker), recovery must have REPLAYED
@@ -588,8 +620,7 @@ def chaos_leg(root: str, elements: int, *, queue_depth: int,
     ops_on_conn = 0
     worker_done = False
     try:
-        todo = list(range(elements))
-        rng.shuffle(todo)
+        todo = workloads.shuffled_universe(elements, seed, rng=rng)
         partition_at = len(todo) // 2
         partitioned = False
         for n, e in enumerate(todo):
@@ -662,6 +693,7 @@ def chaos_leg(root: str, elements: int, *, queue_depth: int,
     counters = proxy.counters()
     return {
         "elements": elements,
+        "workload": workloads.SHUFFLED_UNIVERSE,
         # derived from the ACTUAL scenario object, so the committed
         # artifact can never misreport the injected rates
         "scenario": {"drop_rate": scenario.drop_rate,

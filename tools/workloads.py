@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Shared seeded workload generators for the soak harnesses.
+
+Every soak leg used to roll its own key picker inline — ``i %
+elements`` in tools/serve_soak.py's open loop, a seeded
+``rng.shuffle(range(E))`` in the ledgered fleet legs — which left the
+key DISTRIBUTION of each committed artifact implicit in harness code.
+This module names them: a leg takes a picker (or a shuffled universe)
+and records ``picker.name`` in its artifact, so SERVE_CURVE /
+SHARD_CURVE / CONTROL_CURVE legs all declare what they offered.
+
+Pickers are deterministic functions of (seed, i, t_frac): the same
+seed replays the same key stream, which the autopilot soak's
+decision-log adjudication leans on.
+
+* ``CycleKeys`` — the historical open-loop picker: ``i % E``
+  (round-robin over the universe; perfectly uniform, zero locality).
+* ``UniformKeys`` — seeded iid uniform draws.
+* ``ZipfKeys`` — seeded Zipf(s) draws over a seed-shuffled rank→key
+  map (the skew is real but WHICH keys are hot depends on the seed,
+  like production traffic), the adversarial half of the autopilot
+  soak's workload.
+* ``FlashCrowd`` — wraps any base picker: inside the
+  ``[start_frac, stop_frac)`` window of the leg, each draw lands on
+  one small hot key set with probability ``hot_prob`` — the
+  "mid-run flash crowd onto one keyspace" the fleet autopilot must
+  split its way out of.
+* ``shuffled_universe`` — the ledgered legs' submit-once order: every
+  element exactly once, seed-shuffled.
+"""
+
+from __future__ import annotations
+
+import bisect
+import random
+from typing import List, Optional, Sequence
+
+
+class KeyPicker:
+    """One named deterministic key stream: ``pick(i, t_frac)`` returns
+    the element id for the leg's i-th op, ``t_frac`` in [0, 1] the
+    leg's progress (time-scheduled pickers key off it; the rest ignore
+    it)."""
+
+    name = "abstract"
+
+    def pick(self, i: int, t_frac: float = 0.0) -> int:
+        raise NotImplementedError
+
+    def __call__(self, i: int, t_frac: float = 0.0) -> int:
+        return self.pick(i, t_frac)
+
+
+class CycleKeys(KeyPicker):
+    """``i % E`` — the historical open-loop picker, named."""
+
+    def __init__(self, elements: int):
+        self.elements = int(elements)
+        self.name = "uniform-cycle"
+
+    def pick(self, i: int, t_frac: float = 0.0) -> int:
+        return i % self.elements
+
+
+class UniformKeys(KeyPicker):
+    """Seeded iid uniform draws over the universe."""
+
+    def __init__(self, elements: int, seed: int = 0):
+        self.elements = int(elements)
+        self._rng = random.Random(seed)
+        self.name = "uniform-iid"
+
+    def pick(self, i: int, t_frac: float = 0.0) -> int:
+        return self._rng.randrange(self.elements)
+
+
+class ZipfKeys(KeyPicker):
+    """Seeded Zipf(s) draws: rank r gets probability ∝ 1/r^s, and the
+    rank→key map is a seed-shuffled permutation of the universe (the
+    hot keys are a seed property, not always ids 0..k — a fleet
+    sharded by key hash must see the skew land on arbitrary owners).
+    Draw = one rng.random() + one bisect over the precomputed CDF."""
+
+    def __init__(self, elements: int, s: float = 1.0, seed: int = 0):
+        if elements < 1:
+            raise ValueError("elements must be >= 1")
+        self.elements = int(elements)
+        self.s = float(s)
+        self._rng = random.Random(seed)
+        weights = [1.0 / (r ** self.s) for r in range(1, elements + 1)]
+        total = sum(weights)
+        cdf: List[float] = []
+        acc = 0.0
+        for w in weights:
+            acc += w / total
+            cdf.append(acc)
+        self._cdf = cdf
+        keys = list(range(elements))
+        self._rng.shuffle(keys)
+        self._rank_to_key = keys
+        self.name = f"zipf(s={self.s:g})"
+
+    def hottest(self, n: int) -> List[int]:
+        """The n highest-probability keys (rank order) — what a soak
+        uses to aim a flash crowd at the already-warm keyspace."""
+        return list(self._rank_to_key[:n])
+
+    def pick(self, i: int, t_frac: float = 0.0) -> int:
+        r = bisect.bisect_left(self._cdf, self._rng.random())
+        return self._rank_to_key[min(r, self.elements - 1)]
+
+
+class FlashCrowd(KeyPicker):
+    """Base distribution plus a scheduled crowd: inside
+    ``[start_frac, stop_frac)`` of the leg each draw hits the hot set
+    (uniformly within it) with probability ``hot_prob`` — outside the
+    window the base picker runs unmodified."""
+
+    def __init__(self, base: KeyPicker, hot_keys: Sequence[int], *,
+                 start_frac: float = 0.25, stop_frac: float = 1.0,
+                 hot_prob: float = 0.5, seed: int = 0):
+        if not hot_keys:
+            raise ValueError("a flash crowd needs a non-empty hot set")
+        if not 0.0 <= start_frac < stop_frac:
+            raise ValueError("need 0 <= start_frac < stop_frac")
+        self.base = base
+        self.hot_keys = [int(k) for k in hot_keys]
+        self.start_frac = float(start_frac)
+        self.stop_frac = float(stop_frac)
+        self.hot_prob = float(hot_prob)
+        self._rng = random.Random(seed)
+        self.name = (f"{base.name}+flash(n={len(self.hot_keys)},"
+                     f"p={self.hot_prob:g},"
+                     f"[{self.start_frac:g},{self.stop_frac:g}))")
+
+    def pick(self, i: int, t_frac: float = 0.0) -> int:
+        if (self.start_frac <= t_frac < self.stop_frac
+                and self._rng.random() < self.hot_prob):
+            return self.hot_keys[self._rng.randrange(len(self.hot_keys))]
+        return self.base.pick(i, t_frac)
+
+
+SHUFFLED_UNIVERSE = "shuffled-universe"
+
+
+def shuffled_universe(elements: int, seed: int,
+                      rng: Optional[random.Random] = None) -> List[int]:
+    """The ledgered legs' submit-once order (every element exactly
+    once, seed-shuffled) — name it ``SHUFFLED_UNIVERSE`` in the
+    artifact.  Pass ``rng`` to draw from a leg's existing stream
+    instead of a fresh seed."""
+    todo = list(range(elements))
+    (rng if rng is not None else random.Random(seed)).shuffle(todo)
+    return todo
