@@ -243,12 +243,17 @@ def _cmd_router(args) -> int:
     import signal
     import threading
 
+    if args.standby_of is not None:
+        return _cmd_router_standby(args, shards)
+
     from go_crdt_playground_tpu.shard.router import ShardRouter
 
     router = ShardRouter(shards, args.elements, seed=args.seed,
                          state_dir=args.state_dir,
                          transfer_timeout_s=args.transfer_timeout,
-                         fleet_gc_interval_s=args.fleet_gc_interval)
+                         fleet_gc_interval_s=args.fleet_gc_interval,
+                         router_epoch=args.router_epoch,
+                         router_id=args.router_id)
     # the banner's load split reuses the router's OWN precomputed owner
     # map — recomputing it here would double the O(E x shards) blake2b
     # startup cost for a log line
@@ -271,6 +276,89 @@ def _cmd_router(args) -> int:
     fwd = snap["counters"].get("router.ops.forwarded", 0)
     acks = snap["counters"].get("router.acks.relayed", 0)
     print(f"drained: {fwd} ops forwarded, {acks} acks relayed", flush=True)
+    return 0
+
+
+def _cmd_router_standby(args, shards) -> int:
+    """The warm-standby router (DESIGN.md §22): tail the primary's
+    committed ring, promote on its death under a bumped fenced epoch,
+    and only THEN print the standard ``listening on`` banner — so the
+    operator's (and the fleet runner's) address handshake doubles as
+    the promotion signal."""
+    import signal
+    import threading
+
+    from go_crdt_playground_tpu.shard.ha import RouterStandby
+
+    if args.port == 0:
+        print("error: --standby-of requires a fixed --port (clients "
+              "carry the standby address in their ordered failover "
+              "list BEFORE promotion)", file=sys.stderr, flush=True)
+        return 2
+    if args.state_dir is None:
+        print("error: --standby-of requires --state-dir (the tailed "
+              "ring and the fenced router epoch must persist)",
+              file=sys.stderr, flush=True)
+        return 2
+    standby = RouterStandby(
+        tuple(args.standby_of), shards, args.elements, seed=args.seed,
+        state_dir=args.state_dir,
+        standby_id=args.router_id or "router-standby",
+        listen_addr=("127.0.0.1", args.port),
+        poll_interval_s=args.ha_poll_interval,
+        failure_threshold=args.ha_failure_threshold,
+        router_kwargs={"transfer_timeout_s": args.transfer_timeout,
+                       "fleet_gc_interval_s": args.fleet_gc_interval})
+    standby.start()
+    print(f"Router standby engaged (primary="
+          f"{args.standby_of[0]}:{args.standby_of[1]} "
+          f"port={args.port} id={standby.standby_id} "
+          f"poll={args.ha_poll_interval}s "
+          f"threshold={args.ha_failure_threshold})", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    promoted = False
+    tailing_announced = False
+    try:
+        while not stop.is_set():
+            if not tailing_announced:
+                rec = standby.last_record
+                if rec is not None:
+                    # the scriptable warm handshake: a standby that
+                    # has never printed this line has never tailed and
+                    # will NOT promote (shard/ha.py blocks promotion
+                    # without a tailed record — epoch collision risk)
+                    print(f"Router standby tailing primary ring "
+                          f"(generation={rec.get('generation')} "
+                          f"digest={rec.get('digest')} "
+                          f"router-epoch={rec.get('router_epoch')})",
+                          flush=True)
+                    tailing_announced = True
+            if standby.await_promoted(0.2):
+                promoted = True
+                break
+    except KeyboardInterrupt:
+        pass
+    if promoted:
+        router = standby.router
+        rinfo = router.route().info()
+        print(f"Shard router listening on 127.0.0.1:{args.port} "
+              f"(E={args.elements} shards={list(router.ring.shards)} "
+              f"seed={args.seed} ring gen={rinfo['generation']} "
+              f"digest={rinfo['digest']} "
+              f"router-epoch={router.router_epoch} "
+              f"promoted-after={standby.promotion_s:.2f}s "
+              f"reason={standby.promote_reason!r})", flush=True)
+        try:
+            stop.wait()
+        except KeyboardInterrupt:
+            pass
+        snap = router.recorder.snapshot()
+        fwd = snap["counters"].get("router.ops.forwarded", 0)
+        acks = snap["counters"].get("router.acks.relayed", 0)
+        print(f"drained: {fwd} ops forwarded, {acks} acks relayed",
+              flush=True)
+    standby.close()
     return 0
 
 
@@ -319,8 +407,9 @@ def _cmd_autopilot(args) -> int:
         min_shards=args.min_shards,
         max_shards=args.max_shards,
         cold_rate_per_shard=args.cold_rate)
+    routers = [tuple(a) for a in args.router]
     pilot = FleetAutopilot(
-        tuple(args.router), args.standby, config=config,
+        routers, args.standby, config=config,
         poll_interval_s=args.poll_interval,
         reshard_timeout_s=args.reshard_timeout,
         decision_log=args.decision_log, seed=args.seed)
@@ -330,7 +419,7 @@ def _cmd_autopilot(args) -> int:
         print(f"error: {e}", file=sys.stderr, flush=True)
         return 1
     print(f"Fleet autopilot engaged over router "
-          f"{args.router[0]}:{args.router[1]} "
+          f"{'+'.join(f'{h}:{p}' for h, p in routers)} "
           f"(ring gen={resumed['generation']} "
           f"shards={resumed['shards']} "
           f"standbys={resumed['standbys']} "
@@ -525,6 +614,34 @@ def main(argv=None) -> int:
                         "minimum and pushes it back for clamped local GC "
                         "(ROADMAP item c; requires every shard reachable "
                         "per round)")
+    r.add_argument("--router-epoch", dest="router_epoch", type=int,
+                   default=0,
+                   help="router-leadership epoch (DESIGN.md §22, 0 = "
+                        "fence dormant): shards adjudicate admin verbs "
+                        "against the highest epoch they have seen — an "
+                        "HA primary starts at 1, a promoted standby "
+                        "persists primary+1.  The persisted record in "
+                        "--state-dir wins over a smaller flag")
+    r.add_argument("--router-id", dest="router_id", default=None,
+                   help="stable router identity for epoch records and "
+                        "HA logs (default: router-<pid>)")
+    r.add_argument("--standby-of", dest="standby_of", default=None,
+                   type=_peer_addr, metavar="HOST:PORT",
+                   help="run as the WARM STANDBY of the primary router "
+                        "at this address (DESIGN.md §22): tail its "
+                        "committed ring into --state-dir, promote on "
+                        "its death under a bumped fenced epoch, then "
+                        "serve on --port (which must be fixed — "
+                        "clients list it as their failover address).  "
+                        "Requires --state-dir; --shard flags are the "
+                        "fallback fleet if no ring was ever tailed")
+    r.add_argument("--ha-poll-interval", dest="ha_poll_interval",
+                   type=float, default=0.25,
+                   help="standby health/tail poll cadence in seconds")
+    r.add_argument("--ha-failure-threshold", dest="ha_failure_threshold",
+                   type=int, default=3,
+                   help="consecutive failed polls before the standby "
+                        "promotes itself")
 
     rs = sub.add_parser(
         "reshard",
@@ -553,7 +670,13 @@ def main(argv=None) -> int:
              "itself — split hot keyspaces onto standby shards, drain "
              "cold ones, one action in flight, typed aborts cool down")
     ap_p.add_argument("--router", required=True, metavar="HOST:PORT",
-                      type=_peer_addr, help="the router's client address")
+                      type=_peer_addr, action="append", default=None,
+                      help="the router's client address; repeatable as "
+                           "an ORDERED failover list (primary first, "
+                           "then warm standbys — DESIGN.md §22): the "
+                           "controller re-resolves the active router "
+                           "through it and rides a failover with only "
+                           "a counted poll failure")
     ap_p.add_argument("--standby", action="append", default=[],
                       type=_shard_spec, metavar="ID=HOST:PORT",
                       help="one standby serve --ingest frontend the "

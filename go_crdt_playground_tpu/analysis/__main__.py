@@ -70,7 +70,11 @@ LOCK_TARGETS = ["net/peer.py", "net/antientropy.py", "net/digestsync.py",
                 # loop thread with start/stop owners and post-stop
                 # readers — swept like every other runtime tier
                 "control/signals.py", "control/policy.py",
-                "control/actuator.py", "control/controller.py"]
+                "control/actuator.py", "control/controller.py",
+                # the router HA tier (ISSUE 13): the standby's tail
+                # loop thread, the promotion path, and await/observer
+                # readers all cross on the standby lock
+                "shard/ha.py"]
 # extra files that participate in the lock-ORDER graph (their locks can
 # nest under the runtime's)
 LOCK_ORDER_EXTRA = ["utils/checkpoint.py"]
@@ -99,7 +103,8 @@ ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "actuator": "ReshardActuator",
                 "signals": "FleetSignals",
                 "pool": "StandbyPool",
-                "pilot": "FleetAutopilot"}
+                "pilot": "FleetAutopilot",
+                "standby": "RouterStandby"}
 
 # the full pass list (report keys): the report-freshness lint pins the
 # COMMITTED artifact's pass list to this — landing a new pass without
@@ -108,7 +113,7 @@ ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
 REGISTERED_PASSES = ("lockdiscipline", "locksets", "durability",
                      "purity", "lattice_laws", "protocol_contract",
                      "codec_symmetry", "metrics_contract",
-                     "report_freshness")
+                     "report_freshness", "thread_shadow")
 
 
 def _paths(rel: List[str], root: str) -> List[str]:
@@ -243,7 +248,8 @@ def build_report(fast: bool, root: str = PKG_ROOT,
                                                  durability, lattice_laws,
                                                  lockdiscipline,
                                                  metrics_contract,
-                                                 protocol_contract, purity)
+                                                 protocol_contract, purity,
+                                                 thread_shadow)
     from go_crdt_playground_tpu.analysis.report import Report
 
     report = Report()
@@ -287,6 +293,12 @@ def build_report(fast: bool, root: str = PKG_ROOT,
     f7, s7 = metrics_contract.analyze(root)
     report.extend(f7)
     report.add_stats("metrics_contract", **s7)
+
+    # T001 Thread-subclass attribute shadowing (the PR-12
+    # _stop-breaks-join() bug class, now gate-time)
+    f8, s8 = thread_shadow.analyze(root)
+    report.extend(f8)
+    report.add_stats("thread_shadow", **s8)
 
     if committed_report is None:
         committed_report = os.path.join(os.path.dirname(root),

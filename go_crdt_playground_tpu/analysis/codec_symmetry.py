@@ -260,6 +260,18 @@ def build_codecs() -> List[CodecSpec]:
                      .astype(np.uint8).tobytes()),
         lambda a: a, P, ("encode_dsum_reply", "decode_dsum_reply"),
         self_delimiting=False)
+    add("ring_sync", p.encode_ring_sync, p.decode_ring_sync,
+        lambda rng: (_rid(rng), int(rng.integers(0, 1 << 16)),
+                     "router-" + str(int(rng.integers(100)))),
+        lambda a: a, P, ("encode_ring_sync", "decode_ring_sync"))
+    add("ring_sync_reply", p.encode_ring_sync_reply,
+        p.decode_ring_sync_reply,
+        lambda rng: (_rid(rng),
+                     {"router_epoch": int(rng.integers(0, 1 << 16)),
+                      "generation": int(rng.integers(100))}),
+        lambda a: a, P,
+        ("encode_ring_sync_reply", "decode_ring_sync_reply"),
+        self_delimiting=False)
 
     # -- net/framing.py ------------------------------------------------------
     add("hello", framing.encode_hello,

@@ -46,7 +46,7 @@ from go_crdt_playground_tpu.analysis.report import (METRICS_CONTRACT,
 _NAME_RE = re.compile(r"^([a-z][a-z0-9_]*|\*)(\.[a-z0-9_*:]+)+\*?$")
 # path-ish literals that match the dotted shape but are not metrics
 _NOT_METRIC_RE = re.compile(
-    r"\.(json|py|sh|log|md|txt|ckpt|tmp|wal|proto|cpp|go|toml)$|/")
+    r"\.(json|jsonl|py|sh|log|md|txt|ckpt|tmp|wal|proto|cpp|go|toml)$|/")
 
 _EMIT_METHODS = {"count", "observe", "set_gauge", "_count"}
 

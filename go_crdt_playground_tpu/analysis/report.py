@@ -35,6 +35,7 @@ CODEC_ASYMMETRY = "W003"        # encode/decode pair broke its contract
 FRAME_CAP_MISSING = "W004"      # recv_frame call site without max_body
 METRICS_CONTRACT = "M001"       # metric name referenced/emitted drift
 REPORT_STALE = "F001"           # committed report's pass list is stale
+THREAD_SHADOW = "T001"          # Thread subclass shadows a Thread internal
 
 
 @dataclass

@@ -68,11 +68,19 @@ class ReshardActuator:
     DEFAULT_POLICY = BackoffPolicy(base_s=0.2, multiplier=2.0, cap_s=2.0,
                                    jitter=0.2, max_retries=4)
 
-    def __init__(self, router_addr: Addr, *,
+    def __init__(self, router_addr, *,
                  reshard_timeout_s: float = 120.0,
                  policy: Optional[BackoffPolicy] = None,
                  recorder=None, seed: int = 0):
-        self.router_addr = (router_addr[0], int(router_addr[1]))
+        from go_crdt_playground_tpu.serve.client import normalize_addrs
+
+        # router HA (DESIGN.md §22): an ordered address list makes
+        # every fresh admin connection re-resolve the ACTIVE router —
+        # an action interrupted by a failover retries against the
+        # promoted standby, and the ring-generation arbitration below
+        # adjudicates it exactly like any other transport ambiguity
+        self.router_addrs = normalize_addrs(router_addr)
+        self.router_addr = self.router_addrs[0]
         self.reshard_timeout_s = float(reshard_timeout_s)
         self.policy = policy if policy is not None else self.DEFAULT_POLICY
         self.recorder = recorder
@@ -165,7 +173,7 @@ class ReshardActuator:
                       addr: Optional[Addr]) -> Tuple[bool, Dict]:
         from go_crdt_playground_tpu.serve.client import ServeClient
 
-        with ServeClient(self.router_addr,
+        with ServeClient(self.router_addrs,
                          timeout=self.reshard_timeout_s,
                          connect_timeout=5.0) as c:
             return c.reshard(mode, sid, addr,
@@ -178,7 +186,7 @@ class ReshardActuator:
         from go_crdt_playground_tpu.serve.client import ServeClient
 
         try:
-            with ServeClient(self.router_addr, timeout=10.0,
+            with ServeClient(self.router_addrs, timeout=10.0,
                              connect_timeout=2.0) as c:
                 ring = c.stats()["ring"]
                 return (int(ring["generation"]),

@@ -115,7 +115,7 @@ class StandbyPool:
 class FleetAutopilot:
     """The closed-loop controller over one router + a standby pool."""
 
-    def __init__(self, router_addr: Addr,
+    def __init__(self, router_addr,
                  standbys: Sequence[Tuple[str, Addr]] = (), *,
                  policy: Optional[AutopilotPolicy] = None,
                  config: Optional[PolicyConfig] = None,
@@ -124,17 +124,24 @@ class FleetAutopilot:
                  decision_log: Optional[str] = None,
                  recorder=None, seed: int = 0):
         from go_crdt_playground_tpu.obs import Recorder
+        from go_crdt_playground_tpu.serve.client import normalize_addrs
 
         if poll_interval_s <= 0:
             raise ValueError("poll_interval_s must be > 0")
-        self.router_addr = (router_addr[0], int(router_addr[1]))
+        # router HA (DESIGN.md §22): with an ordered address list the
+        # STATS poll client and every actuation re-resolve the active
+        # router — the autopilot rides through a failover with only a
+        # counted poll failure, and the decision log's signal records
+        # carry the epoch bump (FleetView.router_epoch)
+        self.router_addrs = normalize_addrs(router_addr)
+        self.router_addr = self.router_addrs[0]
         self.recorder = recorder if recorder is not None else Recorder()
         self.pool = StandbyPool(standbys)
         self.policy = (policy if policy is not None
                        else AutopilotPolicy(config, seed=seed))
         self.signals = FleetSignals()
         self.actuator = ReshardActuator(
-            self.router_addr, reshard_timeout_s=reshard_timeout_s,
+            self.router_addrs, reshard_timeout_s=reshard_timeout_s,
             recorder=self.recorder, seed=seed)
         self.poll_interval_s = float(poll_interval_s)
         self.decision_log_path = decision_log
@@ -178,6 +185,8 @@ class FleetAutopilot:
             "record": "resume",
             "t": round(view.t, 3),
             "router": list(self.router_addr),
+            "router_addrs": [list(a) for a in self.router_addrs],
+            "router_epoch": view.router_epoch,
             "generation": view.generation,
             "digest": view.digest,
             "shards": list(view.shards),
@@ -307,7 +316,7 @@ class FleetAutopilot:
         if self._stats_client is None or self._stats_client.closed:
             self._drop_client()
             self._stats_client = ServeClient(
-                self.router_addr, timeout=30.0, connect_timeout=2.0)
+                self.router_addrs, timeout=30.0, connect_timeout=2.0)
         return self._stats_client
 
     def _drop_client(self) -> None:

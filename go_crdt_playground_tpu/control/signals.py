@@ -68,6 +68,11 @@ class FleetView(NamedTuple):
     fenced: int              # elements currently fenced (handoff live)
     load_stats: Dict         # ring keyspace balance (shard/ring.py)
     per_shard: Dict[str, ShardSignals]
+    # which ROUTER answered this poll (DESIGN.md §22): a failover
+    # shows up as an epoch bump between consecutive views, and every
+    # decision record carries it — the soak adjudicates that a split
+    # after a failover committed through the PROMOTED router
+    router_epoch: int = 0
 
     @property
     def reachable(self) -> List[ShardSignals]:
@@ -88,6 +93,7 @@ class FleetView(NamedTuple):
         return {
             "t": round(self.t, 3),
             "generation": self.generation,
+            "router_epoch": self.router_epoch,
             "shards": list(self.shards),
             "fenced": self.fenced,
             "imbalance": self.imbalance(),
@@ -185,6 +191,7 @@ class FleetSignals:
             shards=tuple(ring.get("shards", [])),
             fenced=int(ring.get("fenced", 0)),
             load_stats=dict(ring.get("load_stats", {})),
-            per_shard=per_shard)
+            per_shard=per_shard,
+            router_epoch=int(ring.get("router_epoch", 0) or 0))
         self.last_view = view
         return view

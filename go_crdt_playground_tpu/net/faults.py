@@ -183,7 +183,13 @@ class ChaosProxy:
         self._counters: Dict[str, int] = {
             "connections": 0, "refused": 0, "dropped": 0, "truncated": 0,
             "garbled": 0, "delayed": 0, "duplicated": 0, "passed": 0,
+            "severed": 0,
         }
+        # live (client, upstream) socket pairs, so a mid-stream phase
+        # flip (sever()) can cut ESTABLISHED pipes — per-connection
+        # plans are drawn at accept, so a long-lived pipelined link
+        # would otherwise never feel a scenario change
+        self._active: set = set()  # guarded-by: _lock
         self._closing = False
         self._sock = socket.socket()
         self._sock.bind(("127.0.0.1", 0))
@@ -205,6 +211,41 @@ class ChaosProxy:
     def heal(self) -> None:
         with self._lock:
             self.scenario.partitioned = False
+
+    def sever(self) -> None:
+        """Abruptly cut every ESTABLISHED proxied connection (both
+        ends) without touching the listener: the peer behind a
+        long-lived pipelined link re-dials and the CURRENT scenario
+        adjudicates the fresh connection — how a mid-stream phase flip
+        (torn-frame window, partition) actually reaches a connection
+        that was planned clean at accept time."""
+        with self._lock:
+            pairs = list(self._active)
+            self._counters["severed"] += len(pairs)
+        for pair in pairs:
+            for s in pair:
+                # shutdown ONLY — the pump threads may be blocked in
+                # recv()/sendall() on these very sockets, and close()
+                # here would free the fd for reuse by a new accepted
+                # connection while the old pump still reads it (cross-
+                # connection corruption); shutdown wakes the pumps and
+                # their own finally blocks close both ends safely
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    def set_scenario(self, **rates) -> None:
+        """Mutate per-connection fault rates live (the fleet soak's
+        router↔shard chaos leg flips torn-frame windows on and off
+        mid-stream).  Unknown field names are refused at the call,
+        not discovered as a silently-ineffective chaos phase."""
+        for name, value in rates.items():
+            if not hasattr(self.scenario, name):
+                raise ValueError(f"unknown scenario field {name!r}")
+        with self._lock:
+            for name, value in rates.items():
+                setattr(self.scenario, name, value)
 
     def counters(self) -> Dict[str, int]:
         with self._lock:
@@ -326,6 +367,9 @@ class ChaosProxy:
             except OSError:
                 pass
             return
+        pair = (conn, upstream)
+        with self._lock:
+            self._active.add(pair)
         recorded: Optional[List[bytes]] = [] if plan.duplicate else None
 
         def pump(src: socket.socket, dst: socket.socket,
@@ -374,13 +418,17 @@ class ChaosProxy:
                         pass
 
         cut = plan.cut_after if plan.action == ACT_TRUNCATE else None
-        t = threading.Thread(
-            target=pump, daemon=True,
-            args=(conn, upstream, cut, plan.garble, plan.garble_offset,
-                  recorded))
-        t.start()
-        pump(upstream, conn, cut, False, None, None)
-        t.join(timeout=5.0)
+        try:
+            t = threading.Thread(
+                target=pump, daemon=True,
+                args=(conn, upstream, cut, plan.garble,
+                      plan.garble_offset, recorded))
+            t.start()
+            pump(upstream, conn, cut, False, None, None)
+            t.join(timeout=5.0)
+        finally:
+            with self._lock:
+                self._active.discard(pair)
         if plan.duplicate and recorded:
             self._replay(b"".join(recorded))
 

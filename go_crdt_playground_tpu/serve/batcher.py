@@ -49,6 +49,13 @@ _CRASH_ENV = "CRDT_SERVE_CRASH_AFTER_BATCHES"
 class MicroBatcher:
     """One thread turning queued ops into packed durable batches."""
 
+    # disk-full degrade window: after an OSError escapes the durable
+    # apply path (ENOSPC, fsync failure), the frontend sheds writes
+    # typed StorageDegraded at ADMISSION for this long, then lets one
+    # batch through as a disk probe — a still-broken disk re-arms the
+    # window, a healed one clears it (serve reads the whole time)
+    STORAGE_RETRY_S = 1.0
+
     def __init__(self, target, queue: AdmissionQueue, *,
                  max_batch: int = 32, flush_s: float = 0.002,
                  idle_wait_s: float = 0.05, recorder=None,
@@ -70,6 +77,13 @@ class MicroBatcher:
         # race-ok: post-mortem breadcrumb (loop thread writes, a
         # post-stop reader inspects); no control flow depends on it
         self.last_error: Optional[BaseException] = None
+        # monotonic deadline of the storage-degrade window (0 = disk
+        # healthy).  race-ok: written only by the batcher loop thread;
+        # listener reader threads poll it through storage_degraded() —
+        # a float store is atomic in CPython, and the worst stale read
+        # costs one op a REJECT_STORAGE-vs-Overloaded classification,
+        # never correctness (both are typed retryable sheds)
+        self._storage_degraded_until = 0.0
         # race-ok: loop-thread-only batch counter driving the SIGKILL
         # test hook (None = hook disabled)
         self._crash_after: Optional[int] = None
@@ -118,6 +132,15 @@ class MicroBatcher:
         self._stop.set()
         t.join(timeout=max(0.1, deadline - self._clock()))
         self._flush_remaining()
+
+    def storage_degraded(self) -> bool:
+        """True while the disk-full degrade window is armed: the
+        admission path sheds writes typed ``StorageDegraded`` instead
+        of queueing them toward a WAL that just refused an fsync.  The
+        window expires on its own (the next admitted batch is the disk
+        probe) and clears immediately on a successful apply."""
+        until = self._storage_degraded_until
+        return bool(until) and self._clock() < until
 
     def _flush_remaining(self) -> None:
         """Post-stop sweep: anything still queued (loop died, or drain
@@ -180,6 +203,27 @@ class MicroBatcher:
         try:
             # durable on return: state applied + batch δ WAL-fsync'd
             self.target.ingest_batch(add_rows, del_rows, live_mask)
+        except OSError as e:
+            # the DISK failed the durable contract (ENOSPC, an fsync
+            # error in the WAL append path — utils/wal.py counts the
+            # site as wal.append_errors): classify typed
+            # StorageDegraded, never the generic Overloaded, and arm
+            # the degrade window the admission path sheds against —
+            # reads keep serving, writes shed typed until a probe
+            # batch survives this call again
+            self.last_error = e
+            self._storage_degraded_until = (self._clock()
+                                            + self.STORAGE_RETRY_S)
+            self._count("serve.batch_errors")
+            for r in live:
+                self._count("serve.shed.storage")
+                r.session.send(
+                    protocol.MSG_REJECT,
+                    protocol.encode_reject(
+                        r.req_id, protocol.REJECT_STORAGE,
+                        f"durable WAL append failed (storage "
+                        f"degraded; retry with backoff): {e}"))
+            return
         except Exception as e:  # noqa: BLE001 — poison batch: reject
             # its (not-yet-replied) ops as RETRYABLE — an apply failure
             # is transient server trouble (disk error, kernel fault),
@@ -196,6 +240,10 @@ class MicroBatcher:
                         r.req_id, protocol.REJECT_OVERLOADED,
                         f"batch apply failed (retry): {e}"))
             return
+        if self._storage_degraded_until:
+            # the probe batch survived: the disk recovered — clear the
+            # degrade window so admission stops shedding writes
+            self._storage_degraded_until = 0.0
         if self._crash_after is not None:
             self._crash_after -= 1
             if self._crash_after <= 0:

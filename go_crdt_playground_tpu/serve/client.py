@@ -14,6 +14,29 @@ UNRESOLVED, not acked — ``PendingOp.wait`` raises ``ConnectionError``
 for it.  The protocol is deliberately at-least-once: ops are idempotent
 CRDT mutations, so the client-side retry for an ambiguous outcome is a
 plain resubmit.
+
+**Router-HA failover (DESIGN.md §22).**  ``addr`` may be an ORDERED
+LIST of addresses — a primary router and its warm standby(s).  The
+client serves through one connection at a time; when that connection
+dies it rotates to the next address on the next attempt (wrapping, so
+a recovered primary is retried too).  The failover contract is typed
+and idempotence-aware:
+
+* **in-flight OPs** whose ack died with the old router resolve with the
+  typed ``AmbiguousOp`` (a ``ConnectionError`` subclass): the outcome
+  is UNKNOWN — the op may be durably applied behind the dead ack.
+  They are NEVER silently resent: the caller's ledger decides to
+  resubmit (idempotent), which is what keeps the zero-phantom
+  invariant adjudicable.
+* **idempotence-safe reads** (QUERY/STATS/DSUM/RING_SYNC) retry
+  transparently on the successor address — a dashboard or autopilot
+  poll rides through a failover without seeing it.
+* **non-idempotent verbs** (OP submit, RESHARD, SLICE_*, GC/FRONTIER)
+  stay single-shot per call; only the NEXT call dials the successor.
+
+A single-address client behaves exactly as before: its reader's death
+flips ``closed`` and every later submit fails fast (the connection-pool
+sweep contract ``shard/router._ShardLink`` relies on).
 """
 
 from __future__ import annotations
@@ -21,12 +44,45 @@ from __future__ import annotations
 import socket
 import threading
 import time
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from go_crdt_playground_tpu.net import framing
 from go_crdt_playground_tpu.serve import protocol
+
+Addr = Tuple[str, int]
+
+
+class AmbiguousOp(ConnectionError):
+    """An in-flight op's connection died before its ack/reject arrived
+    (router failover, SIGKILL): the outcome is UNKNOWN — the op may be
+    durably applied on its shard behind the dead reply stream.  Typed
+    so a ledgered workload can count ambiguity separately from true
+    unresolved transport loss, then resubmit idempotently.  Subclasses
+    ``ConnectionError`` on purpose: every pre-HA call site that treated
+    connection death as resubmit-and-continue keeps doing so."""
+
+
+def _is_multi_addr(addr) -> bool:
+    """A (host, port) pair vs a sequence of them: the pair's first
+    element is a string, an address list's first element is not."""
+    return (isinstance(addr, (list, tuple)) and len(addr) > 0
+            and not isinstance(addr[0], str))
+
+
+def normalize_addrs(addr) -> List[Addr]:
+    """One (host, port) pair — or an ordered failover sequence of them
+    — as a normalized list.  THE address-shape heuristic for every HA
+    surface (this client, the actuator, the autopilot): one place to
+    change what counts as a list."""
+    if _is_multi_addr(addr):
+        out = [(a[0], int(a[1])) for a in addr]
+    else:
+        out = [(addr[0], int(addr[1]))]
+    if not out:
+        raise ValueError("at least one address is required")
+    return out
 
 
 class PendingOp:
@@ -74,7 +130,9 @@ class PendingOp:
 
 
 class ServeClient:
-    """One pipelined connection to a ``ServeFrontend``."""
+    """One pipelined connection to a ``ServeFrontend`` (or, with an
+    ordered address list, to whichever of a router HA pair is
+    currently serving — module docstring)."""
 
     # explicit reply-body cap (W004 frame-cap discipline): the largest
     # legal reply is a SLICE_STATE payload, which scales with the
@@ -85,7 +143,8 @@ class ServeClient:
     # endpoint reading frames with no cap at all)
     MAX_REPLY_BODY = 64 << 20
 
-    def __init__(self, addr: Tuple[str, int], timeout: float = 30.0,
+    def __init__(self, addr: Union[Addr, Sequence[Addr]],
+                 timeout: float = 30.0,
                  on_result: Optional[Callable[[PendingOp], None]] = None,
                  connect_timeout: Optional[float] = None,
                  max_reply_body: Optional[int] = None):
@@ -101,37 +160,120 @@ class ServeClient:
                                if max_reply_body is None
                                else int(max_reply_body))
         self._on_result = on_result
-        self._sock = socket.create_connection(
-            addr, timeout=timeout if connect_timeout is None
-            else connect_timeout)
-        self._sock.settimeout(timeout)
+        self.addrs: List[Addr] = normalize_addrs(addr)
+        self._connect_timeout = (timeout if connect_timeout is None
+                                 else connect_timeout)
         self._wlock = threading.Lock()
         self._lock = threading.Lock()
+        # serializes (re)connect attempts so two stalled callers cannot
+        # dial two sockets for one logical connection; never held while
+        # _lock is held (the order is _dial_lock -> _lock)
+        self._dial_lock = threading.Lock()
         self._pending: dict = {}  # guarded-by: _lock
         self._next_id = 0  # guarded-by: _lock
         self._replies: dict = {}  # guarded-by: _lock
         self._closed = False  # guarded-by: _lock
-        self._reader = threading.Thread(
-            target=self._read_loop, name="serve-client-reader", daemon=True)
-        self._reader.start()
+        self._user_closed = False  # guarded-by: _lock
+        self._sock: Optional[socket.socket] = None  # guarded-by: _lock
+        self._gen = 0  # guarded-by: _lock
+        self._active = 0  # guarded-by: _lock
+        self._next_dial = 0  # guarded-by: _lock
+        self._rotations = 0  # guarded-by: _lock
+        self._reader: Optional[threading.Thread] = None  # guarded-by: _lock
+        self._ensure_conn()
 
     @property
     def closed(self) -> bool:
-        """True once the reader exited (server gone, idle timeout, or
-        ``close()``): every later submit fails fast.  A connection POOL
+        """True once this client can never serve again: the user closed
+        it, or — single-address clients only — its reader exited
+        (server gone, idle timeout).  A connection POOL
         (shard/router._ShardLink) polls this to sweep-and-redial a
         client that died of read-idle instead of paying one doomed
-        request to find out."""
+        request to find out.  A multi-address (HA) client reconnects
+        instead of flipping closed."""
         with self._lock:
             return self._closed
+
+    @property
+    def active_addr(self) -> Addr:
+        """The address of the connection currently (last) serving —
+        which member of an HA pair this client is actually talking to."""
+        with self._lock:
+            return self.addrs[self._active]
+
+    @property
+    def rotations(self) -> int:
+        """How many times this client failed over to a different
+        address (0 for the single-address case by construction)."""
+        with self._lock:
+            return self._rotations
+
+    # -- connection management ----------------------------------------------
+
+    def _ensure_conn(self) -> None:
+        """Connect if disconnected, rotating through the address list
+        starting at the failover candidate.  Raises ``ConnectionError``
+        when no address answers (the caller retries later)."""
+        with self._lock:
+            if self._closed:
+                raise ConnectionError("client closed")
+            if self._sock is not None:
+                return
+        with self._dial_lock:
+            with self._lock:
+                if self._closed:
+                    raise ConnectionError("client closed")
+                if self._sock is not None:
+                    return
+                start = self._next_dial
+            n = len(self.addrs)
+            last: Optional[BaseException] = None
+            for i in range(n):
+                idx = (start + i) % n
+                try:
+                    sock = socket.create_connection(
+                        self.addrs[idx], timeout=self._connect_timeout)
+                except OSError as e:
+                    last = e
+                    continue
+                sock.settimeout(self.timeout)
+                reader = None
+                with self._lock:
+                    if self._closed:
+                        # close() raced the dial: never leak the socket
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        raise ConnectionError("client closed")
+                    self._gen += 1
+                    gen = self._gen
+                    self._sock = sock
+                    if idx != self._active and self._gen > 1:
+                        self._rotations += 1
+                    self._active = idx
+                    self._next_dial = idx
+                    reader = threading.Thread(
+                        target=self._read_loop, args=(sock, gen),
+                        name="serve-client-reader", daemon=True)
+                    self._reader = reader
+                reader.start()
+                return
+            raise ConnectionError(
+                f"no reachable address in {self.addrs}: {last}")
 
     # -- submit path --------------------------------------------------------
 
     def submit_async(self, kind: int, elements: Sequence[int],
                      deadline_s: Optional[float] = None) -> PendingOp:
+        self._ensure_conn()
         with self._lock:
             if self._closed:
                 raise ConnectionError("client closed")
+            sock = self._sock
+            if sock is None:
+                raise ConnectionError(
+                    "client disconnected (failover dial pending)")
             self._next_id += 1
             req_id = self._next_id
             op = PendingOp(req_id, time.monotonic())
@@ -140,7 +282,7 @@ class ServeClient:
         body = protocol.encode_op(req_id, kind, elements, deadline_us)
         try:
             with self._wlock:
-                framing.send_frame(self._sock, protocol.MSG_OP, body)
+                framing.send_frame(sock, protocol.MSG_OP, body)
         except OSError as e:
             # ownership handshake with the read loop's death sweep: if
             # the sweep already popped this op it also resolved it and
@@ -168,17 +310,45 @@ class ServeClient:
                                  deadline_s).wait(self.timeout)
 
     def _request_reply(self, msg_type: int, encode,
-                       timeout: Optional[float] = None) -> object:
+                       timeout: Optional[float] = None,
+                       idempotent: bool = False) -> object:
+        """One synchronous request.  ``idempotent`` requests (reads:
+        QUERY/STATS/DSUM/RING_SYNC) retry transparently across the
+        address list on TRANSPORT failure — typed ServeError rejects
+        always propagate.  Non-idempotent verbs stay single-shot."""
+        attempts = len(self.addrs) if idempotent else 1
+        last: Optional[BaseException] = None
+        for attempt in range(attempts):
+            try:
+                return self._request_reply_once(msg_type, encode, timeout)
+            except protocol.ServeError:
+                raise
+            except (OSError, ConnectionError) as e:
+                # socket.timeout and ConnectionError are OSError
+                # subclasses; framing.RemoteError is NOT (a server
+                # really answered — never retried blind)
+                last = e
+                if attempt + 1 >= attempts:
+                    raise
+        raise ConnectionError(f"request failed on every address: {last}")
+
+    def _request_reply_once(self, msg_type: int, encode,
+                            timeout: Optional[float] = None) -> object:
+        self._ensure_conn()
         with self._lock:
             if self._closed:
                 raise ConnectionError("client closed")
+            sock = self._sock
+            if sock is None:
+                raise ConnectionError(
+                    "client disconnected (failover dial pending)")
             self._next_id += 1
             req_id = self._next_id
             op = PendingOp(req_id, time.monotonic())
             self._pending[req_id] = op
         try:
             with self._wlock:
-                framing.send_frame(self._sock, msg_type, encode(req_id))
+                framing.send_frame(sock, msg_type, encode(req_id))
         except OSError:
             # a failed send must not leave the entry pending (the read
             # loop would later resolve it as a phantom failure on top
@@ -207,7 +377,8 @@ class ServeClient:
     def members(self) -> Tuple[List[int], np.ndarray]:
         """Read back the replica's live element ids + vv."""
         return self._request_reply(protocol.MSG_QUERY,
-                                   protocol.encode_query)
+                                   protocol.encode_query,
+                                   idempotent=True)
 
     def stats(self) -> dict:
         """The frontend's SLO read-out: its ``obs.Recorder.snapshot()``
@@ -215,14 +386,28 @@ class ServeClient:
         occupancy, queue depth) — what dashboards and the serve soak
         both consume."""
         return self._request_reply(protocol.MSG_STATS,
-                                   protocol.encode_stats)
+                                   protocol.encode_stats,
+                                   idempotent=True)
 
     def digest_summary(self) -> bytes:
         """The replica's digest summary body (opaque bytes): the
         O(E/16) freshness key the router's member cache compares
         before deciding whether a full ``members()`` pull is needed."""
         return self._request_reply(protocol.MSG_DSUM,
-                                   protocol.encode_dsum)
+                                   protocol.encode_dsum,
+                                   idempotent=True)
+
+    def ring_sync(self, epoch: int = 0, router_id: str = "") -> dict:
+        """The router-HA verb (DESIGN.md §22): with ``epoch == 0`` a
+        pure read of the responder's routing/epoch record (the
+        standby's tail poll); with ``epoch > 0`` an epoch ANNOUNCEMENT
+        the responder adjudicates — a stale claim raises the typed
+        ``StaleRouterEpoch``.  Announcing the same epoch twice is
+        idempotent, so the call retries across an HA address list."""
+        return self._request_reply(
+            protocol.MSG_RING_SYNC,
+            lambda rid: protocol.encode_ring_sync(rid, epoch, router_id),
+            idempotent=True)
 
     # -- fleet-aware GC (router aggregation, DESIGN.md §17) -----------------
 
@@ -287,12 +472,12 @@ class ServeClient:
 
     # -- reader -------------------------------------------------------------
 
-    def _read_loop(self) -> None:
+    def _read_loop(self, sock: socket.socket, gen: int) -> None:
         err: BaseException = ConnectionError("connection closed")
         try:
             while True:
                 msg_type, body = framing.recv_frame(
-                    self._sock, max_body=self.max_reply_body)
+                    sock, max_body=self.max_reply_body)
                 now = time.monotonic()
                 if msg_type == protocol.MSG_ACK:
                     req_id = protocol.decode_ack(body)
@@ -338,6 +523,11 @@ class ServeClient:
                     with self._lock:
                         self._replies[req_id] = summary
                     self._finish(req_id, None, now)
+                elif msg_type == protocol.MSG_RING_SYNC_REPLY:
+                    req_id, record = protocol.decode_ring_sync_reply(body)
+                    with self._lock:
+                        self._replies[req_id] = record
+                    self._finish(req_id, None, now)
                 else:
                     err = framing.ProtocolError(
                         f"unexpected frame type {msg_type}")
@@ -345,21 +535,43 @@ class ServeClient:
         except (framing.RemoteError, framing.ProtocolError, OSError) as e:
             err = e
         finally:
-            # the reader IS the client's liveness: once it exits (idle
-            # timeout, torn connection) later submits could send fine
-            # but never resolve — flip closed so they fail fast instead
-            # of hanging out their full wait.  Socket teardown happens
-            # inline (close() would join the current thread).
+            # the reader IS the connection's liveness: once it exits
+            # (idle timeout, torn connection) later submits could send
+            # fine but never resolve.  Single-address clients flip
+            # closed so they fail fast; HA clients mark themselves
+            # disconnected and aim the next dial at the successor
+            # address.  Socket teardown happens inline (close() would
+            # join the current thread).
             with self._lock:
-                self._closed = True
+                if self._gen != gen:
+                    # a racing close()+reconnect superseded this
+                    # connection; its pending set is not ours to sweep
+                    return
+                self._sock = None
+                failover = len(self.addrs) > 1 and not self._user_closed
+                if failover:
+                    self._next_dial = (self._active + 1) % len(self.addrs)
+                else:
+                    self._closed = True
+                dead_addr = self.addrs[self._active]
                 pending = list(self._pending.values())
                 self._pending.clear()
             try:
-                self._sock.close()
+                sock.close()
             except OSError:
                 pass
-            wrapped = (err if isinstance(err, framing.RemoteError)
-                       else ConnectionError(f"server went away: {err}"))
+            if isinstance(err, framing.RemoteError):
+                wrapped: BaseException = err
+            elif failover and pending:
+                # the typed-ambiguous contract (module docstring): the
+                # ops may be durably applied behind the dead ack — the
+                # ledger resubmits, the client never resends silently
+                wrapped = AmbiguousOp(
+                    f"connection to {dead_addr} died with "
+                    f"{len(pending)} ops in flight (outcome unknown — "
+                    f"resubmit): {err}")
+            else:
+                wrapped = ConnectionError(f"server went away: {err}")
             for op in pending:
                 op._resolve(wrapped, None)
                 if self._on_result is not None:
@@ -371,6 +583,7 @@ class ServeClient:
 
     def _finish(self, req_id: int, exc: Optional[BaseException],
                 now: float) -> None:
+        rotate_sock = None
         with self._lock:
             op = self._pending.pop(req_id, None)
             if op is None:
@@ -379,6 +592,20 @@ class ServeClient:
                 # abandoned queries can't strand replies forever
                 self._replies.pop(req_id, None)
                 return
+            if (isinstance(exc, protocol.StaleRouterEpoch)
+                    and len(self.addrs) > 1):
+                # a DEPOSED router answered: it is alive but must not
+                # be used — aim the next dial at the successor and
+                # tear this connection down so the next attempt
+                # rotates (the reject still resolves this op typed;
+                # remaining in-flight ops surface typed-ambiguous)
+                self._next_dial = (self._active + 1) % len(self.addrs)
+                rotate_sock = self._sock
+        if rotate_sock is not None:
+            try:
+                rotate_sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         latency = now - op.t_sent
         op._resolve(exc, None if exc is not None else latency)
         if self._on_result is not None:
@@ -386,21 +613,26 @@ class ServeClient:
 
     def close(self) -> None:
         with self._lock:
-            if self._closed:
+            if self._user_closed:
                 return
+            self._user_closed = True
             self._closed = True
+            sock, self._sock = self._sock, None
+            reader = self._reader
         # shutdown BEFORE close: a reader blocked in recv() does not
         # reliably wake on close() alone (it can sit until the socket
         # timeout); shutdown tears the connection under it immediately
-        try:
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
-        self._reader.join(timeout=5.0)
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if reader is not None:
+            reader.join(timeout=5.0)
 
     def __enter__(self) -> "ServeClient":
         return self

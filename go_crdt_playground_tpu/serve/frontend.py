@@ -171,6 +171,19 @@ class ServeFrontend:
         self.addr: Optional[Addr] = None
         # race-ok: read-only after __init__ (reshard-soak crash hook)
         self._slice_crash = os.environ.get(_SLICE_CRASH_ENV) or None
+        # router-epoch fence (DESIGN.md §22): the highest router epoch
+        # this shard has ever ADJUDICATED, persisted under durable_dir
+        # (fsync-then-rename) so a restart cannot forget that a
+        # primary was deposed.  Admin-plane verbs (SLICE_PULL/PUSH,
+        # FRONTIER, GC) reject typed StaleRouterEpoch for any
+        # connection that announced a lower epoch — or, once a fence
+        # exists, never announced at all.
+        from go_crdt_playground_tpu.shard.handoff import \
+            load_router_epoch
+
+        self._epoch_lock = threading.Lock()
+        self._router_epoch = load_router_epoch(
+            durable_dir)  # guarded-by: _epoch_lock
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -324,6 +337,8 @@ class ServeFrontend:
             return self._handle_gc(session, body)
         if msg_type == protocol.MSG_DSUM:
             return self._handle_dsum(session, body)
+        if msg_type == protocol.MSG_RING_SYNC:
+            return self._handle_ring_sync(session, body)
         # protocol-ignore: MSG_RESHARD — router-only admin verb; a
         # frontend answers it with the typed unknown-frame error below
         session.send(framing.MSG_ERROR,
@@ -358,6 +373,17 @@ class ServeFrontend:
             self._count("serve.shed.draining")
             session.send(protocol.MSG_REJECT, protocol.encode_reject(
                 req_id, protocol.REJECT_DRAINING, "frontend draining"))
+            return True
+        if self.batcher.storage_degraded():
+            # disk-full graceful degrade (DESIGN.md §16 tail): the WAL
+            # append/fsync path failed recently — shed WRITES typed at
+            # admission (reads keep serving) until the batcher's next
+            # probe window lets one batch test the disk again
+            self._count("serve.shed.storage")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_STORAGE,
+                "durable WAL append failing (storage degraded; "
+                "reads still served — retry with backoff)"))
             return True
         now = time.monotonic()
         deadline = (now + deadline_us / 1e6) if deadline_us > 0 else None
@@ -421,6 +447,69 @@ class ServeFrontend:
             req_id, digestsync.node_summary(self.node)))
         return True
 
+    # -- router-epoch fence (router HA, DESIGN.md §22) ----------------------
+
+    def _handle_ring_sync(self, session: Session, body: bytes) -> bool:
+        """Adjudicate a router-epoch announcement (or serve a pure
+        read).  A claim ABOVE the recorded maximum is adopted and
+        persisted BEFORE it is acknowledged — from that fsync on, no
+        older router can drive an admin verb here.  A claim BELOW it
+        is the deposed router itself: typed ``StaleRouterEpoch``."""
+        from go_crdt_playground_tpu.shard.handoff import \
+            persist_router_epoch
+
+        try:
+            req_id, epoch, router_id = protocol.decode_ring_sync(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return False
+        with self._epoch_lock:
+            current = self._router_epoch
+            if epoch > current:
+                # persist-then-adopt under the lock: two racing
+                # announcements serialize here, and the on-disk record
+                # is monotone because only the winner of the compare
+                # ever writes
+                persist_router_epoch(self.durable_dir, epoch, router_id)
+                self._router_epoch = epoch
+                current = epoch
+                self._count("serve.router_epoch.adopted")
+        if 0 < epoch < current:
+            self._count("serve.rejects.stale_epoch")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_STALE_EPOCH,
+                f"router epoch {epoch} is stale: epoch {current} "
+                "already adjudicated (a standby promoted past you)"))
+            return True
+        if epoch > 0:
+            # the fence stamp the admin verbs below adjudicate against
+            session.router_epoch = epoch
+        session.send(protocol.MSG_RING_SYNC_REPLY,
+                     protocol.encode_ring_sync_reply(
+                         req_id, {"router_epoch": current,
+                                  "role": "shard"}))
+        return True
+
+    def _epoch_fenced(self, session: Session, req_id: int) -> bool:
+        """The admin-plane fence check: True (and a typed reject sent)
+        when this connection's announced router epoch is older than the
+        highest adjudicated one — including the never-announced case
+        once any fence exists, so a deposed pre-announce code path can
+        never slip an admin write through.  With no epoch ever seen
+        (non-HA deployments) the fence is dormant and every existing
+        caller is untouched."""
+        with self._epoch_lock:
+            current = self._router_epoch
+        if current > 0 and session.router_epoch < current:
+            self._count("serve.rejects.stale_epoch")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_STALE_EPOCH,
+                f"admin verb under router epoch "
+                f"{session.router_epoch or 'none'}: epoch {current} "
+                "already adjudicated (announce via RING_SYNC)"))
+            return True
+        return False
+
     # -- keyspace handoff (live resharding, DESIGN.md §18) ------------------
 
     def _crash_if_armed(self, which: str) -> None:
@@ -454,6 +543,8 @@ class ServeFrontend:
             session.send(protocol.MSG_REJECT, protocol.encode_reject(
                 req_id, protocol.REJECT_DRAINING, "frontend draining"))
             return True
+        if self._epoch_fenced(session, req_id):
+            return True
         self._crash_if_armed("pull")
         import numpy as np
 
@@ -479,6 +570,8 @@ class ServeFrontend:
             self._count("serve.shed.draining")
             session.send(protocol.MSG_REJECT, protocol.encode_reject(
                 req_id, protocol.REJECT_DRAINING, "frontend draining"))
+            return True
+        if self._epoch_fenced(session, req_id):
             return True
         self._crash_if_armed("push")
         try:
@@ -520,6 +613,8 @@ class ServeFrontend:
         except framing.ProtocolError as e:
             session.send(framing.MSG_ERROR, str(e).encode())
             return False
+        if self._epoch_fenced(session, req_id):
+            return True
         node = self.node
         declared = self._gc_declared
         with node._lock:
@@ -550,6 +645,8 @@ class ServeFrontend:
         except framing.ProtocolError as e:
             session.send(framing.MSG_ERROR, str(e).encode())
             return False
+        if self._epoch_fenced(session, req_id):
+            return True
         node = self.node
         dropped = 0
         if (node.delta_semantics == "v2"

@@ -110,6 +110,21 @@ MSG_GC_REPLY = 31  # protocol-ignore: reply — GC accounting
 # re-pull only on mismatch: repeated fleet reads become O(diff).
 MSG_DSUM = 32
 MSG_DSUM_REPLY = 33  # protocol-ignore: reply — digest summary body
+# router high availability (DESIGN.md §22): RING_SYNC is one verb with
+# two jobs.  (1) TAIL — a warm-standby router (shard/ha.py) asks the
+# primary for its committed routing record (generation, digest, shard
+# map, router epoch) and persists it locally, so a promotion adopts
+# the exact ring the primary last committed.  (2) FENCE — a router
+# ANNOUNCES its monotone router epoch to a shard frontend before
+# driving admin-plane verbs; the frontend persists the highest epoch
+# it has ever seen and from then on answers any admin verb
+# (SLICE_PULL/SLICE_PUSH/FRONTIER/GC) whose connection announced a
+# lower epoch — or none at all — with the typed ``REJECT_STALE_EPOCH``,
+# so a deposed primary that resurrects can never commit a reshard
+# transfer or force a GC drop (split-brain containment; the promotion
+# sequence bumps the epoch and announces it fleet-wide BEFORE serving).
+MSG_RING_SYNC = 34
+MSG_RING_SYNC_REPLY = 35  # protocol-ignore: reply — ring/epoch record
 
 OP_ADD = 0
 OP_DEL = 1
@@ -123,6 +138,8 @@ REJECT_DRAINING = 3
 REJECT_INVALID = 4
 REJECT_UNAVAILABLE = 5
 REJECT_MOVING = 6
+REJECT_STALE_EPOCH = 7
+REJECT_STORAGE = 8
 
 _MAX_REASON = 1 << 16
 
@@ -173,6 +190,26 @@ class KeyspaceMoving(ServeError):
     owner on commit)."""
 
 
+class StaleRouterEpoch(ServeError):
+    """The admin verb was driven under a router epoch OLDER than the
+    highest this endpoint has adjudicated (DESIGN.md §22): the caller
+    is a DEPOSED router — a standby has promoted past it.  The verb was
+    NOT applied.  Deterministic, never retryable with the same epoch:
+    a deposed router must stop driving admin actions (its in-flight
+    handoff aborts typed, with the old ring still serving) and an
+    operator resolves which router is current via STATS/RING_SYNC."""
+
+
+class StorageDegraded(ServeError):
+    """The frontend's durable WAL append/fsync path failed (ENOSPC, an
+    fsync error) — the op was NOT acked and NOT durable.  The frontend
+    degrades gracefully: reads (QUERY/STATS/DSUM) keep serving, writes
+    shed with this typed reject until a write probe succeeds again.
+    Transient from the client's perspective: retry with backoff — the
+    op is idempotent, and the frontend re-probes the disk on a
+    cooldown cadence."""
+
+
 REJECT_EXCEPTIONS = {
     REJECT_OVERLOADED: Overloaded,
     REJECT_EXPIRED: DeadlineExceeded,
@@ -180,6 +217,8 @@ REJECT_EXCEPTIONS = {
     REJECT_INVALID: InvalidOp,
     REJECT_UNAVAILABLE: ShardUnavailable,
     REJECT_MOVING: KeyspaceMoving,
+    REJECT_STALE_EPOCH: StaleRouterEpoch,
+    REJECT_STORAGE: StorageDegraded,
 }
 
 # exception class -> wire code (the ROUTER's relay direction: a typed
@@ -653,6 +692,62 @@ def decode_dsum_reply(body: bytes) -> Tuple[int, bytes]:
     if pos >= len(body):
         raise ProtocolError("empty DSUM_REPLY summary")
     return req_id, body[pos:]
+
+
+# -- router HA: epoch announce + committed-ring tail (DESIGN.md §22) --------
+
+
+def encode_ring_sync(req_id: int, epoch: int, router_id: str) -> bytes:
+    """``epoch`` is the caller's claimed router epoch (0 = pure read,
+    no claim — the standby's tail poll); ``router_id`` identifies the
+    claimant in the adjudicator's persisted record and its logs."""
+    if epoch < 0:
+        raise ValueError(f"router epoch must be >= 0, got {epoch}")
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    wire._put_varint(out, int(epoch))
+    _put_str(out, router_id)
+    return bytes(out)
+
+
+def decode_ring_sync(body: bytes) -> Tuple[int, int, str]:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+        epoch, pos = wire._get_varint(body, pos)
+        router_id, pos = _get_str(body, pos)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos != len(body):
+        raise ProtocolError("trailing bytes after RING_SYNC")
+    return req_id, epoch, router_id
+
+
+def encode_ring_sync_reply(req_id: int, record: dict) -> bytes:
+    """``record`` is the responder's routing/epoch record as JSON: a
+    router replies its committed RouteState (``generation``,
+    ``digest``, ``shards`` with addresses, ``seed``, ``elements``,
+    ``epoch`` of the handoff machine) plus ``router_epoch``; a shard
+    frontend replies just ``router_epoch`` (the highest it has
+    adjudicated) — the standby's tail and the fence acknowledgment
+    share one reply shape."""
+    import json
+
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    return bytes(out) + json.dumps(record).encode("utf-8")
+
+
+def decode_ring_sync_reply(body: bytes) -> Tuple[int, dict]:
+    import json
+
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+        record = json.loads(body[pos:].decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as err:
+        raise ProtocolError(str(err)) from err
+    if not isinstance(record, dict):
+        raise ProtocolError("RING_SYNC_REPLY record is not a JSON object")
+    return req_id, record
 
 
 def decode_members(body: bytes) -> Tuple[int, List[int], np.ndarray]:

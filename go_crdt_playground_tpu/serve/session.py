@@ -59,6 +59,13 @@ class Session:
         self._wconn = conn.dup()  # independent timeout for the writer
         self._wconn.settimeout(send_timeout_s)
         self.peer = peer
+        # the router epoch this connection ANNOUNCED via RING_SYNC
+        # (DESIGN.md §22), 0 = never announced.  Admin-plane verbs on a
+        # shard frontend adjudicate it against the highest epoch the
+        # frontend has ever seen — the deposed-router fence.
+        # race-ok: written and read only on this connection's single
+        # reader thread (the dispatch callback runs there)
+        self.router_epoch = 0
         self._cond = threading.Condition()
         self._queue: Deque[Tuple[int, bytes]] = deque()  # guarded-by: _cond
         self._depth = queue_depth

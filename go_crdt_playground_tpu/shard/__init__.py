@@ -20,6 +20,7 @@ keyspace keeps serving.
 from go_crdt_playground_tpu.shard.fleet import (FleetSpec,  # noqa: F401
                                                 RouterProc, ShardFleet,
                                                 ShardProc)
+from go_crdt_playground_tpu.shard.ha import RouterStandby  # noqa: F401
 from go_crdt_playground_tpu.shard.handoff import (HandoffCoordinator,  # noqa: F401
                                                   HandoffError, RouteState)
 from go_crdt_playground_tpu.shard.ring import HashRing  # noqa: F401

@@ -202,12 +202,15 @@ class ShardProc(_Proc):
 class RouterProc(_Proc):
     """One ``router --serve`` subprocess over a shard map (the INITIAL
     fleet — live resharding grows/shrinks it; with ``state_dir`` the
-    committed ring survives router restarts)."""
+    committed ring survives router restarts).  ``extra_args`` appends
+    verbatim CLI flags (the HA soak passes ``--router-epoch`` /
+    ``--router-id``)."""
 
     def __init__(self, repo: str, dirpath: str, spec: FleetSpec,
                  shard_addrs: Dict[str, Addr], port: int,
                  state_dir: Optional[str] = None,
-                 transfer_timeout_s: float = 10.0):
+                 transfer_timeout_s: float = 10.0,
+                 extra_args: Tuple[str, ...] = ()):
         os.makedirs(dirpath, exist_ok=True)
         argv = [sys.executable, "-m", "go_crdt_playground_tpu", "router",
                 "--serve", "--port", str(port),
@@ -219,8 +222,56 @@ class RouterProc(_Proc):
             argv += ["--shard", f"{sid}={host}:{p}"]
         if state_dir is not None:
             argv += ["--state-dir", state_dir]
+        argv += list(extra_args)
         super().__init__(argv, cwd=repo,
                          log_path=os.path.join(dirpath, "router.log"))
+
+
+_STANDBY_RE = re.compile(rb"Router standby engaged")
+_TAILING_RE = re.compile(rb"Router standby tailing primary ring")
+
+
+class StandbyRouterProc(_Proc):
+    """One ``router --serve --standby-of`` subprocess (shard/ha.py as
+    a process): tails the primary, promotes on its death, and only
+    THEN prints the standard ``listening on`` banner — so
+    ``await_address`` doubles as the promotion handshake.
+    ``await_engaged`` is the pre-promotion handshake (the standby is
+    tailing)."""
+
+    def __init__(self, repo: str, dirpath: str, spec: FleetSpec,
+                 shard_addrs: Dict[str, Addr], port: int,
+                 primary: Addr, state_dir: str,
+                 standby_id: str = "router-b",
+                 poll_interval_s: float = 0.25,
+                 failure_threshold: int = 3,
+                 transfer_timeout_s: float = 10.0):
+        os.makedirs(dirpath, exist_ok=True)
+        argv = [sys.executable, "-m", "go_crdt_playground_tpu", "router",
+                "--serve", "--port", str(port),
+                "--elements", str(spec.elements),
+                "--seed", str(spec.seed),
+                "--transfer-timeout", str(transfer_timeout_s),
+                "--standby-of", f"{primary[0]}:{primary[1]}",
+                "--router-id", standby_id,
+                "--ha-poll-interval", str(poll_interval_s),
+                "--ha-failure-threshold", str(failure_threshold),
+                "--state-dir", state_dir]
+        for sid in sorted(shard_addrs):
+            host, p = shard_addrs[sid]
+            argv += ["--shard", f"{sid}={host}:{p}"]
+        super().__init__(argv, cwd=repo,
+                         log_path=os.path.join(dirpath, "standby.log"))
+
+    def await_engaged(self, timeout_s: float = 120.0) -> None:
+        self.await_match(_STANDBY_RE, timeout_s)
+
+    def await_tailed(self, timeout_s: float = 60.0) -> None:
+        """Wait until the standby has tailed the primary at least once
+        — only a tailed standby will promote (shard/ha.py's
+        epoch-collision guard), so a soak must not SIGKILL the primary
+        before this handshake."""
+        self.await_match(_TAILING_RE, timeout_s)
 
 
 @dataclass
@@ -239,6 +290,10 @@ class ShardFleet:
     router: Optional[RouterProc] = None
     # pass a directory to persist committed ring swaps (live resharding)
     router_state_dir: Optional[str] = None
+    # extra `router --serve` CLI flags (the HA soak's --router-epoch)
+    router_extra_args: Tuple[str, ...] = ()
+    # the router's port, fixed at start() so kill/restart reuses it
+    router_port: Optional[int] = None
 
     @staticmethod
     def sid(index: int) -> str:
@@ -261,9 +316,43 @@ class ShardFleet:
             s.await_address()
         addrs = {self.sid(i): ("127.0.0.1", self.shard_ports[i])
                  for i in range(self.spec.n_shards)}
+        self.router_port = router_port
         self.router = RouterProc(self.repo, os.path.join(self.root, "router"),
                                  self.spec, addrs, router_port,
-                                 state_dir=self.router_state_dir)
+                                 state_dir=self.router_state_dir,
+                                 extra_args=self.router_extra_args)
+        return self.router.await_address()
+
+    def shard_addr_map(self) -> Dict[str, Addr]:
+        """sid -> address of every INITIAL shard (the router/standby
+        launch configuration)."""
+        return {self.sid(i): ("127.0.0.1", self.shard_ports[i])
+                for i in range(self.spec.n_shards)}
+
+    def kill_router(self) -> None:
+        """SIGKILL the router subprocess (the HA soak's failover
+        trigger); its port and state_dir stay reserved for a
+        restart."""
+        assert self.router is not None
+        self.router.sigkill()
+        self.router.log.close()
+        self.router = None
+
+    def restart_router(self,
+                       extra_args: Optional[Tuple[str, ...]] = None
+                       ) -> Addr:
+        """Restart a killed router on ITS ORIGINAL port + state_dir —
+        the resurrection leg: it adopts its persisted committed ring
+        and its OLD persisted router epoch, so a promoted standby's
+        fence must contain it."""
+        assert self.router is None, "router still running"
+        assert self.router_port is not None
+        self.router = RouterProc(
+            self.repo, os.path.join(self.root, "router"), self.spec,
+            self.shard_addr_map(), self.router_port,
+            state_dir=self.router_state_dir,
+            extra_args=(self.router_extra_args if extra_args is None
+                        else extra_args))
         return self.router.await_address()
 
     def kill_shard(self, index: int) -> None:

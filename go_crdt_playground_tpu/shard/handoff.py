@@ -86,6 +86,14 @@ PHASE_ABORTED = "aborted"
 RING_FILE = "ring.json"    # the last COMMITTED ring (what a restart adopts)
 EPOCH_FILE = "epoch.json"  # the last epoch's phase breadcrumb (post-mortems
                            # + the monotone epoch counter across restarts)
+# the ROUTER-LEADERSHIP epoch (DESIGN.md §22) — monotone across the HA
+# pair: a promoted standby persists primary_epoch + 1 here before it
+# announces/serves, and every shard frontend persists the highest
+# epoch it has adjudicated so a restart cannot forget the fence.
+# Distinct from EPOCH_FILE on purpose: handoff epochs count ring
+# CHANGES under one router; router epochs count which ROUTER may
+# drive them.
+ROUTER_EPOCH_FILE = "router_epoch.json"
 
 
 class HandoffError(RuntimeError):
@@ -161,6 +169,47 @@ def load_epoch_file(state_dir: str) -> Optional[dict]:
     return _load_json(os.path.join(state_dir, EPOCH_FILE))
 
 
+def write_json_atomic(state_dir: str, filename: str, rec: dict) -> None:
+    """fsync-then-rename atomic JSON record write — the persistence
+    discipline every routing-state file in this module shares (a torn
+    write must read as ABSENT, never as a half-record)."""
+    path = os.path.join(state_dir, filename)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=2)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(state_dir)
+
+
+def load_router_epoch(state_dir: Optional[str]) -> int:
+    """The persisted router-leadership epoch (0 when absent/unreadable
+    — the pre-HA configuration, fence dormant)."""
+    if state_dir is None:
+        return 0
+    rec = _load_json(os.path.join(state_dir, ROUTER_EPOCH_FILE))
+    if rec is None:
+        return 0
+    try:
+        return max(0, int(rec.get("router_epoch", 0)))
+    except (TypeError, ValueError):
+        return 0
+
+
+def persist_router_epoch(state_dir: Optional[str], epoch: int,
+                         owner: str) -> None:
+    """Durably record the highest router epoch this endpoint has seen
+    (or, for a promoting standby, now CLAIMS) — fsync'd BEFORE the
+    epoch is acted on, so a restart can never regress the fence."""
+    if state_dir is None:
+        return
+    os.makedirs(state_dir, exist_ok=True)
+    write_json_atomic(state_dir, ROUTER_EPOCH_FILE,
+                      {"router_epoch": int(epoch), "owner": owner})
+
+
 class HandoffCoordinator:
     """Drives one handoff epoch at a time against a ``ShardRouter``.
 
@@ -196,6 +245,14 @@ class HandoffCoordinator:
                     epoch = max(epoch, int(rec.get("epoch", 0)))
             with self._lock:
                 self._epoch = epoch
+
+    @property
+    def epoch(self) -> int:
+        """The monotone HANDOFF epoch (ring-change counter) — exposed
+        so the RING_SYNC tail record can carry it and a promoting
+        standby's coordinator resumes past it."""
+        with self._lock:
+            return self._epoch
 
     # -- the admin verb -----------------------------------------------------
 
@@ -417,7 +474,7 @@ class HandoffCoordinator:
             return
         rec = {"epoch": epoch, "phase": phase, "route": route_info,
                "detail": detail}
-        self._write_json(os.path.join(self.state_dir, EPOCH_FILE), rec)
+        write_json_atomic(self.state_dir, EPOCH_FILE, rec)
         if phase == PHASE_COMMITTED:
             # a restarted router rebuilds the ring from this
             rec = dict(rec)
@@ -426,17 +483,7 @@ class HandoffCoordinator:
             rec["elements"] = self.router.num_elements
             rec["generation"] = detail["generation"]
             rec["digest"] = detail["digest"]
-            self._write_json(os.path.join(self.state_dir, RING_FILE), rec)
-
-    def _write_json(self, path: str, rec: dict) -> None:
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(rec, f, indent=2)
-            f.write("\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        fsync_dir(self.state_dir)
+            write_json_atomic(self.state_dir, RING_FILE, rec)
 
     def _count(self, name: str, n: int = 1) -> None:
         if self.recorder is not None:

@@ -57,6 +57,7 @@ link's reply stream.
 
 from __future__ import annotations
 
+import os
 import socket
 import threading
 import time
@@ -73,7 +74,9 @@ from go_crdt_playground_tpu.serve.session import Session
 from go_crdt_playground_tpu.shard.handoff import (PHASE_COMMITTED,
                                                   HandoffCoordinator,
                                                   HandoffError, RouteState,
-                                                  load_ring_file)
+                                                  load_ring_file,
+                                                  load_router_epoch,
+                                                  persist_router_epoch)
 from go_crdt_playground_tpu.shard.ring import HashRing, load_stats
 from go_crdt_playground_tpu.utils.backoff import Backoff, BackoffPolicy
 
@@ -188,13 +191,29 @@ class _ShardLink:
     # once per cooldown because the breaker opens on the failure
     DIAL_TIMEOUT_S = 1.0
 
+    # admin-plane calls that must be fenced by the router epoch: the
+    # link ANNOUNCES its router's epoch (one RING_SYNC per dialed
+    # connection) before driving any of these, so the shard can
+    # adjudicate staleness per DESIGN.md §22
+    ADMIN_CALLS = frozenset(
+        {"slice_pull", "slice_push", "gc", "frontier"})
+
     def __init__(self, sid: str, addr: Addr, *, timeout_s: float,
                  breaker_threshold: int, breaker_cooldown_s: float,
                  policy: BackoffPolicy, seed: int, on_reply,
-                 max_reply_body: Optional[int] = None) -> None:
+                 max_reply_body: Optional[int] = None,
+                 router_epoch: int = 0, router_id: str = "",
+                 on_deposed=None) -> None:
         self.sid = sid
         self.addr = (addr[0], int(addr[1]))
         self.timeout_s = timeout_s
+        # the owning router's leadership epoch/id (0 = fence dormant,
+        # pre-HA behavior).  race-ok: read-only after construction
+        self.router_epoch = int(router_epoch)
+        self.router_id = router_id
+        # router._note_deposed (thread-safe): a shard adjudicated our
+        # epoch stale — arm the router-wide self-fence
+        self._on_deposed = on_deposed
         # reply-body cap for every client this link dials: the router
         # drives SLICE_PULL against shard frontends, and a donor slice
         # reply scales with the universe — the default 64MB ServeClient
@@ -215,6 +234,11 @@ class _ShardLink:
         self._gen = 0  # guarded-by: _lock
         self._pending: Dict[Tuple[int, int],
                             Tuple[_Relay, Tuple[int, ...]]] = {}  # guarded-by: _lock
+        # dial generation whose connection has ANNOUNCED the router
+        # epoch (admin-plane fence): announce-once-per-connection, so
+        # a redial re-announces and a deposed router's stale epoch is
+        # re-adjudicated on every fresh connection
+        self._announced_gen = 0  # guarded-by: _lock
         self.breaker = CircuitBreaker(
             failure_threshold=breaker_threshold,
             cooldown_s=breaker_cooldown_s)
@@ -351,18 +375,48 @@ class _ShardLink:
 
     def _request(self, call: str, *args):
         """One synchronous request/reply on the link's client with the
-        drop-on-failure treatment members()/stats() pioneered."""
+        drop-on-failure treatment members()/stats() pioneered.  Admin
+        calls (``ADMIN_CALLS``) first announce the router epoch on
+        this connection — once per dial generation — so the shard's
+        fence adjudicates every admin verb; a typed
+        ``StaleRouterEpoch`` from the announce or the call itself
+        PROPAGATES (deterministic: the router is deposed; the handoff
+        machinery aborts typed, never retries the same epoch)."""
         stale = None
         try:
             with self._lock:
                 stale = self._sweep_dead_client_locked()
                 client = self._ensure_client_locked()
                 gen = self._gen
+                announce = (self.router_epoch > 0
+                            and call in self.ADMIN_CALLS
+                            and self._announced_gen != gen)
         finally:
             if stale is not None:
                 stale.close()
+        if announce:
+            try:
+                client.ring_sync(self.router_epoch, self.router_id)
+            except protocol.ServeError as e:
+                if (isinstance(e, protocol.StaleRouterEpoch)
+                        and self._on_deposed is not None):
+                    # the shard adjudicated us deposed: arm the router
+                    # self-fence too (RESHARD/fleet-GC/OP shed typed
+                    # from here on), then propagate — the handoff
+                    # machinery aborts typed on this
+                    self._on_deposed()
+                raise  # typed adjudication: deposed
+            except (OSError, ConnectionError, socket.timeout,
+                    framing.RemoteError) as e:
+                self._drop_client(gen)
+                raise _Unreachable(
+                    f"shard {self.sid} epoch announce failed: {e}"
+                ) from e
+            with self._lock:
+                if self._gen == gen:
+                    self._announced_gen = gen
         try:
-            return getattr(client, call)(*args)
+            result = getattr(client, call)(*args)
         except (OSError, ConnectionError, socket.timeout,
                 framing.RemoteError) as e:
             # RemoteError too: a shard answering MSG_ERROR (e.g. a
@@ -371,6 +425,13 @@ class _ShardLink:
             self._drop_client(gen)
             raise _Unreachable(
                 f"shard {self.sid} {call} failed: {e}") from e
+        if call == "ring_sync":
+            # an explicit announce (promotion fan-out) also satisfies
+            # the once-per-connection announce contract
+            with self._lock:
+                if self._gen == gen:
+                    self._announced_gen = gen
+        return result
 
     def members(self) -> Tuple[List[int], np.ndarray]:
         return self._request("members")
@@ -414,6 +475,14 @@ class _ShardLink:
 
     def stats(self) -> dict:
         return self._request("stats")
+
+    def announce_epoch(self) -> dict:
+        """Announce the owning router's epoch to this shard (the
+        promotion fence fan-out); returns the shard's epoch record.
+        Raises typed ``StaleRouterEpoch`` when this router is already
+        deposed — the caller must stop acting, not retry."""
+        return self._request("ring_sync", self.router_epoch,
+                             self.router_id)
 
     def frontier(self) -> Tuple[np.ndarray, np.ndarray, bool]:
         return self._request("frontier")
@@ -476,13 +545,30 @@ class ShardRouter:
                  state_dir: Optional[str] = None,
                  fence_timeout_s: float = 10.0,
                  transfer_timeout_s: float = 30.0,
-                 fleet_gc_interval_s: float = 0.0):
+                 fleet_gc_interval_s: float = 0.0,
+                 router_epoch: int = 0,
+                 router_id: Optional[str] = None):
         from go_crdt_playground_tpu.obs import Recorder
 
         if not shards:
             raise ValueError("a router needs at least one shard")
         self.recorder = recorder if recorder is not None else Recorder()
         self.num_elements = int(num_elements)
+        # router-leadership epoch (DESIGN.md §22): monotone across the
+        # HA pair, adjudicated by SHARDS on every admin-plane verb.  0
+        # keeps the fence dormant (pre-HA deployments).  The persisted
+        # record wins over a smaller flag so a restarted router can
+        # never regress its own claim; a larger flag (a promotion)
+        # persists before anything is announced or served.
+        # race-ok: read-only after __init__ (a promotion constructs a
+        # NEW router; nothing bumps a live router's own epoch)
+        self.router_epoch = max(int(router_epoch),
+                                load_router_epoch(state_dir))
+        self.router_id = (router_id if router_id
+                          else f"router-{os.getpid()}")
+        if state_dir is not None and self.router_epoch > 0:
+            persist_router_epoch(state_dir, self.router_epoch,
+                                 self.router_id)
         self._downstream_timeout_s = downstream_timeout_s
         self._breaker_threshold = breaker_threshold
         self._breaker_cooldown_s = breaker_cooldown_s
@@ -522,6 +608,16 @@ class ShardRouter:
             ring.digest(self.num_elements, owner))
         self._links: Dict[str, _ShardLink] = {}  # guarded-by: _lock
         self._link_seq = 0  # guarded-by: _lock
+        # the highest router epoch this router has ever HEARD claimed
+        # (its own included): a RING_SYNC claim above our own means a
+        # standby promoted past us — self-fence: refuse RESHARD and
+        # fleet-GC rounds typed rather than drive admin verbs the
+        # shards would reject one by one
+        self._max_epoch_seen = self.router_epoch  # guarded-by: _lock
+        # latched by announce_epoch(): serve() skips its startup probe
+        # when the owner (the promotion path) already fanned it out
+        # race-ok: single-writer latch, worst case one redundant probe
+        self._announced_fleet = False
         with self._lock:
             for sid in ring.shards:
                 self._links[sid] = self._new_link(sid, shard_map[sid])
@@ -606,6 +702,8 @@ class ShardRouter:
             breaker_cooldown_s=self._breaker_cooldown_s,
             policy=self._policy, seed=self._seed * 1000 + self._link_seq,
             on_reply=self._relay_reply,
+            router_epoch=self.router_epoch, router_id=self.router_id,
+            on_deposed=self._note_deposed,
             # slice replies scale with the universe (the frontend's
             # SLICE_PUSH cap formula, §18); the 64MB floor keeps
             # MEMBERS/STATS bounded on small universes
@@ -712,6 +810,19 @@ class ShardRouter:
     # -- lifecycle ----------------------------------------------------------
 
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
+        if self.router_epoch > 0 and not self._announced_fleet:
+            # HA deployments: announce/probe BEFORE taking traffic —
+            # a resurrected deposed primary discovers the promoted
+            # epoch here (the shards remember it durably) and starts
+            # life self-fenced: admin verbs refuse typed AND the data
+            # plane sheds typed, because forwarding ops over a ring
+            # the promoted router may have resharded past could strand
+            # acked writes on handoff donors (read-filtered, invisible
+            # to fleet reads — the one thing zero-acked-op-loss can
+            # never tolerate).  Skipped when the owner already fanned
+            # the announce out (the promotion path) — one fleet RTT,
+            # not two, on the SIGKILL-to-serving critical path.
+            self.announce_epoch()
         addr = self.host.listen(host, port)
         if self._fleet_gc_interval_s > 0:
             self._fleet_gc_thread = threading.Thread(
@@ -768,6 +879,8 @@ class ShardRouter:
             return True
         if msg_type == protocol.MSG_RESHARD:
             return self._handle_reshard(session, body)
+        if msg_type == protocol.MSG_RING_SYNC:
+            return self._handle_ring_sync(session, body)
         # The router DRIVES the verbs below against shard frontends; it
         # never serves them itself (W001 dispatcher-scoped exclusions):
         # protocol-ignore: MSG_SLICE_PULL — handoff donor read, driven
@@ -778,6 +891,119 @@ class ShardRouter:
         session.send(framing.MSG_ERROR,
                      f"unexpected frame type {msg_type}".encode())
         return False
+
+    # -- router HA: epoch record + self-fence (DESIGN.md §22) ---------------
+
+    @property
+    def deposed(self) -> bool:
+        """True once a HIGHER router epoch than our own has been heard
+        claimed: a standby promoted past this router.  The data plane
+        (OP/QUERY/STATS) keeps serving — CRDT ops are safe through any
+        correct ring holder — but admin actions refuse typed."""
+        with self._lock:
+            return self._max_epoch_seen > self.router_epoch
+
+    def ring_record(self) -> Dict[str, object]:
+        """The committed routing record a warm standby tails: ring
+        generation/digest/membership WITH addresses, the handoff epoch
+        counter, and this router's leadership epoch — everything a
+        promotion needs to adopt the exact ring the primary last
+        committed (shard/ha.py persists it in the ring.json shape a
+        restarted/promoted router adopts)."""
+        rt = self.route()
+        links = self.links_snapshot()
+        with self._lock:
+            seen = self._max_epoch_seen
+        return {
+            "role": "router",
+            "router_id": self.router_id,
+            "router_epoch": self.router_epoch,
+            "max_epoch_seen": seen,
+            "generation": rt.generation,
+            "digest": rt.digest,
+            "seed": rt.ring.seed,
+            "elements": self.num_elements,
+            "epoch": self.handoff.epoch,
+            "shards": {sid: list(link.addr)
+                       for sid, link in links.items()
+                       if sid in rt.ring.shards},
+        }
+
+    def _handle_ring_sync(self, session: Session, body: bytes) -> bool:
+        """Serve the tail read / adjudicate an epoch claim.  A claim
+        above everything seen is NOTED (self-fence: this router stops
+        admin actions) and acknowledged; a claim below the maximum is
+        the deposed router itself — typed ``StaleRouterEpoch``."""
+        try:
+            req_id, epoch, router_id = protocol.decode_ring_sync(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return False
+        noted = False
+        with self._lock:
+            if epoch > self._max_epoch_seen:
+                self._max_epoch_seen = epoch
+                noted = True
+            seen = self._max_epoch_seen
+        if noted:
+            self._count("router.epoch.noted")
+        if 0 < epoch < seen:
+            self._count("router.rejects.stale_epoch")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_STALE_EPOCH,
+                f"router epoch {epoch} is stale: epoch {seen} "
+                "already observed"))
+            return True
+        self._count("router.ring_syncs")
+        session.send(protocol.MSG_RING_SYNC_REPLY,
+                     protocol.encode_ring_sync_reply(
+                         req_id, self.ring_record()))
+        return True
+
+    def _note_deposed(self) -> None:
+        """A shard (or a RING_SYNC claimant) proved a HIGHER epoch
+        exists: arm the self-fence.  The exact successor epoch is
+        immaterial — ``deposed`` is a comparison, and our own epoch
+        never changes on a live router."""
+        with self._lock:
+            if self._max_epoch_seen <= self.router_epoch:
+                self._max_epoch_seen = self.router_epoch + 1
+        self._count("router.epoch.noted")
+
+    def _announce_one(self, sid: str, link: _ShardLink):
+        try:
+            return link.announce_epoch()
+        except protocol.StaleRouterEpoch as e:
+            # adjudicated deposed by this shard's durable fence (the
+            # resurrection-discovery path: link._request only arms the
+            # self-fence on the implicit admin-call announce, and this
+            # was the EXPLICIT one)
+            self._note_deposed()
+            return e
+
+    def announce_epoch(self) -> Dict[str, object]:
+        """Fan this router's epoch out to every shard — the promotion
+        fence, and the resurrection DISCOVERY probe: each shard either
+        adopts/acks the epoch (its record rides back — a record
+        carrying a higher adjudicated epoch arms our self-fence) or
+        rejects it typed StaleRouterEpoch (we are deposed).  Returns
+        sid -> True | the failure/verdict string.  An unreachable
+        shard learns the epoch lazily on the first admin dial instead;
+        promotion proceeds — the fence only needs to beat the deposed
+        router to each shard, and the announce-per-connection
+        discipline makes every later admin contact carry it."""
+        results = self._fan_out_fn(self._announce_one)
+        self._announced_fleet = True
+        self._count("router.epoch.announces")
+        out: Dict[str, object] = {}
+        for sid, r in results.items():
+            if isinstance(r, dict):
+                if int(r.get("router_epoch", 0) or 0) > self.router_epoch:
+                    self._note_deposed()
+                out[sid] = True
+            else:
+                out[sid] = str(r)
+        return out
 
     # -- OP forwarding ------------------------------------------------------
 
@@ -804,6 +1030,19 @@ class ShardRouter:
             self._count("router.shed.draining")
             session.send(protocol.MSG_REJECT, protocol.encode_reject(
                 req_id, protocol.REJECT_DRAINING, "router draining"))
+            return True
+        if self.deposed:
+            # a deposed router must not forward ops: its ring may be
+            # STALE relative to the promoted router's reshards, and an
+            # op applied on a handoff donor is acked-but-read-filtered
+            # — invisible to fleet reads, a silent acked-op loss.  The
+            # typed reject tells an HA client to rotate (ServeClient
+            # arms its failover on this code).
+            self._count("router.shed.deposed")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_STALE_EPOCH,
+                "router deposed (stale router epoch) — dial the "
+                "promoted router"))
             return True
         # the in-flight window the reshard fence synchronizes with:
         # from BEFORE the fence check to AFTER the last sub-op is
@@ -1074,6 +1313,14 @@ class ShardRouter:
         ring_info = rt.info()
         ring_info["load_stats"] = load_stats(rt.owner,
                                              len(rt.ring.shards))
+        # which ROUTER is serving, not just which ring: the HA client
+        # and the autopilot's decision log adjudicate failovers from
+        # these (DESIGN.md §22)
+        with self._lock:
+            seen = self._max_epoch_seen
+        ring_info["router_epoch"] = self.router_epoch
+        ring_info["router_id"] = self.router_id
+        ring_info["max_epoch_seen"] = seen
         session.send(protocol.MSG_STATS_REPLY, protocol.encode_stats_reply(
             req_id, {"counters": counters,
                      "observations": {},
@@ -1112,6 +1359,13 @@ class ShardRouter:
 
         Returns the round's accounting; the periodic driver and the
         fleet soak read the same dict."""
+        if self.deposed:
+            # self-fence (DESIGN.md §22): a deposed router must never
+            # push a GC frontier — its fleet view may be stale and the
+            # shards would reject the verbs typed anyway
+            self._count("router.fleet_gc.deposed")
+            return {"pushed": False,
+                    "reason": "router deposed (stale router epoch)"}
         results = self._fan_out("frontier")
         evidence = []
         for sid, r in sorted(results.items()):
@@ -1168,6 +1422,19 @@ class ShardRouter:
             session.send(protocol.MSG_RESHARD_REPLY,
                          protocol.encode_reshard_reply(
                              req_id, False, {"reason": "router draining"}))
+            return True
+        if self.deposed:
+            # self-fence: the typed refusal an operator (or autopilot)
+            # gets from a deposed primary BEFORE any shard has to
+            # reject a transfer verb — the reply names the reason so
+            # the caller re-resolves the active router
+            self._count("router.reshard.deposed")
+            session.send(protocol.MSG_RESHARD_REPLY,
+                         protocol.encode_reshard_reply(
+                             req_id, False,
+                             {"reason": "StaleRouterEpoch: router "
+                                        "deposed — a standby promoted "
+                                        "past this epoch"}))
             return True
         mode = ("join" if mode_code == protocol.RESHARD_JOIN else "leave")
         self._count("router.reshard.requests")

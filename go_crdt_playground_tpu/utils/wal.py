@@ -189,19 +189,28 @@ class DeltaWal:
 
     # durable-on-return
     def append(self, body: bytes) -> None:
-        """Durably append one record (see the fsync contract above)."""
+        """Durably append one record (see the fsync contract above).
+        An ``OSError`` anywhere in the write/flush/fsync path (ENOSPC,
+        a failing device) is counted as ``wal.append_errors`` and
+        re-raised — the serving layer classifies it into the typed
+        ``StorageDegraded`` shed (serve/batcher.py) instead of letting
+        it escape a worker thread untyped."""
         rec = encode_record(body)
-        with self._lock:
-            if self._file is None:
-                raise ValueError("WAL is closed")
-            if self._file_size > 0 and \
-                    self._file_size + len(rec) > self.segment_bytes:
-                self._rotate_locked()
-            self._file.write(rec)
-            self._file.flush()
-            if self.fsync:
-                os.fsync(self._file.fileno())
-            self._file_size += len(rec)
+        try:
+            with self._lock:
+                if self._file is None:
+                    raise ValueError("WAL is closed")
+                if self._file_size > 0 and \
+                        self._file_size + len(rec) > self.segment_bytes:
+                    self._rotate_locked()
+                self._file.write(rec)
+                self._file.flush()
+                if self.fsync:
+                    os.fsync(self._file.fileno())
+                self._file_size += len(rec)
+        except OSError:
+            self._count("wal.append_errors")
+            raise
         self._count("wal.appends")
         self._count("wal.appended_bytes", len(rec))
 

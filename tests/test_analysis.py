@@ -686,6 +686,55 @@ def test_supervisor_round_counter_locked():
 
 
 # ---------------------------------------------------------------------------
+# T001: Thread-subclass attribute shadowing (the PR-12 _stop bug class)
+# ---------------------------------------------------------------------------
+
+
+def test_thread_shadow_finds_planted_offenders(tmp_path):
+    from go_crdt_playground_tpu.analysis import thread_shadow
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import threading\n"
+        "from threading import Thread\n"
+        "class Sampler(Thread):\n"
+        "    def __init__(self):\n"
+        "        super().__init__(daemon=True)\n"
+        "        self._stop = threading.Event()  # breaks join()\n"
+        "    def run(self):\n"
+        "        pass\n"
+        "class Pumper(threading.Thread):\n"
+        "    def _bootstrap(self):  # overrides a runtime internal\n"
+        "        pass\n"
+        "    def start(self):  # shadows start() itself\n"
+        "        pass\n")
+    (pkg / "clean.py").write_text(
+        "import threading\n"
+        "class Good(threading.Thread):\n"
+        "    def __init__(self):\n"
+        "        super().__init__()\n"
+        "        self._halt = threading.Event()  # renamed: fine\n"
+        "        self.daemon = True              # property: fine\n"
+        "        self.name = 'good'              # property: fine\n"
+        "    def run(self):                      # documented override\n"
+        "        pass\n"
+        "class NotAThread:\n"
+        "    def __init__(self):\n"
+        "        self._stop = 1  # not a Thread subclass: fine\n")
+    findings, stats = thread_shadow.analyze(str(pkg), extra_dirs=())
+    assert stats["thread_subclasses"] == 3
+    symbols = sorted(f.symbol for f in findings)
+    assert symbols == ["Pumper._bootstrap", "Pumper.start",
+                       "Sampler._stop"], symbols
+    assert all(f.code == "T001" and f.severity == "error"
+               for f in findings)
+    # the exact PR-12 offender name is in the blocklist on this
+    # interpreter (the pass derives it from threading.Thread itself)
+    assert "_stop" in thread_shadow.thread_internal_names()
+
+
+# ---------------------------------------------------------------------------
 # the gate itself
 # ---------------------------------------------------------------------------
 
@@ -748,11 +797,19 @@ def test_gate_fast(tmp_path):
     assert {"FleetAutopilot", "AutopilotPolicy", "ReshardActuator",
             "FleetSignals", "StandbyPool"} <= covered, covered
     assert "AdaptiveGroupSize" in covered, covered
+    # ... and the router-HA tier (the router-HA ISSUE): the standby's
+    # tail loop, promotion path, and observer readers cross threads on
+    # the standby lock and must be inside the sweep
+    assert "RouterStandby" in covered, covered
     # the wire-contract suite (the protocol-contract ISSUE): W001-W004
     # + M001 must have swept the dialect modules, every registered
     # dispatcher, the full codec registry, and the metric-name surface
     assert {"protocol_contract", "codec_symmetry", "metrics_contract",
-            "report_freshness"} <= set(report["passes"])
+            "report_freshness", "thread_shadow"} <= set(report["passes"])
+    # T001 swept a real census (the tree is full of Thread subclasses;
+    # zero scanned would mean the pass ran against nothing)
+    ts = report["passes"]["thread_shadow"]["stats"]
+    assert ts["thread_subclasses"] >= 3 and ts["files_scanned"] > 50, ts
     pc = report["passes"]["protocol_contract"]["stats"]
     assert set(pc["dispatchers"]) == {"frontend", "router", "peer",
                                       "serve-client"}, pc

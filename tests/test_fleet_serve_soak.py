@@ -60,6 +60,20 @@ def test_fleet_serve_soak_quick_mode(tmp_path):
     # predicted slice inside a bounded fence window, the leave restores
     # the original digest, and across ALL of it: every op resolved
     # ack-or-typed-reject, zero acked-op loss, zero phantoms
+    # the router↔shard chaos leg (DESIGN.md §22 satellite): the chaos
+    # REALLY happened (proxy counters), the victim keyspace degraded
+    # to typed ShardUnavailable while the survivor kept acking, and
+    # the breaker re-admitted the healed link — ledger clean
+    chaos = artifact["chaos_leg"]
+    assert chaos["proxy"]["truncated"] > 0, chaos["proxy"]
+    assert chaos["proxy"]["refused"] > 0, chaos["proxy"]
+    assert chaos["outage"]["typed_unavailable"] > 0, chaos
+    assert chaos["outage"]["acked_survivor_during_chaos"] > 0, chaos
+    assert chaos["outage"]["unresolved"] == 0, chaos
+    assert chaos["lost_acked_ops"] == []
+    assert chaos["phantom_members"] == []
+    assert chaos["unfinished"] == []
+
     reshard = artifact["reshard_leg"]
     events = {e["event"]: e for e in reshard["events"]}
     aborted = events["join_recipient_killed_mid_handoff"]
@@ -120,6 +134,61 @@ def test_fleet_serve_soak_mesh_quick_mode(tmp_path):
     assert crash["phantom_members"] == []
     assert crash["unfinished"] == []
     assert crash["final_members"] == crash["elements"]
+
+
+@pytest.mark.slow
+def test_fleet_serve_soak_router_ha_quick_mode(tmp_path):
+    """The router-HA soak (--router-ha --quick, DESIGN.md §22): a
+    SIGKILLed primary router fails over to its warm standby inside the
+    declared budget with the exact committed ring adopted under a
+    bumped fenced epoch; ledgered traffic rides through with in-flight
+    ops surfaced typed-ambiguous (zero unresolved, zero acked-op loss,
+    zero phantoms); a real autopilot re-resolves the promoted router
+    and commits a split with the epoch bump in its decision log; and a
+    resurrected deposed primary is contained typed with the promoted
+    ring untouched."""
+    import fleet_serve_soak
+
+    out = str(tmp_path / "HA_CURVE.json")
+    rc = fleet_serve_soak.main(["--router-ha", "--quick", "--out", out])
+    assert rc == 0, "router-HA soak failed (late promotion, stale-" \
+                    "epoch fence breach, unresolved ops, or acked-op " \
+                    "loss)"
+    with open(out) as f:
+        artifact = json.load(f)
+
+    fo = artifact["legs"]["failover"]
+    assert fo["promote_s"] <= fo["promote_budget_s"], fo
+    assert fo["ring_after"]["router_epoch"] == \
+        fo["ring_before"]["router_epoch"] + 1
+    assert fo["ring_after"]["generation"] == \
+        fo["ring_before"]["generation"]
+    assert fo["ring_after"]["digest"] == fo["ring_before"]["digest"]
+    assert fo["acked_before_kill"] > 0
+    assert fo["acked_after_promotion"] > 0
+
+    ap = artifact["legs"]["autopilot"]
+    assert ap["split_committed"] and ap["split_sid"] in \
+        ap["shards_after"], ap
+    assert ap["resume_router_epoch"] == \
+        fo["ring_after"]["router_epoch"]
+    assert ap["decision_signals_router_epoch"] == \
+        fo["ring_after"]["router_epoch"]
+    assert ap["generation_after"] > fo["ring_after"]["generation"]
+
+    rz = artifact["legs"]["resurrection"]
+    assert rz["reshard_refused"], rz
+    assert "StaleRouterEpoch" in rz["reshard_reason"], rz
+    assert rz["op_shed_typed"] and rz["old_router_shed_deposed"] >= 1
+    assert rz["old_router_deposed_noted"] >= 1
+    assert rz["promoted_ring_unchanged"], rz
+
+    assert artifact["traffic"]["unresolved"] == 0, artifact["traffic"]
+    assert artifact["finished"] and artifact["unfinished"] == []
+    assert artifact["lost_acked_ops"] == []
+    assert artifact["phantom_members"] == []
+    assert artifact["final_members"] == artifact["elements"]
+    assert artifact["promoted_ha_counters"]["router.ha.promotions"] == 1
 
 
 @pytest.mark.slow

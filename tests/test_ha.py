@@ -1,0 +1,409 @@
+"""Router HA (DESIGN.md §22): epoch fence adjudication, the standby
+tail/promotion state machine, client failover, actuator re-resolution,
+and the disk-full StorageDegraded path's WAL counter.
+
+Everything here is IN-PROCESS and non-slow: real sockets on localhost,
+tiny universes, the state machine driven through its ``poll_once``
+seam — the subprocess/SIGKILL version is the slow-marked
+``fleet_serve_soak.py --router-ha`` wrapper.
+"""
+
+import os
+import socket
+import threading
+
+import pytest
+
+from go_crdt_playground_tpu.serve import protocol
+from go_crdt_playground_tpu.serve.client import AmbiguousOp, ServeClient
+from go_crdt_playground_tpu.serve.frontend import ServeFrontend
+from go_crdt_playground_tpu.shard.fleet import free_port
+from go_crdt_playground_tpu.shard.ha import (POLL_FAILED, POLL_PROMOTED,
+                                             POLL_TAILED, RouterStandby)
+from go_crdt_playground_tpu.shard.handoff import (load_router_epoch,
+                                                  persist_router_epoch)
+from go_crdt_playground_tpu.shard.router import ShardRouter
+
+E, A = 16, 2
+
+
+def _addr(fe):
+    return fe.addr
+
+
+# ---------------------------------------------------------------------------
+# wire + persistence plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_ring_sync_codec_roundtrip():
+    body = protocol.encode_ring_sync(7, 3, "router-a")
+    assert protocol.decode_ring_sync(body) == (7, 3, "router-a")
+    with pytest.raises(ValueError):
+        protocol.encode_ring_sync(1, -1, "x")
+    rec = {"router_epoch": 9, "generation": 2, "shards": {"s0": ["h", 1]}}
+    rid, got = protocol.decode_ring_sync_reply(
+        protocol.encode_ring_sync_reply(5, rec))
+    assert rid == 5 and got == rec
+    from go_crdt_playground_tpu.net.framing import ProtocolError
+    with pytest.raises(ProtocolError):
+        protocol.decode_ring_sync(body + b"\x00")
+    with pytest.raises(ProtocolError):
+        protocol.decode_ring_sync_reply(
+            protocol.encode_ring_sync_reply(5, rec)[:3])
+
+
+def test_router_epoch_file_roundtrip(tmp_path):
+    d = str(tmp_path)
+    assert load_router_epoch(d) == 0
+    assert load_router_epoch(None) == 0
+    persist_router_epoch(d, 4, "router-b")
+    assert load_router_epoch(d) == 4
+    # garbage reads as absent, never raises
+    with open(os.path.join(d, "router_epoch.json"), "w") as f:
+        f.write("{torn")
+    assert load_router_epoch(d) == 0
+
+
+def test_wal_append_errors_counter(tmp_path):
+    """Satellite: an OSError in the WAL write path is counted at the
+    site (wal.append_errors) and re-raised for the serving layer to
+    classify typed."""
+    from go_crdt_playground_tpu.obs import Recorder
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    rec = Recorder()
+    wal = DeltaWal(str(tmp_path / "wal"), fsync=False, recorder=rec)
+
+    class _Enospc:
+        def write(self, data):
+            raise OSError(28, "No space left on device")
+
+        def flush(self):
+            pass
+
+        def tell(self):
+            return 0
+
+        def close(self):
+            pass
+
+        def fileno(self):
+            return -1
+
+    with wal._lock:
+        wal._file = _Enospc()
+    with pytest.raises(OSError):
+        wal.append(b"doomed")
+    snap = rec.snapshot()["counters"]
+    assert snap["wal.append_errors"] == 1
+    assert "wal.appends" not in snap
+
+
+# ---------------------------------------------------------------------------
+# shard-side fence adjudication
+# ---------------------------------------------------------------------------
+
+
+def test_frontend_epoch_adjudication(tmp_path):
+    """The shard half of the fence: adopt-and-persist higher epochs,
+    reject stale claims typed, fence every admin verb for lower (or
+    missing) announcements, stay dormant with no epoch ever seen."""
+    fe = ServeFrontend(E, A, durable_dir=str(tmp_path / "n0"),
+                       flush_ms=0.5)
+    fe.serve()
+    try:
+        with ServeClient(_addr(fe)) as legacy:
+            # fence dormant: an unannounced admin verb works (pre-HA)
+            assert legacy.slice_pull([0, 1])
+            # adopt epoch 5 (persisted), acked with the record
+            with ServeClient(_addr(fe)) as c5:
+                rec = c5.ring_sync(5, "router-a")
+                assert rec["router_epoch"] == 5
+                # a stale claim rejects typed
+                with ServeClient(_addr(fe)) as c4:
+                    with pytest.raises(protocol.StaleRouterEpoch):
+                        c4.ring_sync(4, "router-old")
+                    # ... and its admin verbs are fenced too
+                    with pytest.raises(protocol.StaleRouterEpoch):
+                        c4.slice_pull([0])
+                # once a fence exists, a NEVER-announced connection is
+                # fenced as well (a deposed pre-announce code path)
+                with pytest.raises(protocol.StaleRouterEpoch):
+                    legacy.slice_pull([0])
+                with pytest.raises(protocol.StaleRouterEpoch):
+                    legacy.frontier()
+                with pytest.raises(protocol.StaleRouterEpoch):
+                    import numpy as np
+
+                    legacy.gc(np.zeros(A, np.uint32))
+                # the announced-current connection keeps working
+                assert c5.slice_pull([0, 1])
+                # reads are NEVER fenced (serve-through-degradation)
+                members, _vv = legacy.members()
+                assert members == []
+        assert load_router_epoch(str(tmp_path / "n0")) == 5
+        snap = fe.recorder.snapshot()["counters"]
+        assert snap["serve.router_epoch.adopted"] == 1
+        assert snap["serve.rejects.stale_epoch"] >= 4
+    finally:
+        fe.close()
+
+
+def test_frontend_epoch_survives_restart(tmp_path):
+    """The fence is durable: a restarted shard still rejects the old
+    epoch (a deposed primary cannot wait out a shard crash)."""
+    d = str(tmp_path / "n0")
+    fe = ServeFrontend(E, A, durable_dir=d, flush_ms=0.5)
+    fe.serve()
+    try:
+        with ServeClient(_addr(fe)) as c:
+            c.ring_sync(3, "router-b")
+    finally:
+        fe.close()
+    fe2 = ServeFrontend(E, A, durable_dir=d, flush_ms=0.5)
+    fe2.serve()
+    try:
+        with ServeClient(_addr(fe2)) as c:
+            with pytest.raises(protocol.StaleRouterEpoch):
+                c.ring_sync(2, "router-a")
+            assert c.ring_sync(3, "router-b")["router_epoch"] == 3
+    finally:
+        fe2.close()
+
+
+# ---------------------------------------------------------------------------
+# router-side record + self-fence
+# ---------------------------------------------------------------------------
+
+
+def test_router_ring_record_and_self_fence(tmp_path):
+    fe = ServeFrontend(E, A, flush_ms=0.5)
+    fe.serve()
+    router = ShardRouter({"s0": _addr(fe)}, E, seed=3,
+                         state_dir=str(tmp_path / "router"),
+                         router_epoch=1, router_id="router-a")
+    addr = router.serve()
+    try:
+        with ServeClient(addr) as c:
+            # the tail read: committed RouteState + epoch, addresses in
+            rec = c.ring_sync(0, "standby")
+            assert rec["router_epoch"] == 1
+            assert rec["generation"] == 0
+            assert rec["shards"] == {"s0": list(_addr(fe))}
+            assert rec["elements"] == E and rec["seed"] == 3
+            c.add(1)  # data plane serving normally
+            # a higher claim arms the self-fence ...
+            assert c.ring_sync(2, "router-b")["max_epoch_seen"] == 2
+            assert router.deposed
+            # ... RESHARD refuses typed with the reason
+            ok, detail = c.reshard(protocol.RESHARD_LEAVE, "s0")
+            assert not ok and "StaleRouterEpoch" in detail["reason"]
+            # ... fleet GC refuses
+            assert router.run_fleet_gc()["pushed"] is False
+            # ... and the data plane sheds typed (stale-ring hazard)
+            with pytest.raises(protocol.StaleRouterEpoch):
+                c.add(2)
+            # a STALE claim (below the max seen) rejects typed
+            with ServeClient(addr) as c1:
+                with pytest.raises(protocol.StaleRouterEpoch):
+                    c1.ring_sync(1, "router-a-again")
+            # reads keep serving through deposition
+            members, _ = c.members()
+            assert 1 in members
+        snap = router.recorder.snapshot()["counters"]
+        assert snap["router.shed.deposed"] >= 1
+        assert snap["router.reshard.deposed"] == 1
+    finally:
+        router.close()
+        fe.close()
+
+
+# ---------------------------------------------------------------------------
+# the standby state machine (poll_once seam — no wall-clock waits)
+# ---------------------------------------------------------------------------
+
+
+def test_standby_tail_promote_and_fence(tmp_path):
+    fe = ServeFrontend(E, A, durable_dir=str(tmp_path / "s0"),
+                       flush_ms=0.5)
+    fe.serve()
+    primary_state = str(tmp_path / "router-a")
+    standby_state = str(tmp_path / "router-b")
+    primary = ShardRouter({"s0": _addr(fe)}, E, seed=7,
+                          state_dir=primary_state,
+                          router_epoch=1, router_id="router-a")
+    primary_addr = primary.serve()
+    standby_port = free_port()
+    standby = RouterStandby(
+        primary_addr, {"s0": _addr(fe)}, E, seed=7,
+        state_dir=standby_state, standby_id="router-b",
+        listen_addr=("127.0.0.1", standby_port),
+        failure_threshold=2)
+    try:
+        with ServeClient(primary_addr) as c:
+            c.add(3)
+        # tail: the committed ring lands in the standby's state_dir
+        assert standby.poll_once() == POLL_TAILED
+        rec = standby.last_record
+        assert rec["router_epoch"] == 1 and rec["generation"] == 0
+        from go_crdt_playground_tpu.shard.handoff import load_ring_file
+        ring_rec = load_ring_file(standby_state)
+        assert ring_rec["phase"] == "committed"
+        assert ring_rec["shards"] == {"s0": list(_addr(fe))}
+        # primary dies: below threshold first, then promote
+        primary.close()
+        assert standby.poll_once() == POLL_FAILED
+        assert not standby.promoted
+        assert standby.poll_once() == POLL_PROMOTED
+        assert standby.promoted and standby.router is not None
+        assert standby.promotion_s is not None
+        promoted = standby.router
+        # the promoted router adopted the TAILED committed ring under
+        # the bumped persisted epoch
+        assert promoted.router_epoch == 2
+        assert load_router_epoch(standby_state) == 2
+        assert promoted.route().generation == 0
+        # the shard adjudicated the new epoch at promotion
+        assert standby.announce_results == {"s0": True}
+        assert load_router_epoch(str(tmp_path / "s0")) == 2
+        # an HA client rides through: ordered list [dead primary,
+        # standby] — reads rotate transparently, writes ack, and the
+        # acked state from the old primary is still served
+        with ServeClient([primary_addr,
+                          ("127.0.0.1", standby_port)]) as hc:
+            members, _ = hc.members()
+            assert 3 in members
+            hc.add(5)
+            members, _ = hc.members()
+            assert {3, 5} <= set(members)
+            assert hc.active_addr == ("127.0.0.1", standby_port)
+        # actuator re-resolution: the ordered list reads the promoted
+        # router's ring state
+        from go_crdt_playground_tpu.control.actuator import \
+            ReshardActuator
+        act = ReshardActuator(
+            [primary_addr, ("127.0.0.1", standby_port)])
+        gen, shards = act._ring_state()
+        assert gen == 0 and shards == ("s0",)
+    finally:
+        standby.close()
+        fe.close()
+
+
+def test_standby_never_tailed_never_promotes(tmp_path):
+    """The epoch-collision guard: a standby that has NEVER tailed the
+    primary holds neither its committed ring nor its epoch — promoting
+    would serve the flag ring under an epoch that can collide with the
+    primary's own (equal epochs adjudicate as current: no fence).  It
+    must keep polling instead, however many failures accumulate."""
+    dead = ("127.0.0.1", free_port())  # nothing ever listened here
+    standby = RouterStandby(dead, {"s0": ("127.0.0.1", 1)}, E,
+                            state_dir=str(tmp_path / "b"),
+                            failure_threshold=2, poll_timeout_s=0.5)
+    try:
+        for _ in range(5):
+            assert standby.poll_once() == POLL_FAILED
+        assert not standby.promoted and standby.router is None
+        snap = standby.recorder.snapshot()["counters"]
+        assert snap["router.ha.promote_blocked"] >= 3
+        assert "router.ha.promotions" not in snap
+    finally:
+        standby.close()
+
+
+def test_standby_does_not_promote_while_primary_healthy(tmp_path):
+    fe = ServeFrontend(E, A, flush_ms=0.5)
+    fe.serve()
+    primary = ShardRouter({"s0": _addr(fe)}, E, seed=1,
+                          router_epoch=1, router_id="router-a")
+    primary_addr = primary.serve()
+    standby = RouterStandby(primary_addr, {"s0": _addr(fe)}, E, seed=1,
+                            state_dir=str(tmp_path / "b"),
+                            failure_threshold=2)
+    try:
+        for _ in range(4):
+            assert standby.poll_once() == POLL_TAILED
+        assert not standby.promoted
+        snap = standby.recorder.snapshot()["counters"]
+        assert snap["router.ha.polls"] == 4
+        assert "router.ha.promotions" not in snap
+    finally:
+        standby.close()
+        primary.close()
+        fe.close()
+
+
+# ---------------------------------------------------------------------------
+# client failover semantics
+# ---------------------------------------------------------------------------
+
+
+def test_client_ambiguous_inflight_and_rotation(tmp_path):
+    """An op whose connection dies un-answered surfaces the TYPED
+    AmbiguousOp (never silently resent); the next attempt rotates to
+    the successor address and serves."""
+    # addr0: a server that accepts, reads one frame, closes unanswered
+    listener = socket.create_server(("127.0.0.1", 0))
+    dead_addr = listener.getsockname()[:2]
+
+    def one_shot():
+        conn, _ = listener.accept()
+        try:
+            conn.recv(64)  # the op frame arrives ...
+        finally:
+            conn.close()   # ... and dies with no reply
+
+    t = threading.Thread(target=one_shot, daemon=True)
+    t.start()
+    fe = ServeFrontend(E, A, flush_ms=0.5)
+    fe.serve()
+    try:
+        c = ServeClient([dead_addr, _addr(fe)], timeout=10.0)
+        try:
+            with pytest.raises(AmbiguousOp):
+                c.add(1)
+            # the ledger's resubmit lands on the successor
+            c.add(1)
+            assert c.rotations >= 1
+            assert c.active_addr == _addr(fe)
+            members, _ = c.members()
+            assert members == [1]
+        finally:
+            c.close()
+    finally:
+        fe.close()
+        listener.close()
+    # single-address clients keep the legacy fail-fast contract
+    fe2 = ServeFrontend(E, A, flush_ms=0.5)
+    fe2.serve()
+    c2 = ServeClient(_addr(fe2))
+    fe2.close()
+    try:
+        deadline = 50
+        while not c2.closed and deadline:
+            import time
+
+            time.sleep(0.1)
+            deadline -= 1
+        assert c2.closed
+        with pytest.raises(ConnectionError):
+            c2.add(1)
+    finally:
+        c2.close()
+
+
+def test_client_idempotent_reads_retry_across_list():
+    """QUERY/STATS retry transparently on the successor when the
+    active address refuses the dial entirely."""
+    fe = ServeFrontend(E, A, flush_ms=0.5)
+    fe.serve()
+    dead = free_port()  # nothing listens here
+    try:
+        with ServeClient([("127.0.0.1", dead), _addr(fe)],
+                         connect_timeout=1.0) as c:
+            members, _ = c.members()
+            assert members == []
+            assert c.stats()["counters"] is not None
+    finally:
+        fe.close()

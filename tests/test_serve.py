@@ -64,7 +64,8 @@ def test_protocol_ack_reject_members_roundtrip():
     assert set(protocol.REJECT_EXCEPTIONS) == {
         protocol.REJECT_OVERLOADED, protocol.REJECT_EXPIRED,
         protocol.REJECT_DRAINING, protocol.REJECT_INVALID,
-        protocol.REJECT_UNAVAILABLE, protocol.REJECT_MOVING}
+        protocol.REJECT_UNAVAILABLE, protocol.REJECT_MOVING,
+        protocol.REJECT_STALE_EPOCH, protocol.REJECT_STORAGE}
     for code, exc in protocol.REJECT_EXCEPTIONS.items():
         assert protocol.REJECT_CODES[exc] == code
 
@@ -469,32 +470,66 @@ def test_session_writer_decouples_sessions():
 
 
 def test_poison_batch_rejects_retryable_and_keeps_serving(tmp_path):
-    """Review fix: an apply failure (transient server trouble) rejects
-    the batch's ops as RETRYABLE Overloaded — not the permanent
-    InvalidOp — and the batcher keeps serving afterwards."""
+    """An apply failure rejects the batch's ops RETRYABLE-typed — a
+    disk failure (OSError: the WAL append/fsync path) classifies as
+    ``StorageDegraded``, any other apply fault as ``Overloaded``,
+    never the permanent InvalidOp — and the batcher keeps serving.
+    While the storage-degrade window is armed, writes shed typed at
+    ADMISSION but reads still serve; the window clears once a probe
+    batch survives."""
+    import time as time_mod
+
     fe = ServeFrontend(E, A, durable_dir=str(tmp_path / "n0"),
                        max_batch=4, flush_ms=0.5)
     inner = fe.node.ingest_batch
-    poison = {"on": True}
+    poison = {"kind": OSError}
 
     def flaky(*args, **kwargs):
-        if poison["on"]:
-            raise OSError("injected disk error")
+        if poison["kind"] is not None:
+            raise poison["kind"]("injected disk error")
         return inner(*args, **kwargs)
 
     fe.node.ingest_batch = flaky
     fe.serve()
     try:
         with ServeClient(_addr(fe)) as c:
-            with pytest.raises(protocol.Overloaded, match="retry"):
+            with pytest.raises(protocol.StorageDegraded, match="retry"):
                 c.add(1)
-            poison["off"] = poison.pop("on")  # heal the fault
-            poison["on"] = False
-            c.add(2)  # the loop survived the poison batch
+            # the degrade window is armed: writes shed typed at
+            # ADMISSION (never reach the queue), reads keep serving
+            assert fe.batcher.storage_degraded()
+            with pytest.raises(protocol.StorageDegraded):
+                c.add(1)
+            members, _ = c.members()
+            assert members == []
+            # a non-disk apply fault stays the generic retryable class
+            poison["kind"] = RuntimeError
+            deadline = time_mod.monotonic() + 10.0
+            saw_overloaded = False
+            while time_mod.monotonic() < deadline:
+                try:
+                    c.add(1)
+                except protocol.StorageDegraded:
+                    time_mod.sleep(0.05)  # window still armed
+                except protocol.Overloaded:
+                    saw_overloaded = True
+                    break
+            assert saw_overloaded
+            poison["kind"] = None  # heal the fault
+            deadline = time_mod.monotonic() + 10.0
+            while True:  # the next admitted batch is the disk probe
+                try:
+                    c.add(2)
+                    break
+                except protocol.ServeError:
+                    assert time_mod.monotonic() < deadline
+                    time_mod.sleep(0.05)
+            assert not fe.batcher.storage_degraded()
             members, _ = c.members()
         assert members == [2]
         snap = fe.recorder.snapshot()
-        assert snap["counters"]["serve.batch_errors"] == 1
+        assert snap["counters"]["serve.batch_errors"] >= 1
+        assert snap["counters"]["serve.shed.storage"] >= 1
     finally:
         fe.close()
 

@@ -49,6 +49,24 @@ single-node load generator runs against the fleet as-is.
   restart, zero acked-op loss, zero phantoms).  Results merge into
   MESH_CURVE.json alongside bench.py --mesh's kernel curve.
 
+* **chaos leg** (default sweep) — a deterministic ``ChaosProxy``
+  interposed on ONE router↔shard downstream link: torn frames, then
+  an asymmetric partition, then heal.  The victim keyspace degrades
+  to typed ``ShardUnavailable`` (unresolved == 0) while the survivor
+  keeps acking; after heal the breaker's half-open probe re-admits
+  the link and the resubmit sweep drains clean.
+
+* **router-HA mode** (``--router-ha``, DESIGN.md §22) — warm-standby
+  router failover: SIGKILL the primary router mid-stream (the standby
+  must promote within the declared budget onto the exact committed
+  ring, under a bumped fenced router epoch; in-flight ops surface
+  typed-ambiguous, zero acked-op loss, zero phantoms), an autopilot
+  leg (the controller's ordered router list re-resolves the promoted
+  router and commits a split with the epoch bump in its decision
+  log), and a deposed-primary resurrection leg (stale RESHARD refused
+  typed StaleRouterEpoch, data plane shed typed, promoted ring digest
+  untouched).  Writes HA_CURVE.json.
+
 * **autopilot mode** (``--autopilot``, DESIGN.md §21) — the
   closed-loop acceptance soak: a REAL ``autopilot`` CLI subprocess
   watching the router must split a flash-crowded keyspace onto
@@ -479,6 +497,159 @@ def adjudicate_reshard(leg: Dict[str, object], quick: bool) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# chaos leg: ChaosProxy on one router↔shard downstream link
+# ---------------------------------------------------------------------------
+
+
+def chaos_leg(root: str, elements: int, seed: int) -> Dict[str, object]:
+    """Deterministic wire chaos on the DOWNSTREAM serve dialect: a
+    ``ChaosProxy`` interposed between the router and one shard (the
+    router's ``--shard`` flag points at the proxy).  Three phases over
+    a ledgered add-only sweep: torn frames (every connection truncated
+    mid-frame), asymmetric partition (inbound dials refused while the
+    shard itself is healthy), heal.  The chaos legs before this one
+    covered only the node-sync and client-ingest ports — the
+    router↔shard link is the last un-injected hop.
+
+    Adjudication: during chaos the victim keyspace degrades to typed
+    ``ShardUnavailable`` (never silence — ``unresolved == 0``) while
+    the other shard's keyspace keeps acking; after ``heal()`` the
+    breaker's half-open probe re-admits the link and the resubmit
+    sweep drains — zero acked-op loss, zero phantoms, whole keyspace
+    in."""
+    import random
+
+    from go_crdt_playground_tpu.net.faults import ChaosProxy
+    from go_crdt_playground_tpu.shard.fleet import (RouterProc, ShardProc,
+                                                    free_port)
+
+    rng = random.Random(seed + 5)
+    spec = FleetSpec(n_shards=2, elements=elements, seed=seed)
+    base = os.path.join(root, "chaos")
+    shards: List[ShardProc] = []
+    proxy = None
+    router = None
+    acked: Set[int] = set()
+    submitted: Set[int] = set()
+    counts = {"typed_unavailable": 0, "typed_other": 0, "unresolved": 0,
+              "acked_survivor_during_chaos": 0}
+    try:
+        ports = [free_port(), free_port()]
+        for i in range(2):
+            shards.append(ShardProc(
+                REPO, os.path.join(base, f"s{i}"), spec, i, ports[i]))
+        for s in shards:
+            s.await_address()
+        proxy = ChaosProxy(("127.0.0.1", ports[1]), seed=seed)
+        addrs = {"s0": ("127.0.0.1", ports[0]),
+                 "s1": ("127.0.0.1", proxy.port)}
+        router = RouterProc(REPO, os.path.join(base, "router"), spec,
+                            addrs, free_port())
+        addr = router.await_address()
+
+        todo = workloads.shuffled_universe(elements, seed, rng=rng)
+        n = len(todo)
+        torn_at, partition_at, heal_at = (int(0.25 * n), int(0.5 * n),
+                                          int(0.75 * n))
+        chaos_window = False
+        client = ServeClient(addr, timeout=30.0)
+        try:
+            for i, e in enumerate(todo):
+                if i == torn_at:
+                    # sever AFTER the flip: the router's long-lived
+                    # pipelined link re-dials into the new scenario
+                    # (plans are drawn at accept)
+                    proxy.set_scenario(truncate_rate=1.0)
+                    proxy.sever()
+                    chaos_window = True
+                elif i == partition_at:
+                    proxy.set_scenario(truncate_rate=0.0)
+                    proxy.partition()
+                    proxy.sever()
+                elif i == heal_at:
+                    proxy.heal()
+                    chaos_window = False
+                submitted.add(e)
+                try:
+                    client.add(e, deadline_s=5.0)
+                    acked.add(e)
+                    if chaos_window:
+                        counts["acked_survivor_during_chaos"] += 1
+                except protocol.ShardUnavailable:
+                    counts["typed_unavailable"] += 1
+                except protocol.ServeError:
+                    counts["typed_other"] += 1
+                except (OSError, ConnectionError, socket.timeout):
+                    # through the router this must never happen — even
+                    # chaos-torn downstream links relay typed rejects
+                    counts["unresolved"] += 1
+        finally:
+            client.close()
+
+        # breaker recovery: resubmit until the whole keyspace is in
+        # (the half-open probe re-admits the healed link)
+        retry_deadline = time.monotonic() + 60.0
+        remaining = [e for e in todo if e not in acked]
+        retries = 0
+        while remaining and time.monotonic() < retry_deadline:
+            client = ServeClient(addr, timeout=30.0)
+            try:
+                still: List[int] = []
+                for e in remaining:
+                    try:
+                        client.add(e, deadline_s=5.0)
+                        acked.add(e)
+                    except (protocol.ServeError, OSError, ConnectionError,
+                            socket.timeout):
+                        still.append(e)
+                remaining = still
+            finally:
+                client.close()
+            if remaining:
+                retries += 1
+                time.sleep(0.25)  # breaker half-open probe cadence
+
+        with ServeClient(addr, timeout=60.0) as c:
+            members, _vv = c.members()
+        members_set = set(members)
+        return {
+            "elements": elements,
+            "outage": counts,
+            "proxy": proxy.counters(),
+            "resubmit_rounds": retries,
+            "acked_ops": len(acked),
+            # MUST be []: an acked op vanished across wire chaos
+            "lost_acked_ops": sorted(acked - members_set),
+            # MUST be []: a member nobody submitted (e.g. a duplicated
+            # or garbled frame applied as a phantom op)
+            "phantom_members": sorted(members_set - submitted),
+            "unfinished": sorted(set(todo) - acked),
+            "final_members": len(members_set),
+        }
+    finally:
+        if router is not None:
+            router.close()
+        if proxy is not None:
+            proxy.close()
+        for s in shards:
+            s.close()
+
+
+def adjudicate_chaos(leg: Dict[str, object]) -> bool:
+    """The chaos leg's acceptance shape (mirrored by the wrapper
+    test): chaos REALLY happened (proxy counters), degradation was
+    typed, recovery drained clean."""
+    ok = leg["proxy"]["truncated"] > 0 and leg["proxy"]["refused"] > 0
+    ok = ok and leg["outage"]["typed_unavailable"] > 0
+    ok = ok and leg["outage"]["acked_survivor_during_chaos"] > 0
+    ok = ok and leg["outage"]["unresolved"] == 0
+    ok = ok and leg["lost_acked_ops"] == []
+    ok = ok and leg["phantom_members"] == []
+    ok = ok and leg["unfinished"] == []
+    return ok
+
+
+# ---------------------------------------------------------------------------
 # mesh legs (device-mesh replica tier, DESIGN.md §20) — `--mesh` mode
 # ---------------------------------------------------------------------------
 
@@ -801,10 +972,16 @@ class _AutopilotProc:
         from go_crdt_playground_tpu.shard.fleet import _Proc
 
         os.makedirs(dirpath, exist_ok=True)
+        # router_addr: one (host, port), or an ORDERED failover list
+        # (primary first, then warm standbys — DESIGN.md §22)
+        routers = (list(router_addr)
+                   if isinstance(router_addr[0], (list, tuple))
+                   else [router_addr])
         argv = [sys.executable, "-m", "go_crdt_playground_tpu",
                 "autopilot",
-                "--router", f"{router_addr[0]}:{router_addr[1]}",
                 "--decision-log", log_path, "--seed", str(seed)]
+        for host, port in routers:
+            argv += ["--router", f"{host}:{port}"]
         for sid, (host, port) in standbys:
             argv += ["--standby", f"{sid}={host}:{port}"]
         for flag, value in sorted(flags.items()):
@@ -1275,6 +1452,434 @@ def adjudicate_autopilot(r: Dict[str, object]) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# router-HA legs (warm-standby failover, DESIGN.md §22) — `--router-ha`
+# ---------------------------------------------------------------------------
+
+
+class _HATraffic(threading.Thread):
+    """Ledgered add-only load through an ORDERED router address list
+    (primary first, standby second) while the primary is SIGKILLed:
+    typed rejects requeue, ``AmbiguousOp`` (in-flight ops whose ack
+    died with the old router) is counted separately and requeued —
+    never silently resent, which is what keeps zero-phantom
+    adjudicable — and dial failures during the promotion window
+    requeue as transport retries.  True UNRESOLVED (a reply that never
+    came on a live connection) is counted and adjudicated to zero."""
+
+    def __init__(self, addrs, elements: int, seed: int):
+        super().__init__(daemon=True)
+        from collections import deque
+
+        self.addrs = list(addrs)
+        self.elements = elements
+        self.seed = seed
+        self._cycle = 0
+        self.todo = deque(workloads.shuffled_universe(elements, seed))
+        self.acked: Set[int] = set()
+        self.submitted: Set[int] = set()
+        self.counts = {"typed_moving": 0, "typed_unavailable": 0,
+                       "typed_stale_epoch": 0, "typed_other": 0,
+                       "ambiguous": 0, "transport_retries": 0,
+                       "unresolved": 0}
+        self._ack_log: List[Tuple[float, int]] = []
+        self._log_lock = threading.Lock()
+        self.stop_when_drained = threading.Event()
+
+    def acked_since(self, t: float) -> int:
+        with self._log_lock:
+            return sum(1 for ts, _ in self._ack_log if ts >= t)
+
+    def run(self) -> None:
+        from go_crdt_playground_tpu.serve.client import AmbiguousOp
+
+        client = None
+        try:
+            while True:
+                if not self.todo:
+                    if self.stop_when_drained.is_set():
+                        return
+                    # keep offering load (idempotent re-adds of the
+                    # same universe): the autopilot leg needs live
+                    # heat long after the first pass lands — the
+                    # ledger sets (acked/submitted) are unchanged by
+                    # resubmission, so every invariant stays exact
+                    self._cycle += 1
+                    self.todo.extend(workloads.shuffled_universe(
+                        self.elements, self.seed + self._cycle))
+                e = self.todo.popleft()
+                self.submitted.add(e)
+                try:
+                    if client is None or client.closed:
+                        if client is not None:
+                            client.close()
+                        client = ServeClient(self.addrs, timeout=30.0,
+                                             connect_timeout=2.0)
+                    client.add(e, deadline_s=5.0)
+                    self.acked.add(e)
+                    with self._log_lock:
+                        self._ack_log.append((time.monotonic(), e))
+                except AmbiguousOp:
+                    # outcome unknown — the op may be durably applied
+                    # behind the dead router's ack; resubmit (idempotent)
+                    self.counts["ambiguous"] += 1
+                    self.todo.append(e)
+                    time.sleep(0.05)
+                except protocol.KeyspaceMoving:
+                    self.counts["typed_moving"] += 1
+                    self.todo.append(e)
+                    time.sleep(0.01)
+                except protocol.ShardUnavailable:
+                    self.counts["typed_unavailable"] += 1
+                    self.todo.append(e)
+                    time.sleep(0.05)
+                except protocol.StaleRouterEpoch:
+                    # a deposed router answered: the client rotates on
+                    # this code — requeue and resubmit via the successor
+                    self.counts["typed_stale_epoch"] += 1
+                    self.todo.append(e)
+                    time.sleep(0.05)
+                except protocol.ServeError:
+                    self.counts["typed_other"] += 1
+                    self.todo.append(e)
+                    time.sleep(0.01)
+                except socket.timeout:
+                    # sent on a live connection, no reply inside the
+                    # client timeout: genuinely unresolved
+                    self.counts["unresolved"] += 1
+                    self.todo.append(e)
+                except (ConnectionError, OSError):
+                    # never-sent (dial refused mid-promotion) or
+                    # send-failed: requeue through the failover list
+                    self.counts["transport_retries"] += 1
+                    self.todo.append(e)
+                    time.sleep(0.05)
+        finally:
+            if client is not None:
+                client.close()
+
+    def drain(self, timeout_s: float) -> bool:
+        self.stop_when_drained.set()
+        self.join(timeout=timeout_s)
+        return not self.is_alive() and not self.todo
+
+
+def run_router_ha_mode(args) -> int:
+    """``--router-ha``: the warm-standby failover soak (DESIGN.md
+    §22), three legs over one real fleet:
+
+    1. **failover** — SIGKILL the primary router mid-stream under
+       continuous ledgered traffic: the standby must promote within
+       the declared budget (its promotion banner IS the handshake),
+       adopt the primary's exact committed ring (same generation +
+       digest) under router epoch 2, and traffic must keep acking
+       through the promoted router — in-flight ops surface typed-
+       ambiguous and resubmit, ``unresolved == 0``.
+    2. **autopilot** — a real ``autopilot`` CLI subprocess holding the
+       ORDERED router list rides through the failover (its poll
+       client rotates) and commits a SPLIT through the promoted
+       router; its decision log records the epoch bump (resume +
+       decision signals carry ``router_epoch == 2``).
+    3. **resurrection** — restart the old primary on its original
+       port/state_dir (old persisted epoch 1): its startup announce
+       discovers the promoted epoch from the shards' durable fence
+       and it comes back SELF-FENCED — a RESHARD against it refuses
+       typed with the StaleRouterEpoch reason, its data plane sheds
+       typed (the stale-ring containment), and the promoted router's
+       ring digest is untouched.
+
+    Throughout: zero acked-op loss, zero phantoms, whole keyspace in.
+    Writes HA_CURVE.json.
+    """
+    from go_crdt_playground_tpu.control.controller import \
+        read_decision_log
+    from go_crdt_playground_tpu.shard.fleet import (StandbyRouterProc,
+                                                    free_port)
+
+    if args.quick:
+        elements = 144
+        promote_budget_s = 20.0
+    else:
+        elements = 288
+        promote_budget_s = 15.0
+
+    t0 = time.time()
+    root = tempfile.mkdtemp(prefix="router-ha-soak-")
+    # actors=4: lanes for the 2 initial shards + the autopilot's
+    # standby shard (index 2)
+    spec = FleetSpec(n_shards=2, elements=elements, seed=args.seed,
+                     actors=4, queue_depth=64, max_batch=8, flush_ms=2.0)
+    fleet = ShardFleet(
+        REPO, os.path.join(root, "fleet"), spec,
+        router_state_dir=os.path.join(root, "fleet", "router-state"),
+        router_extra_args=("--router-epoch", "1",
+                           "--router-id", "router-a"))
+    result: Dict[str, object] = {}
+    standby = None
+    pilot = None
+    traffic = None
+    try:
+        primary_addr = fleet.start()
+        standby_port = free_port()
+        standby_addr = ("127.0.0.1", standby_port)
+        standby = StandbyRouterProc(
+            REPO, os.path.join(root, "standby"), spec,
+            fleet.shard_addr_map(), standby_port, primary_addr,
+            os.path.join(root, "standby-state"), standby_id="router-b",
+            poll_interval_s=0.25, failure_threshold=3)
+        standby.await_engaged()
+        # only a TAILED standby promotes (the epoch-collision guard):
+        # the kill must not race the first tail poll
+        standby.await_tailed()
+        addrs = [primary_addr, standby_addr]
+
+        ring0 = _ring_info(primary_addr)
+        traffic = _HATraffic(addrs, elements, args.seed)
+        traffic.start()
+        baseline_deadline = time.monotonic() + 60.0
+        while (len(traffic.acked) < elements // 4
+               and time.monotonic() < baseline_deadline):
+            time.sleep(0.05)
+        acked_before_kill = len(traffic.acked)
+
+        # ---- leg 1: failover ------------------------------------------
+        t_kill = time.monotonic()
+        fleet.kill_router()
+        promoted_listen = standby.await_address(
+            timeout_s=promote_budget_s + 60.0)
+        t_promoted = time.monotonic()
+        ring1 = _ring_info(standby_addr)
+        # first ledgered ack THROUGH the promoted router
+        ack_deadline = time.monotonic() + 60.0
+        while (traffic.acked_since(t_promoted) < 10
+               and time.monotonic() < ack_deadline):
+            time.sleep(0.05)
+        leg_failover = {
+            "promote_s": round(t_promoted - t_kill, 3),
+            "promote_budget_s": promote_budget_s,
+            "promoted_listen": list(promoted_listen),
+            "acked_before_kill": acked_before_kill,
+            "acked_after_promotion": traffic.acked_since(t_promoted),
+            "ring_before": {k: ring0[k] for k in
+                            ("generation", "digest", "router_epoch")},
+            "ring_after": {k: ring1[k] for k in
+                           ("generation", "digest", "router_epoch",
+                            "router_id")},
+        }
+        print(json.dumps({"failover": leg_failover}), flush=True)
+
+        # ---- leg 2: autopilot through the promoted router -------------
+        s2_addr = fleet.launch_shard(2)
+        log_path = os.path.join(root, "decisions.jsonl")
+        # hair-trigger heat: the leg's claim is "a split COMMITS
+        # through the PROMOTED router with the epoch in the log" —
+        # convergence quality is CONTROL_CURVE.json's job.  cold-rate
+        # 0 disables merges so the generation accounting stays crisp.
+        pilot = _AutopilotProc(
+            REPO, os.path.join(root, "pilot"), addrs,
+            [(fleet.sid(2), s2_addr)], log_path, args.seed,
+            {"--poll-interval": 0.5, "--p99-budget-ms": 1.0,
+             "--queue-watermark": 1.0, "--hot-windows": 2,
+             "--cold-windows": 1000, "--cooldown": 2.0,
+             "--abort-cooldown": 4.0, "--min-shards": 2,
+             "--max-shards": 3, "--cold-rate": 0.0,
+             "--reshard-timeout": 60.0})
+        banner = pilot.await_engaged()
+        split_deadline = time.monotonic() + 90.0
+        committed_join = None
+        while time.monotonic() < split_deadline:
+            recs = read_decision_log(log_path)
+            joins = [r for r in recs
+                     if r.get("record") == "outcome"
+                     and r.get("action") == "join"
+                     and r.get("outcome") == "committed"]
+            if joins:
+                committed_join = joins[0]
+                break
+            time.sleep(0.5)
+        pilot.proc.terminate()
+        pilot.close()
+        pilot = None
+        recs = read_decision_log(log_path)
+        resume = next((r for r in recs if r.get("record") == "resume"),
+                      {})
+        decs = {r["seq"]: r for r in recs
+                if r.get("record") == "decision"}
+        join_decision = (decs.get(committed_join.get("decision_seq"))
+                         if committed_join else None)
+        ring2 = _ring_info(standby_addr)
+        leg_autopilot = {
+            "banner": banner,
+            "resume_router_epoch": resume.get("router_epoch"),
+            "resume_generation": resume.get("generation"),
+            "split_committed": committed_join is not None,
+            "split_sid": (committed_join or {}).get("sid"),
+            "decision_signals_router_epoch": (
+                (join_decision or {}).get("signals", {})
+                .get("router_epoch")),
+            "generation_after": ring2["generation"],
+            "shards_after": ring2["shards"],
+        }
+        print(json.dumps({"autopilot": leg_autopilot}), flush=True)
+
+        # drain the ledger BEFORE resurrecting the old primary (a
+        # deposed router sheds typed, but the ledger should finish on
+        # the promoted one)
+        finished = traffic.drain(timeout_s=180.0)
+
+        # ---- leg 3: deposed-primary resurrection ----------------------
+        old_addr = fleet.restart_router()
+        # the resurrected primary discovered the promoted epoch at its
+        # startup announce (the shards persist the fence): a RESHARD
+        # against it must refuse typed, its data plane must shed typed
+        with ServeClient(old_addr, timeout=30.0) as c:
+            ok_reshard, detail = c.reshard(protocol.RESHARD_LEAVE,
+                                           fleet.sid(2), timeout=30.0)
+            op_shed_typed = False
+            try:
+                c.add(0, deadline_s=5.0)
+            except protocol.StaleRouterEpoch:
+                op_shed_typed = True
+            except protocol.ServeError:
+                pass
+            old_stats = c.stats()
+        ring3 = _ring_info(standby_addr)
+        old_counters = old_stats.get("counters", {})
+        leg_resurrection = {
+            "reshard_refused": not ok_reshard,
+            "reshard_reason": str(detail.get("reason", "")),
+            "op_shed_typed": op_shed_typed,
+            "old_router_epoch": old_stats.get("ring", {})
+            .get("router_epoch"),
+            "old_router_deposed_noted": int(
+                old_counters.get("router.epoch.noted", 0)),
+            "old_router_shed_deposed": int(
+                old_counters.get("router.shed.deposed", 0)),
+            "promoted_ring_unchanged": (
+                ring3["generation"] == ring2["generation"]
+                and ring3["digest"] == ring2["digest"]),
+        }
+        print(json.dumps({"resurrection": leg_resurrection}),
+              flush=True)
+
+        # ---- final ledger adjudication (via the promoted router) ------
+        with ServeClient(standby_addr, timeout=60.0) as c:
+            members, _vv = c.members()
+            promoted_stats = c.stats()
+        members_set = set(members)
+        result = {
+            "elements": elements,
+            "legs": {"failover": leg_failover,
+                     "autopilot": leg_autopilot,
+                     "resurrection": leg_resurrection},
+            "traffic": dict(traffic.counts),
+            "finished": finished,
+            "acked_ops": len(traffic.acked),
+            "submitted_ops": len(traffic.submitted),
+            "final_members": len(members_set),
+            # MUST be []: an acked op vanished across the failover
+            "lost_acked_ops": sorted(traffic.acked - members_set),
+            # MUST be []: a member nobody submitted — the typed-
+            # ambiguous surfacing (never silent resend) keeps this
+            # adjudicable
+            "phantom_members": sorted(members_set - traffic.submitted),
+            "unfinished": sorted(set(range(elements)) - traffic.acked),
+            "promoted_ha_counters": {
+                k: v for k, v in
+                promoted_stats.get("counters", {}).items()
+                if k.startswith("router.ha.")
+                or k.startswith("router.epoch.")},
+        }
+    finally:
+        if traffic is not None and traffic.is_alive():
+            traffic.stop_when_drained.set()
+        if pilot is not None:
+            pilot.close()
+        if standby is not None:
+            standby.close()
+        fleet.close()
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+    out = args.out or os.path.join(REPO, "HA_CURVE.json")
+    artifact = {
+        "metric": (
+            "router high availability: a warm-standby router tails the "
+            "primary's committed RouteState over RING_SYNC and promotes "
+            "on its SIGKILL under a monotone fenced router epoch — "
+            "promotion inside the declared budget with the exact "
+            "committed ring (generation+digest) adopted, continuous "
+            "ledgered traffic rides through with in-flight ops surfaced "
+            "typed-ambiguous (zero unresolved, zero acked-op loss, zero "
+            "phantoms), a real autopilot re-resolves the promoted "
+            "router and commits a split with the epoch bump in its "
+            "decision log, and a resurrected deposed primary is "
+            "contained: stale RESHARD refused typed StaleRouterEpoch, "
+            "data plane shed typed, promoted ring digest untouched"),
+        "value": result.get("legs", {}).get("failover", {})
+        .get("promote_s"),
+        "unit": "seconds from primary SIGKILL to standby promotion",
+        "fleet": {"elements": result.get("elements"),
+                  "initial_shards": 2, "autopilot_standby_shards": 1,
+                  "seed": args.seed, "quick": bool(args.quick),
+                  "ha_poll_interval_s": 0.25,
+                  "ha_failure_threshold": 3},
+        "platform": "cpu",
+        "elapsed_s": round(time.time() - t0, 1),
+        **result,
+    }
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"wrote {out}")
+    return 0 if adjudicate_router_ha(result) else 1
+
+
+def adjudicate_router_ha(r: Dict[str, object]) -> bool:
+    """The acceptance shape of the router-HA soak (mirrored by
+    tests/test_fleet_serve_soak.py)."""
+    if not r:
+        return False
+    fo = r["legs"]["failover"]
+    # promotion inside the budget, onto the SAME committed ring, under
+    # the bumped epoch
+    ok = fo["promote_s"] <= fo["promote_budget_s"]
+    ok = ok and fo["ring_after"]["router_epoch"] \
+        == fo["ring_before"]["router_epoch"] + 1
+    ok = ok and fo["ring_after"]["generation"] \
+        == fo["ring_before"]["generation"]
+    ok = ok and fo["ring_after"]["digest"] == fo["ring_before"]["digest"]
+    ok = ok and fo["acked_before_kill"] > 0
+    ok = ok and fo["acked_after_promotion"] > 0
+    # the autopilot rode through the failover and committed a split
+    # through the promoted router, with the epoch bump on record
+    ap = r["legs"]["autopilot"]
+    ok = ok and ap["split_committed"]
+    ok = ok and ap["resume_router_epoch"] \
+        == fo["ring_after"]["router_epoch"]
+    ok = ok and ap["decision_signals_router_epoch"] \
+        == fo["ring_after"]["router_epoch"]
+    ok = ok and ap["generation_after"] \
+        > fo["ring_after"]["generation"]
+    ok = ok and ap["split_sid"] in ap["shards_after"]
+    # the deposed primary is contained, typed, with the ring untouched
+    rz = r["legs"]["resurrection"]
+    ok = ok and rz["reshard_refused"]
+    ok = ok and "StaleRouterEpoch" in rz["reshard_reason"]
+    ok = ok and rz["op_shed_typed"]
+    ok = ok and rz["old_router_deposed_noted"] >= 1
+    ok = ok and rz["old_router_shed_deposed"] >= 1
+    ok = ok and rz["promoted_ring_unchanged"]
+    # the ledger: every op resolved typed (ambiguity included), the
+    # whole keyspace landed, nothing acked lost, nothing phantom
+    ok = ok and r["traffic"]["unresolved"] == 0
+    ok = ok and r["finished"] and r["unfinished"] == []
+    ok = ok and r["lost_acked_ops"] == []
+    ok = ok and r["phantom_members"] == []
+    return ok
+
+
+# ---------------------------------------------------------------------------
 # sweep
 # ---------------------------------------------------------------------------
 
@@ -1296,6 +1901,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "splits a flash-crowded keyspace onto standby "
                          "shards, survives its own SIGKILL, and drains "
                          "cold — CONTROL_CURVE.json (DESIGN.md §21)")
+    ap.add_argument("--router-ha", dest="router_ha", action="store_true",
+                    help="router warm-standby failover soak instead of "
+                         "the shard sweep: SIGKILL the primary router "
+                         "mid-stream (bounded promotion, zero acked-op "
+                         "loss), a deposed-primary resurrection fence "
+                         "leg, and an autopilot split through the "
+                         "promoted router — HA_CURVE.json (DESIGN.md "
+                         "§22)")
     ap.add_argument("--out", default=None,
                     help="artifact path (default SHARD_CURVE.json, or "
                          "MESH_CURVE.json with --mesh)")
@@ -1306,6 +1919,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return run_mesh_mode(args)
     if args.autopilot:
         return run_autopilot_mode(args)
+    if args.router_ha:
+        return run_router_ha_mode(args)
     args.out = args.out or os.path.join(REPO, "SHARD_CURVE.json")
 
     if args.quick:
@@ -1338,6 +1953,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                                       ("events", "traffic", "acked_ops",
                                        "lost_acked_ops",
                                        "phantom_members")}}), flush=True)
+        chaos = chaos_leg(root, elements, args.seed)
+        print(json.dumps({"chaos": {k: chaos[k] for k in
+                                    ("outage", "proxy", "acked_ops",
+                                     "lost_acked_ops", "phantom_members",
+                                     "resubmit_rounds")}}), flush=True)
     finally:
         import shutil
 
@@ -1364,6 +1984,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "shard_curve": curve,
         "kill_leg": kill,
         "reshard_leg": reshard,
+        "chaos_leg": chaos,
         "elapsed_s": round(time.time() - t0, 1),
         "platform": "cpu",
     }
@@ -1389,6 +2010,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     # (c) the reshard leg: aborts left the old ring serving, commits
     # moved exactly the predicted slice, nothing acked was lost
     ok = ok and adjudicate_reshard(reshard, args.quick)
+    # (d) the router↔shard chaos leg: typed degradation under torn
+    # frames + asymmetric partition, breaker recovery after heal
+    ok = ok and adjudicate_chaos(chaos)
     return 0 if ok else 1
 
 
