@@ -481,53 +481,53 @@ class ServeClient:
                 now = time.monotonic()
                 if msg_type == protocol.MSG_ACK:
                     req_id = protocol.decode_ack(body)
-                    self._finish(req_id, None, now)
+                    self._finish(req_id, None, now, sock, gen)
                 elif msg_type == protocol.MSG_REJECT:
                     req_id, code, reason = protocol.decode_reject(body)
                     exc = protocol.REJECT_EXCEPTIONS[code](reason)
-                    self._finish(req_id, exc, now)
+                    self._finish(req_id, exc, now, sock, gen)
                 elif msg_type == protocol.MSG_MEMBERS:
                     req_id, members, vv = protocol.decode_members(body)
                     with self._lock:
                         self._replies[req_id] = (members, vv)
-                    self._finish(req_id, None, now)
+                    self._finish(req_id, None, now, sock, gen)
                 elif msg_type == protocol.MSG_STATS_REPLY:
                     req_id, snapshot = protocol.decode_stats_reply(body)
                     with self._lock:
                         self._replies[req_id] = snapshot
-                    self._finish(req_id, None, now)
+                    self._finish(req_id, None, now, sock, gen)
                 elif msg_type == protocol.MSG_SLICE_STATE:
                     req_id, payload = protocol.decode_slice_state(body)
                     with self._lock:
                         self._replies[req_id] = payload
-                    self._finish(req_id, None, now)
+                    self._finish(req_id, None, now, sock, gen)
                 elif msg_type == protocol.MSG_RESHARD_REPLY:
                     req_id, ok, detail = protocol.decode_reshard_reply(body)
                     with self._lock:
                         self._replies[req_id] = (ok, detail)
-                    self._finish(req_id, None, now)
+                    self._finish(req_id, None, now, sock, gen)
                 elif msg_type == protocol.MSG_FRONTIER_REPLY:
                     req_id, fr, proc, iso = \
                         protocol.decode_frontier_reply(body)
                     with self._lock:
                         self._replies[req_id] = (fr, proc, iso)
-                    self._finish(req_id, None, now)
+                    self._finish(req_id, None, now, sock, gen)
                 elif msg_type == protocol.MSG_GC_REPLY:
                     req_id, dropped, remaining = \
                         protocol.decode_gc_reply(body)
                     with self._lock:
                         self._replies[req_id] = (dropped, remaining)
-                    self._finish(req_id, None, now)
+                    self._finish(req_id, None, now, sock, gen)
                 elif msg_type == protocol.MSG_DSUM_REPLY:
                     req_id, summary = protocol.decode_dsum_reply(body)
                     with self._lock:
                         self._replies[req_id] = summary
-                    self._finish(req_id, None, now)
+                    self._finish(req_id, None, now, sock, gen)
                 elif msg_type == protocol.MSG_RING_SYNC_REPLY:
                     req_id, record = protocol.decode_ring_sync_reply(body)
                     with self._lock:
                         self._replies[req_id] = record
-                    self._finish(req_id, None, now)
+                    self._finish(req_id, None, now, sock, gen)
                 else:
                     err = framing.ProtocolError(
                         f"unexpected frame type {msg_type}")
@@ -582,7 +582,8 @@ class ServeClient:
                     self._on_result(op)
 
     def _finish(self, req_id: int, exc: Optional[BaseException],
-                now: float) -> None:
+                now: float, sock: Optional[socket.socket] = None,
+                gen: int = -1) -> None:
         rotate_sock = None
         with self._lock:
             op = self._pending.pop(req_id, None)
@@ -593,14 +594,20 @@ class ServeClient:
                 self._replies.pop(req_id, None)
                 return
             if (isinstance(exc, protocol.StaleRouterEpoch)
-                    and len(self.addrs) > 1):
+                    and len(self.addrs) > 1 and gen == self._gen):
                 # a DEPOSED router answered: it is alive but must not
                 # be used — aim the next dial at the successor and
                 # tear this connection down so the next attempt
                 # rotates (the reject still resolves this op typed;
-                # remaining in-flight ops surface typed-ambiguous)
+                # remaining in-flight ops surface typed-ambiguous).
+                # Scoped to the connection the reject ARRIVED on (the
+                # reader's sock/gen, the same check its death sweep
+                # makes): by now self._sock can already be a NEWER
+                # dial to the promoted successor, and shutting that
+                # down would kill a healthy connection and surface
+                # spurious AmbiguousOp for its in-flight ops
                 self._next_dial = (self._active + 1) % len(self.addrs)
-                rotate_sock = self._sock
+                rotate_sock = sock
         if rotate_sock is not None:
             try:
                 rotate_sock.shutdown(socket.SHUT_RDWR)

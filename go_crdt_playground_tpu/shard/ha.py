@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import threading
 import time
+import warnings
 from typing import Dict, Mapping, Optional, Tuple
 
 from go_crdt_playground_tpu.shard.handoff import (PHASE_COMMITTED,
@@ -105,6 +106,15 @@ class RouterStandby:
         # (timeouts, breaker knobs) — race-ok: read-only after __init__
         self.router_kwargs = dict(router_kwargs or {})
         self._lock = threading.Lock()
+        # serializes the WHOLE promotion sequence (epoch persist →
+        # router build → announce → bind): the router-is-None check at
+        # promote() entry alone would let a manual promote racing the
+        # poll loop build two live routers — with listen_addr the loser
+        # merely fails on bind, but embedded (listen_addr=None) both
+        # would survive and one leaks its shard links and readers.
+        # Never held while _lock is held the other way: the order is
+        # _promote_lock -> _lock
+        self._promote_lock = threading.Lock()
         self._client = None  # guarded-by: _lock
         self._failures = 0  # guarded-by: _lock
         self._last_record: Optional[Dict] = None  # guarded-by: _lock
@@ -115,6 +125,7 @@ class RouterStandby:
         self._promotion_s: Optional[float] = None  # guarded-by: _lock
         self._announce_results: Dict = {}  # guarded-by: _lock
         self._promote_reason: Optional[str] = None  # guarded-by: _lock
+        self._warned_epoch_zero = False  # guarded-by: _lock
         self._promoted = threading.Event()
         self._stop_loop = threading.Event()
         # race-ok: start()/close() owner thread only
@@ -179,8 +190,14 @@ class RouterStandby:
 
     def close(self) -> None:
         self.stop()
-        with self._lock:
-            router = self.router
+        # _promote_lock: a manual promote() mid-sequence finishes (or
+        # unwinds) before the router is read — without it, close()
+        # could observe router=None while the racing promote is between
+        # construction and the store, leaking the router it builds
+        # (shard links, reader threads, a bound listener)
+        with self._promote_lock:
+            with self._lock:
+                router = self.router
         if router is not None:
             router.close()
 
@@ -246,10 +263,14 @@ class RouterStandby:
         the restart-adoptable shape (only when the generation moved —
         tail polls are frequent and fsyncs are not free)."""
         generation = record.get("generation")
+        warn_epoch_zero = False
         with self._lock:
             self._failures = 0
             self._last_record = dict(record)
             epoch = int(record.get("router_epoch", 0) or 0)
+            if epoch == 0 and not self._warned_epoch_zero:
+                self._warned_epoch_zero = True
+                warn_epoch_zero = True
             persist_epoch = epoch > self._last_primary_epoch
             if persist_epoch:
                 self._last_primary_epoch = epoch
@@ -259,6 +280,25 @@ class RouterStandby:
                        and generation != self._persisted_generation)
             if persist:
                 self._persisted_generation = generation
+        if warn_epoch_zero:
+            # resurrection containment is only airtight when the
+            # PRIMARY can rediscover the adjudicated epoch before
+            # taking traffic again.  A state_dir primary probes the
+            # shards at serve() regardless of its epoch, but one
+            # started with neither --router-epoch >= 1 nor a state_dir
+            # restarts blind after this standby promotes: deposed stays
+            # False and it forwards ops over its stale ring — exactly
+            # the acked-writes-stranded hazard the fence exists for.
+            # Loud and counted, not fatal: epoch-0 primaries are every
+            # pre-HA deployment, and the standby still contains the
+            # admin plane either way.
+            self._count("router.ha.primary_epoch_zero")
+            warnings.warn(
+                "RouterStandby is tailing a primary at router epoch 0; "
+                "restart the primary with --router-epoch >= 1 (or a "
+                "--state-dir) or a resurrected primary will not "
+                "self-fence its data plane after a promotion",
+                RuntimeWarning, stacklevel=2)
         if persist_epoch:
             # the tailed epoch is part of what makes this standby WARM:
             # without it on disk, a standby restart would read as
@@ -288,9 +328,16 @@ class RouterStandby:
     def promote(self, reason: str = "manual") -> ShardRouter:
         """The promotion sequence (module docstring): persist the
         bumped epoch FIRST, build the router over the tailed ring,
-        announce the epoch fleet-wide, then bind the listener.  Safe
-        to call at most once; returns the serving router."""
+        announce the epoch fleet-wide, then bind the listener.
+        Single-entry end to end (``_promote_lock``): a concurrent call
+        blocks until the winner finishes, then returns the winner's
+        router — never a second one."""
         t0 = time.monotonic()
+        with self._promote_lock:
+            return self._promote_locked(reason, t0)
+
+    # requires-lock: _promote_lock
+    def _promote_locked(self, reason: str, t0: float) -> ShardRouter:
         with self._lock:
             if self.router is not None:
                 return self.router

@@ -566,6 +566,7 @@ class ShardRouter:
                                 load_router_epoch(state_dir))
         self.router_id = (router_id if router_id
                           else f"router-{os.getpid()}")
+        self._state_dir = state_dir
         if state_dir is not None and self.router_epoch > 0:
             persist_router_epoch(state_dir, self.router_epoch,
                                  self.router_id)
@@ -810,7 +811,8 @@ class ShardRouter:
     # -- lifecycle ----------------------------------------------------------
 
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
-        if self.router_epoch > 0 and not self._announced_fleet:
+        if not self._announced_fleet and (self.router_epoch > 0
+                                          or self._state_dir is not None):
             # HA deployments: announce/probe BEFORE taking traffic —
             # a resurrected deposed primary discovers the promoted
             # epoch here (the shards remember it durably) and starts
@@ -819,9 +821,16 @@ class ShardRouter:
             # the promoted router may have resharded past could strand
             # acked writes on handoff donors (read-filtered, invisible
             # to fleet reads — the one thing zero-acked-op-loss can
-            # never tolerate).  Skipped when the owner already fanned
-            # the announce out (the promotion path) — one fleet RTT,
-            # not two, on the SIGKILL-to-serving critical path.
+            # never tolerate).  Gated on state_dir as well as epoch:
+            # a primary left at the DEFAULT epoch 0 never persists a
+            # claim, so an epoch test alone would let its resurrection
+            # skip straight to forwarding over a possibly-stale ring —
+            # with epoch 0 the probe is a pure RING_SYNC read, and a
+            # shard record carrying any adjudicated epoch > 0 arms the
+            # self-fence (announce_epoch's reply check).  Skipped when
+            # the owner already fanned the announce out (the promotion
+            # path) — one fleet RTT, not two, on the SIGKILL-to-serving
+            # critical path.
             self.announce_epoch()
         addr = self.host.listen(host, port)
         if self._fleet_gc_interval_s > 0:

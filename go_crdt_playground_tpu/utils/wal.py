@@ -34,9 +34,12 @@ the segment to its valid prefix, drops any later segments) so appends
 land on a clean tail.
 
 Metrics (optional ``recorder``): ``wal.appends`` / ``wal.appended_bytes``
-on the write path, ``wal.torn_tail`` when an open-time repair found a
-tear, ``wal.truncations`` on checkpoint-driven resets; the replay-side
-``wal.records`` counter is owned by ``net.peer.Node.replay_wal``.
+on the write path, ``wal.append_errors`` when the disk refuses one,
+``wal.tail_repairs`` when the NEXT append first had to truncate the
+partial record that failure may have left behind, ``wal.torn_tail``
+when an open-time repair found a tear, ``wal.truncations`` on
+checkpoint-driven resets; the replay-side ``wal.records`` counter is
+owned by ``net.peer.Node.replay_wal``.
 """
 
 from __future__ import annotations
@@ -115,6 +118,10 @@ class DeltaWal:
         self._lock = threading.Lock()
         self._file = None  # guarded-by: _lock
         self._file_size = 0  # guarded-by: _lock
+        # a failed append may have left a PARTIAL record on disk past
+        # _file_size; no further byte may land until _heal_locked has
+        # truncated the tail back to the last known-good end
+        self._dirty = False  # guarded-by: _lock
         # (seq, valid_end) of tears already counted by records() — a
         # re-scan of the same physical tear must not re-count it
         self._post_open_tears: set = set()  # guarded-by: _lock
@@ -194,19 +201,31 @@ class DeltaWal:
         a failing device) is counted as ``wal.append_errors`` and
         re-raised — the serving layer classifies it into the typed
         ``StorageDegraded`` shed (serve/batcher.py) instead of letting
-        it escape a worker thread untyped."""
+        it escape a worker thread untyped.  The failure also marks the
+        tail dirty: the flush may have landed a PARTIAL record beyond
+        ``_file_size``, and the next append (the degrade window's disk
+        probe) first heals that tear — truncate back to the known-good
+        end, reopen — so an acked probe record can never sit BEHIND a
+        tear that recovery's prefix rule would truncate at (which would
+        silently drop it, and every later acked record, on restart)."""
         rec = encode_record(body)
         try:
             with self._lock:
-                if self._file is None:
+                if self._file is None and not self._dirty:
                     raise ValueError("WAL is closed")
-                if self._file_size > 0 and \
-                        self._file_size + len(rec) > self.segment_bytes:
-                    self._rotate_locked()
-                self._file.write(rec)
-                self._file.flush()
-                if self.fsync:
-                    os.fsync(self._file.fileno())
+                try:
+                    if self._dirty:
+                        self._heal_locked()
+                    if self._file_size > 0 and \
+                            self._file_size + len(rec) > self.segment_bytes:
+                        self._rotate_locked()
+                    self._file.write(rec)
+                    self._file.flush()
+                    if self.fsync:
+                        os.fsync(self._file.fileno())
+                except OSError:
+                    self._dirty = True
+                    raise
                 self._file_size += len(rec)
         except OSError:
             self._count("wal.append_errors")
@@ -215,20 +234,76 @@ class DeltaWal:
         self._count("wal.appended_bytes", len(rec))
 
     # requires-lock: _lock
-    def _rotate_locked(self) -> None:
-        self._file.flush()
-        if self.fsync:
-            os.fsync(self._file.fileno())
-        self._file.close()
-        self._seq += 1
+    def _heal_locked(self) -> None:
+        """Repair the tail a failed append poisoned: truncate the live
+        segment back to ``_file_size`` (the end of the last record whose
+        fsync returned) and reopen it, so no later byte can land beyond
+        the partial record the failure may have left.  Raises the
+        disk's ``OSError`` while the device still refuses — the tail
+        stays dirty and the next append retries the heal."""
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass  # flushing the buffered partial can fail again;
+                # the fd is closed either way and truncate trims it
+            self._file = None
+        try:
+            with open(self._seg_path(self._seq), "r+b") as f:
+                f.truncate(self._file_size)
+                f.flush()
+                os.fsync(f.fileno())
+        except FileNotFoundError:
+            pass  # a failed rotation never created the segment; the
+            # reopen below starts it empty
+        # fresh=True UNCONDITIONALLY: the failure that poisoned the
+        # tail may have been the directory fsync right after the
+        # segment was created (the file exists, its entry is not
+        # durable) — a redundant dir fsync is harmless, a skipped one
+        # re-opens the crash window that drops the whole segment of
+        # acked records
         self._open_segment(self._seq, fresh=True)
+        self._dirty = False
+        self._count("wal.tail_repairs")
+
+    # requires-lock: _lock
+    def _rotate_locked(self) -> None:
+        try:
+            if self._dirty:
+                # seal() can rotate while the tail is torn: heal FIRST,
+                # or the tear would be frozen into a sealed segment and
+                # the prefix scan would stop there — never reaching the
+                # fresh segment's post-seal records
+                self._heal_locked()
+            self._file.flush()
+            if self.fsync:
+                os.fsync(self._file.fileno())
+            self._file.close()
+            self._seq += 1
+            # known-good end of the NEW segment; set before the open so
+            # a failed open leaves no stale size for _heal_locked to
+            # trust
+            self._file_size = 0
+            self._open_segment(self._seq, fresh=True)
+        except OSError:
+            # armed HERE, not only in append's wrapper: seal() rotates
+            # too, and a failure must leave the log retryable-degraded
+            # (next append heals), never half-closed
+            self._dirty = True
+            raise
 
     def truncate(self) -> None:
         """Drop every record: a successful checkpoint now owns them.
         The fresh segment continues the sequence (never reuses a seq)."""
         with self._lock:
             if self._file is not None:
-                self._file.close()
+                try:
+                    self._file.close()
+                except OSError:
+                    pass  # a dirty buffer's implicit flush can
+                    # re-raise (ENOSPC): every buffered byte is about
+                    # to be unlinked anyway, and aborting here would
+                    # keep a full disk full — truncate IS the reclaim
                 self._file = None
             for seq in self._segments():
                 try:
@@ -236,8 +311,16 @@ class DeltaWal:
                 except OSError:
                     pass
             self._seq += 1
+            self._file_size = 0
+            # armed until the fresh segment is open: a transient
+            # failure in the reopen must read as retryable-degraded
+            # (the next append heals), not as a closed WAL — the
+            # ValueError wedge would escape the serving layer's typed
+            # OSError classification forever
+            self._dirty = True
             self._open_segment(self._seq, fresh=True)
             self._post_open_tears.clear()
+            self._dirty = False  # every poisoned byte was just unlinked
             _fsync_dir(self.path)
         self._count("wal.truncations")
 
@@ -296,14 +379,24 @@ class DeltaWal:
 
     def close(self) -> None:
         with self._lock:
+            # a tear left dirty at close stays on disk; the next open's
+            # construction-time _repair truncates it (clearing the flag
+            # keeps append's closed-check authoritative: a closed WAL
+            # must never self-heal back to life)
+            dirty, self._dirty = self._dirty, False
             if self._file is not None:
-                self._file.flush()
-                if self.fsync:
-                    try:
-                        os.fsync(self._file.fileno())
-                    except OSError:
-                        pass
-                self._file.close()
+                if not dirty:  # a dirty buffer re-raises on flush, and
+                    # its bytes are past the known-good end anyway
+                    self._file.flush()
+                    if self.fsync:
+                        try:
+                            os.fsync(self._file.fileno())
+                        except OSError:
+                            pass
+                try:
+                    self._file.close()
+                except OSError:
+                    pass  # close's implicit flush of a dirty buffer
                 self._file = None
 
     def __enter__(self) -> "DeltaWal":

@@ -100,6 +100,117 @@ def test_wal_append_errors_counter(tmp_path):
     assert "wal.appends" not in snap
 
 
+def test_wal_heals_torn_tail_before_probe_append(tmp_path):
+    """A failed append can leave a PARTIAL record on disk; the degrade
+    window's probe append must not land (and be acked) beyond it —
+    recovery's prefix rule stops at the first tear, so everything acked
+    after it would be silently dropped on restart.  append() heals the
+    tail (truncate to the known-good end, reopen) before the next byte
+    lands."""
+    from go_crdt_playground_tpu.obs import Recorder
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    rec = Recorder()
+    path = str(tmp_path / "wal")
+    wal = DeltaWal(path, fsync=False, recorder=rec)
+    wal.append(b"acked-before")
+
+    class _TornEnospc:
+        """Writes HALF the record, then fails — the torn-mid-record
+        shape a real ENOSPC/EIO leaves behind."""
+
+        def __init__(self, f):
+            self._f = f
+
+        def write(self, data):
+            self._f.write(data[:len(data) // 2])
+            self._f.flush()
+            raise OSError(28, "No space left on device")
+
+        def __getattr__(self, name):
+            return getattr(self._f, name)
+
+    with wal._lock:
+        wal._file = _TornEnospc(wal._file)
+    with pytest.raises(OSError):
+        wal.append(b"doomed-unacked")
+    # the disk heals; the probe append repairs the tear FIRST, so its
+    # record is readable — in-process and after a restart
+    wal.append(b"acked-probe")
+    assert list(wal.records()) == [b"acked-before", b"acked-probe"]
+    snap = rec.snapshot()["counters"]
+    assert snap["wal.tail_repairs"] == 1
+    assert snap["wal.append_errors"] == 1
+    wal.close()
+    wal2 = DeltaWal(path, fsync=False)
+    try:
+        assert list(wal2.records()) == [b"acked-before", b"acked-probe"]
+        # the in-process heal already trimmed the tear: open-time
+        # repair found nothing left to do
+        assert not wal2.torn_tail_repaired
+    finally:
+        wal2.close()
+
+
+def test_wal_reopen_failure_stays_retryable_not_closed(tmp_path):
+    """A transient OSError while opening the fresh segment (truncate's
+    reset, a rotation) must leave the log retryable-degraded — the
+    next append heals it, including the directory fsync for a segment
+    that was never created — not wedged as 'closed' (a ValueError
+    would escape the serving layer's typed OSError classification)."""
+    from go_crdt_playground_tpu.obs import Recorder
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    rec = Recorder()
+    wal = DeltaWal(str(tmp_path / "wal"), fsync=False, recorder=rec)
+    wal.append(b"pre-truncate")
+    orig = wal._open_segment
+
+    def flaky(seq, fresh):
+        raise OSError(5, "Input/output error")
+
+    wal._open_segment = flaky
+    with pytest.raises(OSError):
+        wal.truncate()
+    wal._open_segment = orig
+    wal.append(b"post-heal")  # heals: fresh segment, dir fsync'd
+    assert list(wal.records()) == [b"post-heal"]
+    snap = rec.snapshot()["counters"]
+    assert snap["wal.tail_repairs"] == 1
+    wal.close()
+
+
+def test_wal_truncate_reclaims_despite_dirty_buffer(tmp_path):
+    """truncate() IS the disk-space reclaim after a checkpoint: on a
+    FULL disk the poisoned buffer's implicit flush re-raises ENOSPC at
+    close — truncate must swallow that and still unlink (unlinking
+    needs no free space, and every buffered byte is about to go)."""
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    wal = DeltaWal(str(tmp_path / "wal"), fsync=False)
+    wal.append(b"checkpointed")
+
+    class _FullDisk:
+        def write(self, data):
+            raise OSError(28, "No space left on device")
+
+        def close(self):
+            raise OSError(28, "No space left on device")
+
+        def __getattr__(self, name):
+            raise AssertionError(f"unexpected {name} on full disk")
+
+    with wal._lock:
+        real, wal._file = wal._file, _FullDisk()
+    real.close()
+    with pytest.raises(OSError):
+        wal.append(b"doomed")
+    wal.truncate()  # reclaim proceeds past the re-raising close
+    wal.append(b"post-reclaim")
+    assert list(wal.records()) == [b"post-reclaim"]
+    wal.close()
+
+
 # ---------------------------------------------------------------------------
 # shard-side fence adjudication
 # ---------------------------------------------------------------------------
@@ -219,6 +330,38 @@ def test_router_ring_record_and_self_fence(tmp_path):
         fe.close()
 
 
+def test_epoch_zero_primary_restart_self_fences(tmp_path):
+    """Resurrection containment without ``--router-epoch``: a primary
+    left at the DEFAULT epoch 0 but given a state_dir still runs the
+    serve()-time discovery probe (an epoch-0 RING_SYNC is a pure read),
+    hears the promoted epoch from the shards, and starts life deposed —
+    data plane sheds typed, reads keep serving."""
+    shard_dir = str(tmp_path / "s0")
+    fe = ServeFrontend(E, A, durable_dir=shard_dir, flush_ms=0.5)
+    fe.serve()
+    try:
+        # a standby promoted to epoch 2 while this primary was dead
+        with ServeClient(_addr(fe)) as c:
+            c.ring_sync(2, "router-b")
+        router = ShardRouter({"s0": _addr(fe)}, E,
+                             state_dir=str(tmp_path / "router-a"),
+                             router_id="router-a")  # epoch defaults to 0
+        addr = router.serve()
+        try:
+            assert router.deposed
+            with ServeClient(addr) as c:
+                with pytest.raises(protocol.StaleRouterEpoch):
+                    c.add(1)
+                members, _ = c.members()  # reads serve through it
+                assert members == []
+            snap = router.recorder.snapshot()["counters"]
+            assert snap["router.shed.deposed"] >= 1
+        finally:
+            router.close()
+    finally:
+        fe.close()
+
+
 # ---------------------------------------------------------------------------
 # the standby state machine (poll_once seam — no wall-clock waits)
 # ---------------------------------------------------------------------------
@@ -334,6 +477,65 @@ def test_standby_does_not_promote_while_primary_healthy(tmp_path):
         fe.close()
 
 
+def test_promote_is_single_entry(tmp_path):
+    """A manual promote() racing the poll loop (or a second retry) must
+    never build TWO routers: with listen_addr=None (embedded use) both
+    would survive and one would leak its shard links and reader
+    threads.  The promotion lock serializes the whole sequence; the
+    loser returns the winner's router."""
+    fe = ServeFrontend(E, A, durable_dir=str(tmp_path / "s0"),
+                       flush_ms=0.5)
+    fe.serve()
+    standby = RouterStandby(("127.0.0.1", free_port()),
+                            {"s0": _addr(fe)}, E,
+                            state_dir=str(tmp_path / "b"),
+                            standby_id="router-b")
+    routers = []
+    barrier = threading.Barrier(2)
+
+    def race():
+        barrier.wait()
+        routers.append(standby.promote(reason="race"))
+
+    try:
+        threads = [threading.Thread(target=race) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert len(routers) == 2
+        assert routers[0] is routers[1]
+        assert standby.router is routers[0]
+        snap = standby.recorder.snapshot()["counters"]
+        assert snap["router.ha.promotions"] == 1
+    finally:
+        standby.close()
+        fe.close()
+
+
+def test_standby_warns_on_epoch_zero_primary(tmp_path):
+    """The fence is only airtight when a resurrected primary can
+    rediscover the adjudicated epoch: tailing a primary that runs the
+    default epoch 0 (and so may restart blind) is loud — one warning
+    per standby plus a counter — never fatal."""
+    fe = ServeFrontend(E, A, flush_ms=0.5)
+    fe.serve()
+    primary = ShardRouter({"s0": _addr(fe)}, E)  # pre-HA default: 0
+    primary_addr = primary.serve()
+    standby = RouterStandby(primary_addr, {"s0": _addr(fe)}, E,
+                            state_dir=str(tmp_path / "b"))
+    try:
+        with pytest.warns(RuntimeWarning, match="router epoch 0"):
+            assert standby.poll_once() == POLL_TAILED
+        assert standby.poll_once() == POLL_TAILED  # warned once only
+        snap = standby.recorder.snapshot()["counters"]
+        assert snap["router.ha.primary_epoch_zero"] == 1
+    finally:
+        standby.close()
+        primary.close()
+        fe.close()
+
+
 # ---------------------------------------------------------------------------
 # client failover semantics
 # ---------------------------------------------------------------------------
@@ -405,5 +607,55 @@ def test_client_idempotent_reads_retry_across_list():
             members, _ = c.members()
             assert members == []
             assert c.stats()["counters"] is not None
+    finally:
+        fe.close()
+
+
+def test_stale_epoch_reject_only_rotates_its_own_connection():
+    """A StaleRouterEpoch reject tears down the connection it ARRIVED
+    on — never a newer socket a concurrent failover re-dial already
+    replaced it with (shutting that down would kill a healthy
+    connection and surface spurious AmbiguousOp for its in-flight
+    ops)."""
+    import time as time_mod
+
+    from go_crdt_playground_tpu.serve.client import PendingOp
+
+    class _FakeSock:
+        def __init__(self):
+            self.shut = False
+
+        def shutdown(self, how):
+            self.shut = True
+
+    fe = ServeFrontend(E, A, flush_ms=0.5)
+    fe.serve()
+    try:
+        with ServeClient([_addr(fe), ("127.0.0.1", free_port())]) as c:
+            with c._lock:
+                cur_gen = c._gen
+                c._pending[9901] = PendingOp(9901, time_mod.monotonic())
+                dial_before = c._next_dial
+            # a reject from a SUPERSEDED connection: no rotation, and
+            # the (stale) socket it came on is left alone too — its
+            # reader's death sweep already owns that teardown
+            stale_sock = _FakeSock()
+            c._finish(9901, protocol.StaleRouterEpoch("deposed"),
+                      time_mod.monotonic(), stale_sock, cur_gen - 1)
+            assert not stale_sock.shut
+            with c._lock:
+                assert c._next_dial == dial_before
+            members, _ = c.members()  # the live connection still serves
+            assert members == []
+            # the same reject on the CURRENT connection rotates it
+            with c._lock:
+                cur_gen = c._gen
+                c._pending[9902] = PendingOp(9902, time_mod.monotonic())
+            live_sock = _FakeSock()
+            c._finish(9902, protocol.StaleRouterEpoch("deposed"),
+                      time_mod.monotonic(), live_sock, cur_gen)
+            assert live_sock.shut
+            with c._lock:
+                assert c._next_dial != dial_before
     finally:
         fe.close()
