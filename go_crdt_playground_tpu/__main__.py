@@ -131,17 +131,26 @@ def _cmd_serve(port: int) -> int:
         return 0
 
 
-def _cmd_serve_ingest(args) -> int:
-    """The op-ingest frontend as a process: serve client ops until
-    SIGTERM/SIGINT, then DRAIN (stop accepting, flush+ack the admitted
-    ops, final durable checkpoint) — the graceful half of the serving
-    ladder; the crash half is the serve soak's SIGKILL."""
-    import signal
-    import threading
+def _ingest_banner(args, host: str, bound: int) -> None:
+    """The standard serving banner — printed by the normal launch AND
+    at a shard standby's promotion (the address line doubles as the
+    promotion handshake for harnesses, the fleet-runner discipline)."""
+    print(f"Op-ingest frontend listening on {host}:{bound} "
+          f"(E={args.elements} A={args.actors} actor={args.actor} "
+          f"batch<={args.max_batch} flush={args.flush_ms}ms "
+          f"queue={args.queue_depth} "
+          f"durable={'yes' if args.durable_dir else 'NO'} "
+          f"fused={'yes' if args.fused_ingest else 'NO'} "
+          f"sync={args.sync_mode} "
+          f"mesh={args.mesh_devices or 'off'} "
+          f"shard={args.shard_id or 'off'} "
+          f"compaction={args.compact_interval or 'off'})", flush=True)
 
+
+def _build_frontend(args):
     from go_crdt_playground_tpu.serve import ServeFrontend
 
-    fe = ServeFrontend(
+    return ServeFrontend(
         args.elements, args.actors, actor=args.actor,
         durable_dir=args.durable_dir, peers=args.peer,
         queue_depth=args.queue_depth, max_batch=args.max_batch,
@@ -152,7 +161,25 @@ def _cmd_serve_ingest(args) -> int:
         compact_p99_budget_s=args.compact_p99_budget_ms / 1e3,
         gc_participants=args.gc_participants,
         sync_mode=args.sync_mode,
-        mesh_devices=args.mesh_devices)
+        mesh_devices=args.mesh_devices,
+        shard_id=args.shard_id,
+        shard_epoch=args.shard_epoch,
+        announce_to=args.announce_to,
+        repl_ack_timeout_ms=args.repl_ack_timeout_ms)
+
+
+def _cmd_serve_ingest(args) -> int:
+    """The op-ingest frontend as a process: serve client ops until
+    SIGTERM/SIGINT, then DRAIN (stop accepting, flush+ack the admitted
+    ops, final durable checkpoint) — the graceful half of the serving
+    ladder; the crash half is the serve soak's SIGKILL."""
+    import signal
+    import threading
+
+    if args.standby_of is not None:
+        return _cmd_serve_standby(args)
+
+    fe = _build_frontend(args)
     if args.mesh_devices is not None and not args.fused_ingest:
         print("WARNING: --no-fused-ingest is ignored with "
               "--mesh-devices — the mesh write path is always the "
@@ -164,15 +191,7 @@ def _cmd_serve_ingest(args) -> int:
               "--compact-interval > 0 — no compaction scheduler runs, "
               "deletion records will grow unboundedly", flush=True)
     host, bound = fe.serve(port=args.port, peer_port=args.peer_port)
-    print(f"Op-ingest frontend listening on {host}:{bound} "
-          f"(E={args.elements} A={args.actors} actor={args.actor} "
-          f"batch<={args.max_batch} flush={args.flush_ms}ms "
-          f"queue={args.queue_depth} "
-          f"durable={'yes' if args.durable_dir else 'NO'} "
-          f"fused={'yes' if args.fused_ingest else 'NO'} "
-          f"sync={args.sync_mode} "
-          f"mesh={args.mesh_devices or 'off'} "
-          f"compaction={args.compact_interval or 'off'})", flush=True)
+    _ingest_banner(args, host, bound)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     try:
@@ -185,6 +204,78 @@ def _cmd_serve_ingest(args) -> int:
     lat = snap["observations"].get("serve.ingest_latency_s")
     p99 = f"{lat['p99'] * 1e3:.2f}ms" if lat else "n/a"
     print(f"drained: {acked} ops acked, ingest p99 {p99}", flush=True)
+    return 0
+
+
+def _cmd_serve_standby(args) -> int:
+    """The warm-standby shard frontend (DESIGN.md §23): tail the
+    primary's WAL, promote on its death under a bumped fenced shard
+    epoch + router keyspace claim, and only THEN print the standard
+    ``listening on`` banner — the promotion handshake, exactly the
+    router-standby discipline."""
+    import signal
+    import threading
+
+    from go_crdt_playground_tpu.shard.replica import ShardStandby
+
+    if args.port == 0:
+        print("error: --standby-of requires a fixed --port (the "
+              "router's ordered shard roster names the standby "
+              "address BEFORE promotion)", file=sys.stderr, flush=True)
+        return 2
+    if args.durable_dir is None:
+        print("error: --standby-of requires --durable-dir (the tailed "
+              "replica and the fenced shard epoch must persist)",
+              file=sys.stderr, flush=True)
+        return 2
+    if args.shard_id is None:
+        print("error: --standby-of requires --shard-id (the keyspace "
+              "failover claim names it at the router)",
+              file=sys.stderr, flush=True)
+        return 2
+    fe = _build_frontend(args)
+    standby = ShardStandby(
+        tuple(args.standby_of), fe, sid=args.shard_id,
+        standby_id=args.standby_id or f"{args.shard_id}-standby",
+        listen_addr=("127.0.0.1", args.port),
+        announce_to=args.announce_to,
+        poll_interval_s=args.ha_poll_interval,
+        failure_threshold=args.ha_failure_threshold)
+    standby.start()
+    print(f"Shard standby engaged (primary="
+          f"{args.standby_of[0]}:{args.standby_of[1]} "
+          f"sid={args.shard_id} port={args.port} "
+          f"id={standby.standby_id} poll={args.ha_poll_interval}s "
+          f"threshold={args.ha_failure_threshold})", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    promoted = False
+    tailing_announced = False
+    try:
+        while not stop.is_set():
+            if not tailing_announced and standby.tailed_ever:
+                # the scriptable warm handshake: a standby that never
+                # printed this has never tailed and will NOT promote
+                # (the empty-replica / epoch-collision guard)
+                print(f"Shard standby tailing primary wal "
+                      f"(cursor={standby.cursor})", flush=True)
+                tailing_announced = True
+            if standby.await_promoted(0.2):
+                promoted = True
+                break
+    except KeyboardInterrupt:
+        pass
+    if promoted:
+        _ingest_banner(args, "127.0.0.1", args.port)
+        try:
+            stop.wait()
+        except KeyboardInterrupt:
+            pass
+        snap = fe.recorder.snapshot()
+        acked = snap["counters"].get("serve.ops.acked", 0)
+        print(f"drained: {acked} ops acked (promoted standby, "
+              f"reason={standby.promote_reason!r})", flush=True)
+    standby.close()
     return 0
 
 
@@ -374,7 +465,12 @@ def _cmd_reshard(args) -> int:
     from go_crdt_playground_tpu.serve.client import ServeClient
 
     if args.join is not None:
-        mode, sid, addr = protocol.RESHARD_JOIN, args.join[0], args.join[1]
+        from go_crdt_playground_tpu.serve.client import normalize_addrs
+
+        # a roster spec joins by its ACTIVE member (the handoff pushes
+        # one slice to one address; the roster shape is router config)
+        mode, sid = protocol.RESHARD_JOIN, args.join[0]
+        addr = normalize_addrs(args.join[1])[0]
     else:
         mode, sid, addr = protocol.RESHARD_LEAVE, args.leave, None
     with ServeClient(tuple(args.router), timeout=args.timeout) as c:
@@ -407,9 +503,12 @@ def _cmd_autopilot(args) -> int:
         min_shards=args.min_shards,
         max_shards=args.max_shards,
         cold_rate_per_shard=args.cold_rate)
+    from go_crdt_playground_tpu.serve.client import normalize_addrs
+
     routers = [tuple(a) for a in args.router]
+    standbys = [(sid, normalize_addrs(a)[0]) for sid, a in args.standby]
     pilot = FleetAutopilot(
-        routers, args.standby, config=config,
+        routers, standbys, config=config,
         poll_interval_s=args.poll_interval,
         reshard_timeout_s=args.reshard_timeout,
         decision_log=args.decision_log, seed=args.seed)
@@ -560,6 +659,53 @@ def main(argv=None) -> int:
                    help="seed-comparison mode: two dispatches per batch "
                         "(apply, then delta_extract for the WAL record) "
                         "and dense WAL records")
+    s.add_argument("--shard-id", dest="shard_id", default=None,
+                   help="this frontend's shard id in its fleet "
+                        "(DESIGN.md §23): names the keyspace in "
+                        "failover announces to the router")
+    s.add_argument("--shard-epoch", dest="shard_epoch", type=int,
+                   default=0,
+                   help="this member's shard epoch (0 = fence dormant; "
+                        "an HA replication-group primary starts at 1, "
+                        "a promoted standby persists primary+1).  The "
+                        "persisted record in --durable-dir wins over a "
+                        "smaller flag")
+    s.add_argument("--announce-to", dest="announce_to", action="append",
+                   default=None, type=_peer_addr, metavar="HOST:PORT",
+                   help="router address to announce this member's "
+                        "(shard-id, shard-epoch, serve address) to at "
+                        "startup and promotion — repeatable as an "
+                        "ORDERED router HA failover list.  A deposed "
+                        "member learns the adjudicated epoch from the "
+                        "typed reply and boots self-fenced")
+    s.add_argument("--repl-ack-timeout-ms", dest="repl_ack_timeout_ms",
+                   type=float, default=250.0,
+                   help="semi-synchronous replication ack budget: the "
+                        "batcher waits this long after the group-"
+                        "commit fsync for the standby's durable cursor "
+                        "before degrading typed to async "
+                        "(repl.degraded_windows)")
+    s.add_argument("--standby-of", dest="standby_of", default=None,
+                   type=_peer_addr, metavar="HOST:PORT",
+                   help="run as the WARM STANDBY of the primary shard "
+                        "frontend at this address (DESIGN.md §23): "
+                        "tail its WAL over WAL_SYNC into --durable-dir, "
+                        "promote on its death under a bumped fenced "
+                        "shard epoch, claim the keyspace at "
+                        "--announce-to, then serve on --port (which "
+                        "must be fixed).  Requires --durable-dir and "
+                        "--shard-id")
+    s.add_argument("--standby-id", dest="standby_id", default=None,
+                   help="stable standby identity for epoch records and "
+                        "replication logs (default: <shard-id>-standby)")
+    s.add_argument("--ha-poll-interval", dest="ha_poll_interval",
+                   type=float, default=0.1,
+                   help="standby WAL tail/health poll cadence in "
+                        "seconds (the long-poll window rides on top)")
+    s.add_argument("--ha-failure-threshold", dest="ha_failure_threshold",
+                   type=int, default=5,
+                   help="consecutive failed WAL_SYNC polls before the "
+                        "standby promotes itself")
     s.add_argument("--mesh-devices", dest="mesh_devices", type=int,
                    default=None, metavar="N",
                    help="hold the replica state lane-sharded across a "
@@ -573,12 +719,19 @@ def main(argv=None) -> int:
                         "count=8 before launch")
 
     def _shard_spec(text: str):
+        """``ID=HOST:PORT`` — or ``ID=HOST:PORT,HOST:PORT`` for an
+        ordered replication-group roster (active member first, warm
+        standbys behind it; DESIGN.md §23)."""
         sid, _, addr = text.partition("=")
-        host, _, port = addr.rpartition(":")
-        if not sid or not host or not port.isdigit():
-            raise argparse.ArgumentTypeError(
-                f"shard must be ID=HOST:PORT, got {text!r}")
-        return sid, (host, int(port))
+        addrs = []
+        for part in addr.split(","):
+            host, _, port = part.rpartition(":")
+            if not sid or not host or not port.isdigit():
+                raise argparse.ArgumentTypeError(
+                    f"shard must be ID=HOST:PORT[,HOST:PORT...], "
+                    f"got {text!r}")
+            addrs.append((host, int(port)))
+        return sid, (addrs[0] if len(addrs) == 1 else addrs)
 
     r = sub.add_parser("router")
     r.add_argument("--serve", action="store_true",

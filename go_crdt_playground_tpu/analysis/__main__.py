@@ -74,7 +74,14 @@ LOCK_TARGETS = ["net/peer.py", "net/antientropy.py", "net/digestsync.py",
                 # the router HA tier (ISSUE 13): the standby's tail
                 # loop thread, the promotion path, and await/observer
                 # readers all cross on the standby lock
-                "shard/ha.py"]
+                "shard/ha.py",
+                # the shard replication tier (ISSUE 14): the
+                # publisher's condition crosses WAL_SYNC reader
+                # threads with the batcher's ack gate, and the shard
+                # standby's tail loop crosses promote()/observers —
+                # plus the shared degrade-window latch both serving
+                # ladders poll cross-thread
+                "shard/replica.py", "utils/degrade.py"]
 # extra files that participate in the lock-ORDER graph (their locks can
 # nest under the runtime's)
 LOCK_ORDER_EXTRA = ["utils/checkpoint.py"]
@@ -104,7 +111,10 @@ ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "signals": "FleetSignals",
                 "pool": "StandbyPool",
                 "pilot": "FleetAutopilot",
-                "standby": "RouterStandby"}
+                "standby": "RouterStandby",
+                "repl": "ReplicationPublisher",
+                "window": "DegradeWindow",
+                "_storage": "DegradeWindow"}
 
 # the full pass list (report keys): the report-freshness lint pins the
 # COMMITTED artifact's pass list to this — landing a new pass without

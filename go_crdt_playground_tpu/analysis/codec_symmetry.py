@@ -273,6 +273,76 @@ def build_codecs() -> List[CodecSpec]:
         ("encode_ring_sync_reply", "decode_ring_sync_reply"),
         self_delimiting=False)
 
+    def gen_wal_sync(rng):
+        catchup = rng.random() < 0.4
+        summary = (rng.integers(0, 256, int(rng.integers(1, 60)))
+                   .astype(np.uint8).tobytes() if catchup else None)
+        return (_rid(rng), int(rng.integers(1, 1 << 20)),
+                int(rng.integers(0, 1 << 12)),
+                "sb-" + str(int(rng.integers(100))),
+                int(rng.integers(0, 2000)), int(rng.integers(0, 512)),
+                summary)
+
+    add("wal_sync", p.encode_wal_sync, p.decode_wal_sync,
+        gen_wal_sync,
+        lambda a: (a[0], a[2], a[3], a[1], a[4], a[5], a[6]), P,
+        ("encode_wal_sync", "decode_wal_sync"),
+        self_delimiting=False)
+
+    def gen_wal_sync_reply(rng):
+        catchup = rng.random() < 0.3
+        if catchup:
+            records = ()
+            payload = (rng.integers(0, 256, int(rng.integers(1, 60)))
+                       .astype(np.uint8).tobytes())
+        else:
+            records = tuple(
+                rng.integers(0, 256, int(rng.integers(0, 30)))
+                .astype(np.uint8).tobytes()
+                for _ in range(int(rng.integers(0, 5))))
+            payload = None
+        first = int(rng.integers(1, 1 << 16))
+        return (_rid(rng), int(rng.integers(0, 2)),
+                int(rng.integers(0, 1 << 12)),
+                "s" + str(int(rng.integers(10))),
+                "%08x" % int(rng.integers(1 << 31)),
+                first, first + len(records), first, records, payload)
+
+    def cmp_wal_sync_reply(got, want) -> bool:
+        # the encoder ORs WAL_CATCHUP_PAYLOAD into flags when a
+        # payload rides along; compare modulo that bit, everything
+        # else exactly
+        exp_flags = want[1] | (p.WAL_CATCHUP_PAYLOAD
+                               if want[9] is not None else 0)
+        return (got.req_id, got.flags, got.shard_epoch, got.shard_id,
+                got.nonce, got.min_seq, got.next_seq, got.first_seq,
+                tuple(got.records), got.payload) == (
+            want[0], exp_flags, want[2], want[3], want[4], want[5],
+            want[6], want[7], tuple(want[8]), want[9])
+
+    add("wal_sync_reply", p.encode_wal_sync_reply,
+        p.decode_wal_sync_reply, gen_wal_sync_reply,
+        lambda a: a, P,
+        ("encode_wal_sync_reply", "decode_wal_sync_reply"),
+        self_delimiting=False, compare=cmp_wal_sync_reply)
+
+    add("shard_failover", p.encode_shard_failover,
+        p.decode_shard_failover,
+        lambda rng: (_rid(rng), int(rng.integers(1, 1 << 12)),
+                     "s" + str(int(rng.integers(10))),
+                     "sb-" + str(int(rng.integers(100))),
+                     ("127.0.0.1", int(rng.integers(1, 1 << 16)))),
+        lambda a: a, P,
+        ("encode_shard_failover", "decode_shard_failover"))
+    add("shard_failover_reply", p.encode_shard_failover_reply,
+        p.decode_shard_failover_reply,
+        lambda rng: (_rid(rng),
+                     {"sid": "s1", "shard_epoch": int(rng.integers(100)),
+                      "swapped": bool(rng.integers(0, 2))}),
+        lambda a: a, P,
+        ("encode_shard_failover_reply", "decode_shard_failover_reply"),
+        self_delimiting=False)
+
     # -- net/framing.py ------------------------------------------------------
     add("hello", framing.encode_hello,
         lambda body: framing.decode_hello(body, E, A),

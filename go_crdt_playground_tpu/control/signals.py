@@ -73,6 +73,12 @@ class FleetView(NamedTuple):
     # decision record carries it — the soak adjudicates that a split
     # after a failover committed through the PROMOTED router
     router_epoch: int = 0
+    # which replication-group MEMBER serves each keyspace (DESIGN.md
+    # §23): a shard failover shows up as a per-sid epoch bump between
+    # consecutive views — the decision log records keyspace failovers
+    # the same way it records router ones.  None (not {}: a mutable
+    # NamedTuple default is shared class-wide) = pre-§23 router
+    shard_epochs: Optional[Dict] = None
 
     @property
     def reachable(self) -> List[ShardSignals]:
@@ -94,6 +100,7 @@ class FleetView(NamedTuple):
             "t": round(self.t, 3),
             "generation": self.generation,
             "router_epoch": self.router_epoch,
+            "shard_epochs": dict(self.shard_epochs or {}),
             "shards": list(self.shards),
             "fenced": self.fenced,
             "imbalance": self.imbalance(),
@@ -192,6 +199,8 @@ class FleetSignals:
             fenced=int(ring.get("fenced", 0)),
             load_stats=dict(ring.get("load_stats", {})),
             per_shard=per_shard,
-            router_epoch=int(ring.get("router_epoch", 0) or 0))
+            router_epoch=int(ring.get("router_epoch", 0) or 0),
+            shard_epochs={str(s): int(e) for s, e in
+                          (ring.get("shard_epochs") or {}).items()})
         self.last_view = view
         return view

@@ -602,6 +602,54 @@ class Node:
         with self._lock:
             self._apply_msg(body)
 
+    # -- shard replication (WAL shipping, shard/replica.py, §23) ------------
+
+    def apply_wal_record(self, body: bytes) -> str:
+        """Apply ONE shipped WAL record body — the standby half of a
+        shard replication group: decode it exactly like ``replay_wal``
+        (compact-tag dispatch, replay-GUARD check), write-ahead the
+        ORIGINAL bytes to our own WAL, then apply through the normal
+        payload path.  Logging the record VERBATIM keeps the standby's
+        log replayable under the same guard discipline (the guard is
+        the primary's pre-record vv, which a caught-up standby
+        mirrors) and its state bitwise-convergent with the primary's
+        restart path — both sides run the identical payload sequence
+        through the identical apply.
+
+        Returns ``"applied"``, or ``"future"`` when the guard outruns
+        our vv — a GAP in the stream (never possible on an in-order
+        tail; possible after a missed catch-up): the caller must
+        digest-catch-up, never skip, because applying past a gap would
+        fast-forward the vv over lanes we never received (the
+        replay_wal hole).  Raises ``ProtocolError``/``ValueError`` for
+        an undecodable record (the stream is corrupt: catch up and
+        resume)."""
+        from go_crdt_playground_tpu.net.framing import MODE_DELTA as _D
+        from go_crdt_playground_tpu.utils import wire
+
+        if body[:1] == bytes((wire.WAL_COMPACT_TAG,)):
+            guard, payload = wire.decode_compact_wal_body(
+                body, self.num_elements, self.num_actors)
+            with self._lock:
+                if np.any(np.asarray(guard, np.uint32)
+                          > np.asarray(self._state.vv[0])):
+                    return "future"
+                if self.wal is not None:
+                    self.wal.append(body)
+                self._apply_payload(_D, payload)
+        else:
+            guard, pos = wire._decode_vv_py(body, 0, self.num_actors)
+            mode, payload = framing.decode_payload_msg(
+                body[pos:], self.num_elements, self.num_actors)
+            with self._lock:
+                if np.any(np.asarray(guard, np.uint32)
+                          > np.asarray(self._state.vv[0])):
+                    return "future"
+                if self.wal is not None:
+                    self.wal.append(body)
+                self._apply_payload(mode, payload)
+        return "applied"
+
     # -- digest-driven anti-entropy (net/digestsync.py, DESIGN.md §19) ------
 
     def _digest_fn(self, state_slice, group_size):

@@ -42,6 +42,7 @@ import numpy as np
 
 from go_crdt_playground_tpu.serve import protocol
 from go_crdt_playground_tpu.serve.admission import AdmissionQueue, OpRequest
+from go_crdt_playground_tpu.utils.degrade import DegradeWindow
 
 _CRASH_ENV = "CRDT_SERVE_CRASH_AFTER_BATCHES"
 
@@ -59,7 +60,8 @@ class MicroBatcher:
     def __init__(self, target, queue: AdmissionQueue, *,
                  max_batch: int = 32, flush_s: float = 0.002,
                  idle_wait_s: float = 0.05, recorder=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 repl=None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         # anything satisfying serve/apply.ApplyTarget (ingest_batch
@@ -71,19 +73,25 @@ class MicroBatcher:
         self.idle_wait_s = idle_wait_s
         self.recorder = recorder
         self._clock = clock
+        # semi-synchronous replication gate (shard/replica.py §23):
+        # after the group-commit fsync, acks wait — bounded — for the
+        # standby's durable cursor to cover the batch.  None/dormant
+        # keeps the pre-HA ack path byte-identical.
+        # race-ok: read-only after construction
+        self.repl = repl
         self._stop = threading.Event()
         # race-ok: start()/stop() owner thread only
         self._thread: Optional[threading.Thread] = None
         # race-ok: post-mortem breadcrumb (loop thread writes, a
         # post-stop reader inspects); no control flow depends on it
         self.last_error: Optional[BaseException] = None
-        # monotonic deadline of the storage-degrade window (0 = disk
-        # healthy).  race-ok: written only by the batcher loop thread;
-        # listener reader threads poll it through storage_degraded() —
-        # a float store is atomic in CPython, and the worst stale read
-        # costs one op a REJECT_STORAGE-vs-Overloaded classification,
-        # never correctness (both are typed retryable sheds)
-        self._storage_degraded_until = 0.0
+        # the disk-full probe window (utils/degrade.py — the shared
+        # latch this batcher's inline deadline field grew into).
+        # Armed by the batcher loop thread only; listener reader
+        # threads poll it through storage_degraded() — the worst stale
+        # read costs one op a REJECT_STORAGE-vs-Overloaded
+        # classification, never correctness (both typed retryable)
+        self._storage = DegradeWindow(self.STORAGE_RETRY_S, clock)
         # race-ok: loop-thread-only batch counter driving the SIGKILL
         # test hook (None = hook disabled)
         self._crash_after: Optional[int] = None
@@ -139,8 +147,7 @@ class MicroBatcher:
         of queueing them toward a WAL that just refused an fsync.  The
         window expires on its own (the next admitted batch is the disk
         probe) and clears immediately on a successful apply."""
-        until = self._storage_degraded_until
-        return bool(until) and self._clock() < until
+        return self._storage.active()
 
     def _flush_remaining(self) -> None:
         """Post-stop sweep: anything still queued (loop died, or drain
@@ -212,8 +219,7 @@ class MicroBatcher:
             # reads keep serving, writes shed typed until a probe
             # batch survives this call again
             self.last_error = e
-            self._storage_degraded_until = (self._clock()
-                                            + self.STORAGE_RETRY_S)
+            self._storage.arm()
             self._count("serve.batch_errors")
             for r in live:
                 self._count("serve.shed.storage")
@@ -240,15 +246,28 @@ class MicroBatcher:
                         r.req_id, protocol.REJECT_OVERLOADED,
                         f"batch apply failed (retry): {e}"))
             return
-        if self._storage_degraded_until:
+        if self._storage.armed_ever():
             # the probe batch survived: the disk recovered — clear the
             # degrade window so admission stops shedding writes
-            self._storage_degraded_until = 0.0
+            self._storage.clear()
         if self._crash_after is not None:
             self._crash_after -= 1
             if self._crash_after <= 0:
                 # the test window: durably applied, NOT yet acked
                 os.kill(os.getpid(), signal.SIGKILL)
+        if self.repl is not None:
+            # semi-sync group commit (DESIGN.md §23): wait — bounded —
+            # for the standby's durable cursor to cover this batch's
+            # WAL records before the acks go out.  A dead/slow standby
+            # degrades typed to async inside gate() (the repl.degraded
+            # window), so this can stall an ack by at most one
+            # ack_timeout per degraded episode, never indefinitely.
+            wal = None
+            lock = getattr(self.target, "_lock", None)
+            if lock is not None:
+                with lock:
+                    wal = getattr(self.target, "wal", None)
+            self.repl.gate(wal)
         apply_s = self._clock() - t0
         acked = 0
         for r in live:

@@ -409,6 +409,42 @@ class ServeClient:
             lambda rid: protocol.encode_ring_sync(rid, epoch, router_id),
             idempotent=True)
 
+    def wal_sync(self, from_seq: int, *, epoch: int = 0,
+                 standby_id: str = "", wait_ms: int = 0,
+                 max_records: int = 0,
+                 summary: Optional[bytes] = None
+                 ) -> "protocol.WalSyncReply":
+        """The shard-replication tail verb (DESIGN.md §23): poll the
+        primary's committed WAL records from ``from_seq`` (which also
+        ACKS everything below it), or — with ``summary`` — request the
+        O(diff) digest catch-up.  ``epoch > 0`` is a shard-epoch claim
+        (the promoting standby's deposition notice); announcing the
+        same epoch twice is idempotent, and the read itself is pure,
+        so the call retries across an HA address list.  A reply
+        timeout must cover ``wait_ms`` (the server long-polls that
+        long before answering empty)."""
+        return self._request_reply(
+            protocol.MSG_WAL_SYNC,
+            lambda rid: protocol.encode_wal_sync(
+                rid, from_seq, epoch, standby_id, wait_ms, max_records,
+                summary),
+            timeout=(self.timeout + wait_ms / 1e3 if wait_ms else None),
+            idempotent=True)
+
+    def shard_failover(self, epoch: int, sid: str, owner_id: str,
+                       addr: Addr) -> dict:
+        """The keyspace-failover claim at the router (DESIGN.md §23):
+        adjudicate ``epoch`` for shard ``sid`` and swap its downstream
+        address to ``addr``.  Idempotent by construction (re-claiming
+        the adjudicated state echoes it), so the promoted standby's
+        announce retries across an ordered router HA list; a stale
+        claim raises the typed ``StaleShardEpoch``."""
+        return self._request_reply(
+            protocol.MSG_SHARD_FAILOVER,
+            lambda rid: protocol.encode_shard_failover(
+                rid, epoch, sid, owner_id, addr),
+            idempotent=True)
+
     # -- fleet-aware GC (router aggregation, DESIGN.md §17) -----------------
 
     def frontier(self) -> Tuple[np.ndarray, np.ndarray, bool]:
@@ -525,6 +561,17 @@ class ServeClient:
                     self._finish(req_id, None, now, sock, gen)
                 elif msg_type == protocol.MSG_RING_SYNC_REPLY:
                     req_id, record = protocol.decode_ring_sync_reply(body)
+                    with self._lock:
+                        self._replies[req_id] = record
+                    self._finish(req_id, None, now, sock, gen)
+                elif msg_type == protocol.MSG_WAL_SYNC_REPLY:
+                    reply = protocol.decode_wal_sync_reply(body)
+                    with self._lock:
+                        self._replies[reply.req_id] = reply
+                    self._finish(reply.req_id, None, now, sock, gen)
+                elif msg_type == protocol.MSG_SHARD_FAILOVER_REPLY:
+                    req_id, record = \
+                        protocol.decode_shard_failover_reply(body)
                     with self._lock:
                         self._replies[req_id] = record
                     self._finish(req_id, None, now, sock, gen)

@@ -66,7 +66,11 @@ class ServeFrontend:
                  compact_p99_budget_s: float = 0.25,
                  gc_participants: Optional[Sequence[int]] = None,
                  sync_mode: str = "delta",
-                 mesh_devices: Optional[int] = None):
+                 mesh_devices: Optional[int] = None,
+                 shard_id: Optional[str] = None,
+                 shard_epoch: int = 0,
+                 announce_to=None,
+                 repl_ack_timeout_ms: float = 250.0):
         from go_crdt_playground_tpu.obs import Recorder
 
         self.recorder = recorder if recorder is not None else Recorder()
@@ -108,9 +112,21 @@ class ServeFrontend:
         self.node.ingest_fused = ingest_fused
         self.node.wal_compact_records = wal_compact_records
         self.queue = AdmissionQueue(queue_depth)
+        # shard replication (DESIGN.md §23): the publisher tracks
+        # tailing standbys' durable cursors and gates the batcher's
+        # acks semi-synchronously on them (degrading typed to async
+        # when the standby is dead/slow — a standby can never take
+        # this primary's availability down).  Dormant until the first
+        # WAL_SYNC poll registers a standby.
+        from go_crdt_playground_tpu.shard.replica import \
+            ReplicationPublisher
+
+        self.repl = ReplicationPublisher(
+            self.recorder, ack_timeout_s=repl_ack_timeout_ms / 1e3)
         self.batcher = MicroBatcher(
             self.node, self.queue, max_batch=max_batch,
-            flush_s=flush_ms / 1000.0, recorder=self.recorder)
+            flush_s=flush_ms / 1000.0, recorder=self.recorder,
+            repl=self.repl)
         # the dissemination half rides the EXISTING supervisor; it also
         # owns the durable checkpoint cadence (and attaches a WAL to a
         # fresh non-restored node when durable_dir is set)
@@ -152,7 +168,10 @@ class ServeFrontend:
         # untrusted length header can make one connection buffer.
         slice_cap = max(ConnHost.MAX_FRAME_BODY,
                         16 * num_elements + 4096)
-        slice_verbs = (protocol.MSG_SLICE_PUSH, protocol.MSG_SLICE_PULL)
+        # WAL_SYNC requests carry a digest summary in the catch-up form
+        # (O(E/16) bytes) — same universe-scaled treatment
+        slice_verbs = (protocol.MSG_SLICE_PUSH, protocol.MSG_SLICE_PULL,
+                       protocol.MSG_WAL_SYNC)
         self.host = ConnHost(
             self._dispatch, recorder=self.recorder,
             counter_prefix="serve", thread_name="serve",
@@ -184,6 +203,36 @@ class ServeFrontend:
         self._epoch_lock = threading.Lock()
         self._router_epoch = load_router_epoch(
             durable_dir)  # guarded-by: _epoch_lock
+        # SHARD-epoch fence (DESIGN.md §23): this member's own claim to
+        # its keyspace and the highest epoch it has ever adjudicated
+        # (a standby's deposition notice, or the router's typed verdict
+        # on the serve()-time announce probe).  seen > own = deposed:
+        # a standby promoted past this member — writes shed typed
+        # StaleShardEpoch, reads keep serving (CRDT lower bound).
+        from go_crdt_playground_tpu.shard.replica import (
+            load_shard_epoch, load_shard_epoch_seen, persist_shard_epoch)
+
+        self.shard_id = shard_id
+        self.announce_to = announce_to
+        self._shard_epoch = max(int(shard_epoch), load_shard_epoch(
+            durable_dir))  # guarded-by: _epoch_lock
+        self._shard_epoch_seen = max(
+            self._shard_epoch,
+            load_shard_epoch_seen(durable_dir))  # guarded-by: _epoch_lock
+        if (durable_dir is not None and shard_epoch > 0
+                and self._shard_epoch == int(shard_epoch)):
+            # a flag-raised epoch persists before it is acted on, the
+            # router-epoch discipline
+            persist_shard_epoch(durable_dir, self._shard_epoch,
+                                shard_id or "?",
+                                seen=self._shard_epoch_seen)
+        # WAL-instance nonce: record seqs are only meaningful within
+        # one DeltaWal lifetime; a restart renumbers, and the nonce in
+        # every WAL_SYNC reply is how standbys find out (typed cursor
+        # reset, never a silent gap).  race-ok: read-only after init
+        self._wal_nonce = os.urandom(8).hex()
+        # race-ok: serve()/warmup() owner thread only
+        self._warmed = False
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -194,8 +243,15 @@ class ServeFrontend:
         starts its anti-entropy server / supervisor loop."""
         if self.host.listening:
             raise RuntimeError("already serving")
-        self._warmup()
+        self.warmup()
+        if port != 0:
+            # announce BEFORE the listener opens when the serving
+            # address is declared: a deposed member must learn its
+            # verdict before the first direct write can reach it
+            self._announce_shard((host, port))
         self.addr = self.host.listen(host, port)
+        if port == 0:
+            self._announce_shard(self.addr)
         self.batcher.start()
         if peer_port is not None:
             self.node.serve(host, peer_port)
@@ -218,6 +274,92 @@ class ServeFrontend:
                 self.compactor.gc_participants = self._gc_declared
             self.compactor.start()
         return self.addr
+
+    def warmup(self) -> None:
+        """Idempotent public warmup: a shard STANDBY (shard/replica.py)
+        compiles the whole serving path at ENGAGE time so its
+        promotion pays a bind + announce, not a first-batch
+        trace+compile inside the failover budget; ``serve()`` calls
+        this too and skips the second run."""
+        if not self._warmed:
+            self._warmup()
+            self._warmed = True
+
+    def _announce_shard(self, addr: Addr) -> None:
+        """The serve()-time keyspace announce / resurrection probe
+        (DESIGN.md §23): tell the router which member serves
+        ``shard_id`` under which shard epoch.  Idempotent for the
+        active member; a RESURRECTED deposed primary gets the typed
+        ``StaleShardEpoch`` verdict here — the router's per-sid fence
+        is durable — and boots self-fenced.  Best-effort beyond that:
+        an unreachable router never blocks serving (pre-HA deployments
+        configure no ``announce_to`` at all)."""
+        if self.announce_to is None or self.shard_id is None:
+            return
+        from go_crdt_playground_tpu.serve.client import ServeClient
+        from go_crdt_playground_tpu.shard.replica import \
+            persist_shard_epoch as _persist
+
+        bump = False
+        with self._epoch_lock:
+            if self._shard_epoch < 1:
+                # an announce-configured member IS a replication-group
+                # member: adopt epoch 1 as our OWN claim (persisted)
+                # rather than claiming an epoch the WAL_SYNC replies
+                # would then contradict — a standby tailing the raw 0
+                # would promote at 0+1=1 and COLLIDE with this very
+                # claim at the router (equal epoch, different address
+                # = typed-stale: the failover could never swap)
+                self._shard_epoch = 1
+                self._shard_epoch_seen = max(self._shard_epoch_seen, 1)
+                bump = True
+            epoch = self._shard_epoch
+            seen = self._shard_epoch_seen
+        if bump:
+            _persist(self.durable_dir, epoch, self.shard_id, seen=seen)
+        try:
+            with ServeClient(self.announce_to, timeout=5.0,
+                             connect_timeout=2.0) as c:
+                c.shard_failover(epoch, self.shard_id,
+                                 f"serve-{os.getpid()}", addr)
+            self._count("serve.shard.announces")
+        except protocol.StaleShardEpoch:
+            # the adjudicated epoch is higher: a standby promoted past
+            # this member while it was down.  Self-fence (exact value
+            # immaterial — deposed is a comparison) and persist the
+            # adjudication so a re-restart boots fenced even if the
+            # router is unreachable then
+            from go_crdt_playground_tpu.shard.replica import \
+                persist_shard_epoch
+
+            with self._epoch_lock:
+                self._shard_epoch_seen = max(self._shard_epoch_seen,
+                                             self._shard_epoch + 1)
+                own, seen = self._shard_epoch, self._shard_epoch_seen
+            persist_shard_epoch(self.durable_dir, own,
+                                self.shard_id, seen=seen)
+            self._count("serve.shard.deposed_boot")
+        except Exception:  # noqa: BLE001 — transport failure or an
+            # unexpected router reply: the router may be mid-failover
+            # itself; its link-level ordered-address redial finds us
+            # regardless, so serving never blocks on the probe
+            self._count("serve.shard.announce_failures")
+
+    def claim_shard_epoch(self, epoch: int) -> None:
+        """Adopt a promotion-claimed shard epoch (the standby persists
+        it BEFORE calling this — shard/replica.py step 1)."""
+        with self._epoch_lock:
+            self._shard_epoch = max(self._shard_epoch, int(epoch))
+            self._shard_epoch_seen = max(self._shard_epoch_seen,
+                                         self._shard_epoch)
+
+    @property
+    def shard_deposed(self) -> bool:
+        """True once a HIGHER shard epoch than our own has been
+        adjudicated: a standby owns this keyspace now.  Writes shed
+        typed; reads keep serving."""
+        with self._epoch_lock:
+            return self._shard_epoch_seen > self._shard_epoch
 
     def _warmup(self) -> None:
         """Run one full throwaway ingest (batch apply + δ extraction +
@@ -339,8 +481,12 @@ class ServeFrontend:
             return self._handle_dsum(session, body)
         if msg_type == protocol.MSG_RING_SYNC:
             return self._handle_ring_sync(session, body)
+        if msg_type == protocol.MSG_WAL_SYNC:
+            return self._handle_wal_sync(session, body)
         # protocol-ignore: MSG_RESHARD — router-only admin verb; a
         # frontend answers it with the typed unknown-frame error below
+        # protocol-ignore: MSG_SHARD_FAILOVER — router-only failover
+        # adjudication verb; same typed unknown-frame answer
         session.send(framing.MSG_ERROR,
                      f"unexpected frame type {msg_type}".encode())
         return False
@@ -373,6 +519,19 @@ class ServeFrontend:
             self._count("serve.shed.draining")
             session.send(protocol.MSG_REJECT, protocol.encode_reject(
                 req_id, protocol.REJECT_DRAINING, "frontend draining"))
+            return True
+        if self.shard_deposed:
+            # shard-epoch self-fence (DESIGN.md §23): a standby owns
+            # this keyspace — a write applied here would be acked by a
+            # member the router never reads again (acked-but-invisible,
+            # the one thing zero-acked-op-loss can never tolerate).
+            # Reads below keep serving: a stale member's state is a
+            # correct CRDT lower bound.
+            self._count("serve.shed.shard_deposed")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_STALE_SHARD_EPOCH,
+                "shard member deposed (stale shard epoch) — a standby "
+                "was promoted for this keyspace; dial the router"))
             return True
         if self.batcher.storage_degraded():
             # disk-full graceful degrade (DESIGN.md §16 tail): the WAL
@@ -509,6 +668,159 @@ class ServeFrontend:
                 "already adjudicated (announce via RING_SYNC)"))
             return True
         return False
+
+    # -- shard replication: the WAL_SYNC serve verb (DESIGN.md §23) ---------
+
+    # reply-batch bounds: a tail reply never exceeds either, so one
+    # poll can neither blow the standby's frame cap nor hold the
+    # session writer behind a megarecord burst
+    WAL_SYNC_MAX_RECORDS = 256
+    WAL_SYNC_MAX_BYTES = 1 << 20
+
+    def _handle_wal_sync(self, session: Session, body: bytes) -> bool:
+        """Serve one standby tail poll / catch-up / epoch claim
+        (serve/protocol.py MSG_WAL_SYNC).  The ``from_seq`` cursor is
+        the standby's durable ack — it feeds the semi-sync publisher
+        BEFORE the records are read, so the batcher's gate wakes the
+        moment the ack lands.  An epoch claim above everything seen is
+        the promoting standby's deposition notice: adopted, persisted,
+        and from then on this member's writes shed typed."""
+        from go_crdt_playground_tpu.utils.wal import WalTruncated
+
+        try:
+            (req_id, epoch, standby_id, from_seq, wait_ms, max_records,
+             summary) = protocol.decode_wal_sync(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return False
+        # -- shard-epoch adjudication (the deposition notice path) ----------
+        if epoch > 0:
+            from go_crdt_playground_tpu.shard.replica import \
+                persist_shard_epoch
+
+            persist = None
+            with self._epoch_lock:
+                if epoch > self._shard_epoch_seen:
+                    self._shard_epoch_seen = epoch
+                    persist = (self._shard_epoch, epoch)
+                seen = self._shard_epoch_seen
+            if persist is not None:
+                # durable BEFORE the ack: a restart cannot forget that
+                # this keyspace was claimed past us
+                persist_shard_epoch(self.durable_dir, persist[0],
+                                    self.shard_id or "?",
+                                    seen=persist[1])
+                self._count("serve.shard_epoch.adopted")
+            if epoch < seen:
+                self._count("serve.rejects.stale_shard_epoch")
+                session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                    req_id, protocol.REJECT_STALE_SHARD_EPOCH,
+                    f"shard epoch {epoch} is stale: epoch {seen} "
+                    "already adjudicated"))
+                return True
+        with self._epoch_lock:
+            own_epoch = self._shard_epoch
+        node = self.node
+        with node._lock:
+            wal = node.wal
+        # -- catch-up: reply the O(diff) digest payload ---------------------
+        if summary is not None:
+            from go_crdt_playground_tpu.net import digestsync
+
+            try:
+                _actor, group_size, vv, _proc, digests = \
+                    digestsync.decode_summary(summary, node.num_elements,
+                                              node.num_actors)
+            except framing.ProtocolError as e:
+                session.send(framing.MSG_ERROR, str(e).encode())
+                return False
+            try:
+                with node._lock:
+                    # cursor read under the SAME lock hold as the
+                    # payload build: every record below next_seq is in
+                    # the payload's state, so resuming the tail there
+                    # can never skip one (appends take this lock)
+                    next_seq = wal.next_seq() if wal is not None else 1
+                    _mode, payload, _lanes, _gm = \
+                        digestsync.build_reply_payload(
+                            node, vv, digests, group_size)
+            except Exception as e:  # noqa: BLE001 — a failed extract
+                # must reply typed, not kill the reader thread
+                self._count("repl.ship_errors")
+                session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                    req_id, protocol.REJECT_OVERLOADED,
+                    f"catch-up extract failed (retry): {e}"))
+                return True
+            self._count("repl.catchups_served")
+            session.send(protocol.MSG_WAL_SYNC_REPLY,
+                         protocol.encode_wal_sync_reply(
+                             req_id, 0, own_epoch, self.shard_id or "?",
+                             self._wal_nonce,
+                             wal.min_seq() if wal is not None else 1,
+                             next_seq, next_seq, (), payload))
+            return True
+        # -- tail poll: the ack, then a bounded record batch ----------------
+        self.repl.note_poll(standby_id, from_seq)
+        flags = 0
+        records: list = []
+        first_seq = from_seq
+        if wal is None:
+            min_seq = next_seq = 1
+        else:
+            self.repl.refresh_gauges(wal.next_seq())
+            if from_seq > wal.next_seq():
+                # a cursor beyond this WAL instance's tail is from a
+                # previous numbering (the nonce catches the common
+                # case; this guard catches a standby that missed it):
+                # typed reset, never a silent forever-spin
+                session.send(protocol.MSG_WAL_SYNC_REPLY,
+                             protocol.encode_wal_sync_reply(
+                                 req_id, protocol.WAL_TRUNCATED,
+                                 own_epoch, self.shard_id or "?",
+                                 self._wal_nonce, wal.min_seq(),
+                                 wal.next_seq(), from_seq, ()))
+                return True
+            cap = min(max_records or self.WAL_SYNC_MAX_RECORDS,
+                      self.WAL_SYNC_MAX_RECORDS)
+            deadline = (time.monotonic() + min(wait_ms, 5000) / 1e3
+                        if wait_ms > 0 else None)
+            while True:
+                try:
+                    total = 0
+                    for seq, rec in wal.stream_from(from_seq):
+                        if not records:
+                            first_seq = seq
+                        records.append(rec)
+                        total += len(rec)
+                        if (len(records) >= cap
+                                or total >= self.WAL_SYNC_MAX_BYTES):
+                            break
+                except WalTruncated:
+                    # typed, never a silent gap: the standby must
+                    # digest-catch-up and resume at next_seq
+                    flags |= protocol.WAL_TRUNCATED
+                    records = []
+                except OSError:
+                    self._count("repl.ship_errors")
+                    records = []
+                if records or flags or deadline is None \
+                        or time.monotonic() >= deadline \
+                        or self.host.draining:
+                    break
+                # long-poll: the standby parks here between batches so
+                # a fresh record ships within ~one tick of its fsync
+                time.sleep(0.005)
+            min_seq = wal.min_seq()
+            next_seq = (first_seq + len(records) if records
+                        else wal.next_seq() if flags else from_seq)
+            if records:
+                self._count("repl.records_shipped", len(records))
+        session.send(protocol.MSG_WAL_SYNC_REPLY,
+                     protocol.encode_wal_sync_reply(
+                         req_id, flags, own_epoch, self.shard_id or "?",
+                         self._wal_nonce, min_seq, next_seq, first_seq,
+                         records))
+        return True
 
     # -- keyspace handoff (live resharding, DESIGN.md §18) ------------------
 

@@ -51,7 +51,7 @@ durable-before-ack contract as DESIGN.md §14.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -125,6 +125,36 @@ MSG_DSUM_REPLY = 33  # protocol-ignore: reply — digest summary body
 # sequence bumps the epoch and announces it fleet-wide BEFORE serving).
 MSG_RING_SYNC = 34
 MSG_RING_SYNC_REPLY = 35  # protocol-ignore: reply — ring/epoch record
+# shard replication groups (DESIGN.md §23): WAL_SYNC is the data-plane
+# sibling of RING_SYNC — a warm-standby SHARD (shard/replica.py) tails
+# its primary's committed δ-WAL records by seq cursor (the reply ships
+# a contiguous batch plus the primary's shard epoch, WAL-instance
+# nonce and retained-seq bounds), and the same request doubles as the
+# standby's liveness/ack signal: ``from_seq`` acknowledges everything
+# below it, which is what the primary's semi-synchronous group-commit
+# gate waits on (serve/batcher.py).  A cursor below the retained
+# minimum (a checkpoint truncated the log) replies typed-truncated and
+# the standby catches up O(diff): it re-sends WAL_SYNC carrying its
+# own digest summary and the reply carries a digest-sync payload
+# (net/digestsync.build_reply_payload) plus the fresh cursor.  An
+# ``epoch`` claim above the shard's own fences it exactly like
+# RING_SYNC fences a router (the promoting standby's deposition
+# notice).
+MSG_WAL_SYNC = 36
+MSG_WAL_SYNC_REPLY = 37  # protocol-ignore: reply — WAL tail batch
+# the keyspace-failover announce (DESIGN.md §23): a PROMOTED shard
+# standby claims its primary's keyspace at the ROUTER under a bumped,
+# persisted shard epoch.  The router adjudicates per sid (highest
+# epoch wins, durably), swaps the sid's downstream address under the
+# existing RouteState machinery — the ring and owner map are
+# untouched; only where the keyspace's ops go changes — and persists
+# the swap so a router restart redials the promoted member.  A claim
+# below the adjudicated epoch is the resurrected OLD primary's
+# startup probe: typed ``REJECT_STALE_SHARD_EPOCH``, on which it
+# boots self-fenced (writes shed typed; the PR-13 deposed-router
+# containment one tier down).
+MSG_SHARD_FAILOVER = 38
+MSG_SHARD_FAILOVER_REPLY = 39  # protocol-ignore: reply — failover verdict
 
 OP_ADD = 0
 OP_DEL = 1
@@ -140,6 +170,7 @@ REJECT_UNAVAILABLE = 5
 REJECT_MOVING = 6
 REJECT_STALE_EPOCH = 7
 REJECT_STORAGE = 8
+REJECT_STALE_SHARD_EPOCH = 9
 
 _MAX_REASON = 1 << 16
 
@@ -210,6 +241,18 @@ class StorageDegraded(ServeError):
     cooldown cadence."""
 
 
+class StaleShardEpoch(ServeError):
+    """The caller acted under a SHARD epoch older than the highest
+    adjudicated for that keyspace (DESIGN.md §23): it is a deposed
+    shard primary — its warm standby promoted past it.  Writes to the
+    deposed member were NOT applied (it sheds them typed with this
+    code the moment it learns the adjudicated epoch); a failover
+    announce under a stale epoch was NOT adopted.  Deterministic,
+    never retryable with the same epoch: clients re-resolve the
+    keyspace's active member through the router, which keeps serving
+    it throughout."""
+
+
 REJECT_EXCEPTIONS = {
     REJECT_OVERLOADED: Overloaded,
     REJECT_EXPIRED: DeadlineExceeded,
@@ -219,6 +262,7 @@ REJECT_EXCEPTIONS = {
     REJECT_MOVING: KeyspaceMoving,
     REJECT_STALE_EPOCH: StaleRouterEpoch,
     REJECT_STORAGE: StorageDegraded,
+    REJECT_STALE_SHARD_EPOCH: StaleShardEpoch,
 }
 
 # exception class -> wire code (the ROUTER's relay direction: a typed
@@ -747,6 +791,234 @@ def decode_ring_sync_reply(body: bytes) -> Tuple[int, dict]:
         raise ProtocolError(str(err)) from err
     if not isinstance(record, dict):
         raise ProtocolError("RING_SYNC_REPLY record is not a JSON object")
+    return req_id, record
+
+
+# -- shard replication: WAL tail + keyspace failover (DESIGN.md §23) --------
+
+# WAL_SYNC request flags
+WAL_SYNC_CATCHUP = 0x01   # body tail is the standby's digest summary
+# WAL_SYNC_REPLY flags
+WAL_TRUNCATED = 0x01      # cursor below the retained minimum: catch up
+WAL_CATCHUP_PAYLOAD = 0x02  # body tail is a digest-sync payload body
+
+
+class WalSyncReply(NamedTuple):
+    """One decoded WAL_SYNC reply (the field story is the module-level
+    MSG_WAL_SYNC comment's)."""
+
+    req_id: int
+    flags: int
+    shard_epoch: int
+    shard_id: str
+    nonce: str          # primary WAL-instance nonce: a restart resets
+    #                     record numbering, so a cursor only means
+    #                     anything against the nonce it was minted under
+    min_seq: int        # oldest retained record seq
+    next_seq: int       # the cursor to poll with next
+    first_seq: int      # seq of records[0] (== next_seq - len(records))
+    records: Tuple[bytes, ...]
+    payload: Optional[bytes]  # digest-sync catch-up payload body
+
+
+def encode_wal_sync(req_id: int, from_seq: int, epoch: int,
+                    standby_id: str, wait_ms: int = 0,
+                    max_records: int = 0,
+                    summary: Optional[bytes] = None) -> bytes:
+    """``from_seq`` is the tail cursor AND the ack: the standby has
+    durably applied every record below it.  ``epoch`` is a shard-epoch
+    claim (0 = pure read — the normal tail poll); a promoting standby
+    sends its bumped epoch as the deposition notice.  ``wait_ms`` asks
+    the primary to long-poll that long when no record is ready;
+    ``max_records`` bounds the reply batch (0 = server default).
+    ``summary`` flips the request into the catch-up form: the tail is
+    the standby's digest summary and the reply carries the O(diff)
+    payload instead of records."""
+    if from_seq < 1:
+        raise ValueError(f"from_seq must be >= 1, got {from_seq}")
+    if epoch < 0:
+        raise ValueError(f"shard epoch must be >= 0, got {epoch}")
+    if summary is not None and len(summary) == 0:
+        raise ValueError("empty catch-up summary")
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    out.append(WAL_SYNC_CATCHUP if summary is not None else 0)
+    wire._put_varint(out, int(epoch))
+    _put_str(out, standby_id)
+    wire._put_varint(out, int(from_seq))
+    wire._put_varint(out, max(0, int(wait_ms)))
+    wire._put_varint(out, max(0, int(max_records)))
+    return bytes(out) + (summary if summary is not None else b"")
+
+
+def decode_wal_sync(body: bytes) -> Tuple[int, int, str, int, int, int,
+                                          Optional[bytes]]:
+    """Returns ``(req_id, epoch, standby_id, from_seq, wait_ms,
+    max_records, summary)`` — ``summary`` is None for a plain tail
+    poll, the opaque digest-summary bytes for a catch-up request."""
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+        if pos >= len(body):
+            raise ProtocolError("truncated WAL_SYNC body")
+        flags = body[pos]
+        pos += 1
+        epoch, pos = wire._get_varint(body, pos)
+        standby_id, pos = _get_str(body, pos)
+        from_seq, pos = wire._get_varint(body, pos)
+        if from_seq < 1:
+            raise ProtocolError(f"WAL_SYNC from_seq {from_seq} < 1")
+        wait_ms, pos = wire._get_varint(body, pos)
+        max_records, pos = wire._get_varint(body, pos)
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    summary: Optional[bytes] = None
+    if flags & WAL_SYNC_CATCHUP:
+        if pos >= len(body):
+            raise ProtocolError("empty WAL_SYNC catch-up summary")
+        summary = body[pos:]
+    elif pos != len(body):
+        raise ProtocolError("trailing bytes after WAL_SYNC")
+    return req_id, epoch, standby_id, from_seq, wait_ms, max_records, \
+        summary
+
+
+def encode_wal_sync_reply(req_id: int, flags: int, shard_epoch: int,
+                          shard_id: str, nonce: str, min_seq: int,
+                          next_seq: int, first_seq: int,
+                          records: Sequence[bytes],
+                          payload: Optional[bytes] = None) -> bytes:
+    if payload is not None:
+        flags |= WAL_CATCHUP_PAYLOAD
+        if len(payload) == 0:
+            raise ValueError("empty catch-up payload")
+        if records:
+            raise ValueError("a reply carries records OR a catch-up "
+                             "payload, never both (the opaque tail is "
+                             "the payload's)")
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    out.append(flags & 0xFF)
+    wire._put_varint(out, max(0, int(shard_epoch)))
+    _put_str(out, shard_id)
+    _put_str(out, nonce)
+    wire._put_varint(out, max(0, int(min_seq)))
+    wire._put_varint(out, max(0, int(next_seq)))
+    wire._put_varint(out, max(0, int(first_seq)))
+    wire._put_varint(out, len(records))
+    for rec in records:
+        wire._put_varint(out, len(rec))
+        out.extend(rec)
+    return bytes(out) + (payload if payload is not None else b"")
+
+
+def decode_wal_sync_reply(body: bytes) -> WalSyncReply:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+        if pos >= len(body):
+            raise ProtocolError("truncated WAL_SYNC_REPLY body")
+        flags = body[pos]
+        pos += 1
+        shard_epoch, pos = wire._get_varint(body, pos)
+        shard_id, pos = _get_str(body, pos)
+        nonce, pos = _get_str(body, pos)
+        min_seq, pos = wire._get_varint(body, pos)
+        next_seq, pos = wire._get_varint(body, pos)
+        first_seq, pos = wire._get_varint(body, pos)
+        n, pos = wire._get_varint(body, pos)
+        if n > len(body) - pos:
+            # every record costs >= 1 length byte: checked BEFORE any
+            # allocation a hostile count could trigger
+            raise ProtocolError(f"record count {n} exceeds body")
+        records = []
+        for _ in range(n):
+            ln, pos = wire._get_varint(body, pos)
+            if pos + ln > len(body):
+                raise ProtocolError("truncated WAL_SYNC_REPLY record")
+            records.append(body[pos:pos + ln])
+            pos += ln
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    payload: Optional[bytes] = None
+    if flags & WAL_CATCHUP_PAYLOAD:
+        if pos >= len(body):
+            raise ProtocolError("empty WAL_SYNC_REPLY catch-up payload")
+        payload = body[pos:]
+    elif pos != len(body):
+        raise ProtocolError("trailing bytes after WAL_SYNC_REPLY")
+    return WalSyncReply(req_id, flags, shard_epoch, shard_id, nonce,
+                        min_seq, next_seq, first_seq, tuple(records),
+                        payload)
+
+
+def encode_shard_failover(req_id: int, epoch: int, sid: str,
+                          owner_id: str, addr: Tuple[str, int]) -> bytes:
+    """The promoted standby's keyspace claim at the router (module-
+    level MSG_SHARD_FAILOVER comment): adopt ``addr`` as shard
+    ``sid``'s downstream under shard epoch ``epoch``.  Also the
+    resurrection probe: a restarting member announces its OWN epoch
+    and address — an echo of the already-adjudicated state is
+    idempotent-ok, a stale epoch replies typed."""
+    if epoch < 1:
+        raise ValueError(f"a failover claim needs an epoch >= 1, "
+                         f"got {epoch}")
+    if not sid:
+        raise ValueError("empty shard id")
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    wire._put_varint(out, int(epoch))
+    _put_str(out, sid)
+    _put_str(out, owner_id)
+    _put_str(out, addr[0])
+    wire._put_varint(out, int(addr[1]))
+    return bytes(out)
+
+
+def decode_shard_failover(body: bytes
+                          ) -> Tuple[int, int, str, str,
+                                     Tuple[str, int]]:
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+        epoch, pos = wire._get_varint(body, pos)
+        if epoch < 1:
+            raise ProtocolError(f"shard-failover epoch {epoch} < 1")
+        sid, pos = _get_str(body, pos)
+        if not sid:
+            raise ProtocolError("empty shard id in SHARD_FAILOVER")
+        owner_id, pos = _get_str(body, pos)
+        host, pos = _get_str(body, pos)
+        port, pos = wire._get_varint(body, pos)
+        if port > 0xFFFF:
+            raise ProtocolError(f"port {port} out of range")
+    except ValueError as err:
+        raise ProtocolError(str(err)) from err
+    if pos != len(body):
+        raise ProtocolError("trailing bytes after SHARD_FAILOVER")
+    return req_id, epoch, sid, owner_id, (host, port)
+
+
+def encode_shard_failover_reply(req_id: int, record: dict) -> bytes:
+    """``record`` is the router's adjudication as JSON: the sid's
+    durable shard epoch after this claim, whether the downstream
+    address swapped, and the active address — the promoted standby's
+    confirmation and the soak's audit record share one shape."""
+    import json
+
+    out = bytearray()
+    wire._put_varint(out, req_id)
+    return bytes(out) + json.dumps(record).encode("utf-8")
+
+
+def decode_shard_failover_reply(body: bytes) -> Tuple[int, dict]:
+    import json
+
+    try:
+        req_id, pos = wire._get_varint(body, 0)
+        record = json.loads(body[pos:].decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as err:
+        raise ProtocolError(str(err)) from err
+    if not isinstance(record, dict):
+        raise ProtocolError(
+            "SHARD_FAILOVER_REPLY record is not a JSON object")
     return req_id, record
 
 

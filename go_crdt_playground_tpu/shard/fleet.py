@@ -37,6 +37,14 @@ Addr = Tuple[str, int]
 _ADDR_RE = re.compile(rb"listening on ([\d.]+):(\d+)")
 
 
+def format_addrs(a) -> str:
+    """One ``--shard`` flag value: ``H:P`` for a single (host, port)
+    pair, ``H:P,H:P`` for an ordered replication-group roster."""
+    if a and not isinstance(a[0], str):
+        return ",".join(f"{h}:{p}" for h, p in a)
+    return f"{a[0]}:{a[1]}"
+
+
 def free_port() -> int:
     s = socket.socket()
     s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -166,12 +174,16 @@ class FleetSpec:
 
 
 class ShardProc(_Proc):
-    """One ``serve --ingest`` shard frontend subprocess."""
+    """One ``serve --ingest`` shard frontend subprocess.
+    ``extra_args`` appends PER-SHARD flags after the fleet-wide
+    ``spec.extra_args`` (the replication soak passes ``--shard-id`` /
+    ``--shard-epoch`` / ``--announce-to``, which differ per shard)."""
 
     def __init__(self, repo: str, dirpath: str, spec: FleetSpec,
                  index: int, port: int,
                  crash_after_batches: Optional[int] = None,
-                 crash_on_slice: Optional[str] = None):
+                 crash_on_slice: Optional[str] = None,
+                 extra_args: Tuple[str, ...] = ()):
         self.index = index
         self.port = port
         self.dirpath = dirpath
@@ -191,7 +203,8 @@ class ShardProc(_Proc):
                 "--queue-depth", str(spec.queue_depth),
                 "--max-batch", str(spec.max_batch),
                 "--flush-ms", str(spec.flush_ms),
-                "--checkpoint-every", "0"] + list(spec.extra_args)
+                "--checkpoint-every", "0"] + list(spec.extra_args) \
+            + list(extra_args)
         super().__init__(argv, cwd=repo,
                          log_path=os.path.join(dirpath, "shard.log"),
                          env=env,
@@ -218,8 +231,7 @@ class RouterProc(_Proc):
                 "--seed", str(spec.seed),
                 "--transfer-timeout", str(transfer_timeout_s)]
         for sid in sorted(shard_addrs):
-            host, p = shard_addrs[sid]
-            argv += ["--shard", f"{sid}={host}:{p}"]
+            argv += ["--shard", f"{sid}={format_addrs(shard_addrs[sid])}"]
         if state_dir is not None:
             argv += ["--state-dir", state_dir]
         argv += list(extra_args)
@@ -229,6 +241,57 @@ class RouterProc(_Proc):
 
 _STANDBY_RE = re.compile(rb"Router standby engaged")
 _TAILING_RE = re.compile(rb"Router standby tailing primary ring")
+_SHARD_STANDBY_RE = re.compile(rb"Shard standby engaged")
+_SHARD_TAILING_RE = re.compile(rb"Shard standby tailing primary wal")
+
+
+class StandbyShardProc(_Proc):
+    """One ``serve --ingest --standby-of`` subprocess
+    (shard/replica.py as a process): tails the primary shard's WAL,
+    promotes on its death under a bumped fenced shard epoch, claims
+    the keyspace at the router, and only THEN prints the standard
+    ``listening on`` banner — so ``await_address`` doubles as the
+    promotion handshake, exactly the router-standby discipline."""
+
+    def __init__(self, repo: str, dirpath: str, spec: FleetSpec,
+                 index: int, port: int, primary: Addr, sid: str,
+                 announce_to: Optional[Addr] = None,
+                 standby_id: Optional[str] = None,
+                 poll_interval_s: float = 0.1,
+                 failure_threshold: int = 5):
+        self.index = index
+        self.port = port
+        self.dirpath = dirpath
+        os.makedirs(dirpath, exist_ok=True)
+        argv = [sys.executable, "-m", "go_crdt_playground_tpu", "serve",
+                "--ingest", "--port", str(port),
+                "--elements", str(spec.elements),
+                "--actors", str(spec.actors), "--actor", str(index),
+                "--durable-dir", os.path.join(dirpath, "state"),
+                "--queue-depth", str(spec.queue_depth),
+                "--max-batch", str(spec.max_batch),
+                "--flush-ms", str(spec.flush_ms),
+                "--checkpoint-every", "0",
+                "--standby-of", f"{primary[0]}:{primary[1]}",
+                "--shard-id", sid,
+                "--standby-id", standby_id or f"{sid}-standby",
+                "--ha-poll-interval", str(poll_interval_s),
+                "--ha-failure-threshold", str(failure_threshold)]
+        if announce_to is not None:
+            argv += ["--announce-to", f"{announce_to[0]}:{announce_to[1]}"]
+        argv += list(spec.extra_args)
+        super().__init__(argv, cwd=repo,
+                         log_path=os.path.join(dirpath, "standby.log"))
+
+    def await_engaged(self, timeout_s: float = 120.0) -> None:
+        self.await_match(_SHARD_STANDBY_RE, timeout_s)
+
+    def await_tailed(self, timeout_s: float = 60.0) -> None:
+        """Wait until the standby has tailed the primary at least once
+        — only a tailed standby promotes (the epoch-collision /
+        empty-replica guard), so a soak must not SIGKILL the primary
+        before this handshake."""
+        self.await_match(_SHARD_TAILING_RE, timeout_s)
 
 
 class StandbyRouterProc(_Proc):
@@ -258,8 +321,7 @@ class StandbyRouterProc(_Proc):
                 "--ha-failure-threshold", str(failure_threshold),
                 "--state-dir", state_dir]
         for sid in sorted(shard_addrs):
-            host, p = shard_addrs[sid]
-            argv += ["--shard", f"{sid}={host}:{p}"]
+            argv += ["--shard", f"{sid}={format_addrs(shard_addrs[sid])}"]
         super().__init__(argv, cwd=repo,
                          log_path=os.path.join(dirpath, "standby.log"))
 

@@ -85,9 +85,15 @@ class RouterStandby:
 
         if failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
+        from go_crdt_playground_tpu.serve.client import normalize_addrs
+
         self.primary = (primary[0], int(primary[1]))
-        self.shards = {sid: (a[0], int(a[1]))
-                       for sid, a in shards.items()}
+        # values may be single pairs or ordered replication-group
+        # rosters (DESIGN.md §23); keep whatever shape arrives — the
+        # promoted ShardRouter normalizes either
+        norm = {sid: normalize_addrs(a) for sid, a in shards.items()}
+        self.shards = {sid: (addrs[0] if len(addrs) == 1 else addrs)
+                       for sid, addrs in norm.items()}
         self.num_elements = int(num_elements)
         self.seed = int(seed)
         self.state_dir = state_dir

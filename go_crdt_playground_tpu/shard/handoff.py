@@ -94,6 +94,12 @@ EPOCH_FILE = "epoch.json"  # the last epoch's phase breadcrumb (post-mortems
 # CHANGES under one router; router epochs count which ROUTER may
 # drive them.
 ROUTER_EPOCH_FILE = "router_epoch.json"
+# per-shard SHARD epochs the ROUTER has adjudicated (DESIGN.md §23):
+# which replication-group member may serve each sid's keyspace.
+# Monotone per sid; persisted fsync-then-rename BEFORE a failover swap
+# acts, so a router restart can never hand a keyspace back to a
+# deposed member.
+SHARD_EPOCHS_FILE = "shard_epochs.json"
 
 
 class HandoffError(RuntimeError):
@@ -196,6 +202,35 @@ def load_router_epoch(state_dir: Optional[str]) -> int:
         return max(0, int(rec.get("router_epoch", 0)))
     except (TypeError, ValueError):
         return 0
+
+
+def load_shard_epochs(state_dir: Optional[str]) -> Dict[str, int]:
+    """The router's adjudicated per-sid shard epochs (empty when
+    absent/unreadable — every sid at its pre-HA epoch 0)."""
+    if state_dir is None:
+        return {}
+    rec = _load_json(os.path.join(state_dir, SHARD_EPOCHS_FILE))
+    if not isinstance(rec, dict):
+        return {}
+    out: Dict[str, int] = {}
+    for sid, e in rec.get("epochs", {}).items():
+        try:
+            out[str(sid)] = max(0, int(e))
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def persist_shard_epochs(state_dir: Optional[str],
+                         epochs: Dict[str, int]) -> None:
+    """Durably record the router's per-sid shard-epoch adjudications —
+    fsync'd BEFORE the failover swap acts on them."""
+    if state_dir is None:
+        return
+    os.makedirs(state_dir, exist_ok=True)
+    write_json_atomic(state_dir, SHARD_EPOCHS_FILE,
+                      {"epochs": {sid: int(e)
+                                  for sid, e in epochs.items()}})
 
 
 def persist_router_epoch(state_dir: Optional[str], epoch: int,
@@ -351,7 +386,7 @@ class HandoffCoordinator:
             committed_shards = {
                 s: (staged_link.addr
                     if staged_link is not None and s == sid
-                    else router.shard_addr(s))
+                    else router.shard_roster(s))
                 for s in ring_after.shards}
             fence_s = time.monotonic() - t_fence
             detail = {

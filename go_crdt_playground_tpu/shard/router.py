@@ -68,15 +68,19 @@ import numpy as np
 from go_crdt_playground_tpu.net import framing
 from go_crdt_playground_tpu.net.antientropy import CircuitBreaker
 from go_crdt_playground_tpu.serve import protocol
-from go_crdt_playground_tpu.serve.client import ServeClient
+from go_crdt_playground_tpu.serve.client import ServeClient, normalize_addrs
 from go_crdt_playground_tpu.serve.host import ConnHost
 from go_crdt_playground_tpu.serve.session import Session
 from go_crdt_playground_tpu.shard.handoff import (PHASE_COMMITTED,
+                                                  RING_FILE,
                                                   HandoffCoordinator,
                                                   HandoffError, RouteState,
                                                   load_ring_file,
                                                   load_router_epoch,
-                                                  persist_router_epoch)
+                                                  load_shard_epochs,
+                                                  persist_router_epoch,
+                                                  persist_shard_epochs,
+                                                  write_json_atomic)
 from go_crdt_playground_tpu.shard.ring import HashRing, load_stats
 from go_crdt_playground_tpu.utils.backoff import Backoff, BackoffPolicy
 
@@ -198,14 +202,23 @@ class _ShardLink:
     ADMIN_CALLS = frozenset(
         {"slice_pull", "slice_push", "gc", "frontier"})
 
-    def __init__(self, sid: str, addr: Addr, *, timeout_s: float,
+    def __init__(self, sid: str, addr, *, timeout_s: float,
                  breaker_threshold: int, breaker_cooldown_s: float,
                  policy: BackoffPolicy, seed: int, on_reply,
                  max_reply_body: Optional[int] = None,
                  router_epoch: int = 0, router_id: str = "",
                  on_deposed=None) -> None:
         self.sid = sid
-        self.addr = (addr[0], int(addr[1]))
+        # ORDERED address list (DESIGN.md §23): the keyspace's active
+        # member first, then its replication-group standbys.  Every
+        # dial starts at the active member; the multi-address
+        # ServeClient rotates on dial failure, so the keyspace comes
+        # back through the promoted standby even before its
+        # SHARD_FAILOVER announce lands (the announce then reorders
+        # the roster durably).  race-ok: read-only after construction
+        # (a failover swap builds a NEW link)
+        self.addrs = normalize_addrs(addr)
+        self.addr = self.addrs[0]
         self.timeout_s = timeout_s
         # the owning router's leadership epoch/id (0 = fence dormant,
         # pre-HA behavior).  race-ok: read-only after construction
@@ -274,7 +287,7 @@ class _ShardLink:
         gen = self._gen + 1
         try:
             client = ServeClient(
-                self.addr, timeout=self.timeout_s,
+                self.addrs, timeout=self.timeout_s,
                 connect_timeout=self.DIAL_TIMEOUT_S,
                 max_reply_body=self.max_reply_body,
                 on_result=lambda op: self._downstream_result(gen, op))
@@ -453,7 +466,7 @@ class _ShardLink:
         ``ConnectionError`` that may CONTAIN the same "unexpected
         frame type" text and must stay transient/re-probeable."""
         try:
-            probe = ServeClient(self.addr, timeout=self.timeout_s,
+            probe = ServeClient(self.addrs, timeout=self.timeout_s,
                                 connect_timeout=self.DIAL_TIMEOUT_S,
                                 max_reply_body=self.max_reply_body)
         except (OSError, ConnectionError) as e:
@@ -578,7 +591,10 @@ class ShardRouter:
             max_retries=4)
         self._seed = seed
 
-        shard_map = {sid: (a[0], int(a[1])) for sid, a in shards.items()}
+        # values may be single (host, port) pairs or ORDERED address
+        # lists (active member first, then replication-group standbys
+        # — DESIGN.md §23); one normalization covers both shapes
+        shard_map = {sid: normalize_addrs(a) for sid, a in shards.items()}
         generation = 0
         if state_dir is not None:
             rec = load_ring_file(state_dir)
@@ -592,8 +608,10 @@ class ShardRouter:
                         "delete ring.json to reset membership from flags")
                 # the committed membership wins over the CLI flags: the
                 # flags describe the fleet at FIRST launch, the record
-                # describes it after every reshard since
-                shard_map = {s: (a[0], int(a[1]))
+                # describes it after every reshard AND every keyspace
+                # failover since (the persisted order is active-first,
+                # so a restart redials the promoted member)
+                shard_map = {s: normalize_addrs(a)
                              for s, a in rec["shards"].items()}
                 generation = int(rec.get("generation", 0))
                 self._count("router.ring.restored")
@@ -619,6 +637,17 @@ class ShardRouter:
         # when the owner (the promotion path) already fanned it out
         # race-ok: single-writer latch, worst case one redundant probe
         self._announced_fleet = False
+        # per-sid SHARD epochs (DESIGN.md §23): which replication-group
+        # member the router has adjudicated as each keyspace's active
+        # serving member.  Persisted fsync-then-rename BEFORE a
+        # failover swap acts; a restart can never hand a keyspace back
+        # to a deposed member.
+        self._shard_epochs: Dict[str, int] = load_shard_epochs(
+            state_dir)  # guarded-by: _lock
+        # serializes whole failover adjudications (persist -> swap):
+        # two racing claims for one sid must order their durable
+        # records.  The order is _failover_lock -> _lock
+        self._failover_lock = threading.Lock()
         with self._lock:
             for sid in ring.shards:
                 self._links[sid] = self._new_link(sid, shard_map[sid])
@@ -731,6 +760,17 @@ class ShardRouter:
         if link is None:
             raise KeyError(sid)
         return link.addr
+
+    def shard_roster(self, sid: str):
+        """The sid's ordered address roster in the ring.json value
+        shape: a legacy (host, port) pair when single, a list of
+        pairs when the replication group has standbys — so a handoff
+        commit's persisted record never silently drops a roster."""
+        link = self.link(sid)
+        if link is None:
+            raise KeyError(sid)
+        return (link.addrs[0] if len(link.addrs) == 1
+                else [list(a) for a in link.addrs])
 
     def set_fence(self, fence: np.ndarray) -> None:
         with self._lock:
@@ -890,6 +930,8 @@ class ShardRouter:
             return self._handle_reshard(session, body)
         if msg_type == protocol.MSG_RING_SYNC:
             return self._handle_ring_sync(session, body)
+        if msg_type == protocol.MSG_SHARD_FAILOVER:
+            return self._handle_shard_failover(session, body)
         # The router DRIVES the verbs below against shard frontends; it
         # never serves them itself (W001 dispatcher-scoped exclusions):
         # protocol-ignore: MSG_SLICE_PULL — handoff donor read, driven
@@ -897,6 +939,8 @@ class ShardRouter:
         # protocol-ignore: MSG_FRONTIER — GC evidence read, driven
         # protocol-ignore: MSG_GC — fleet-frontier push, driven
         # protocol-ignore: MSG_DSUM — member-cache freshness read, driven
+        # protocol-ignore: MSG_WAL_SYNC — shard-side replication tail
+        # verb; standbys dial their primary SHARD, never the router
         session.send(framing.MSG_ERROR,
                      f"unexpected frame type {msg_type}".encode())
         return False
@@ -933,7 +977,11 @@ class ShardRouter:
             "seed": rt.ring.seed,
             "elements": self.num_elements,
             "epoch": self.handoff.epoch,
-            "shards": {sid: list(link.addr)
+            # active member first; multi-member rosters ship as lists
+            # of pairs (normalize_addrs reads both shapes back)
+            "shards": {sid: (list(link.addrs[0])
+                             if len(link.addrs) == 1
+                             else [list(a) for a in link.addrs])
                        for sid, link in links.items()
                        if sid in rt.ring.shards},
         }
@@ -1013,6 +1061,148 @@ class ShardRouter:
             else:
                 out[sid] = str(r)
         return out
+
+    # -- shard replication: keyspace failover (DESIGN.md §23) ---------------
+
+    def shard_epochs(self) -> Dict[str, int]:
+        """The adjudicated per-sid shard epochs (STATS + tests)."""
+        with self._lock:
+            return dict(self._shard_epochs)
+
+    def _handle_shard_failover(self, session: Session,
+                               body: bytes) -> bool:
+        """Adjudicate one keyspace-failover claim (or a restarting
+        member's idempotent announce probe).  A deposed ROUTER refuses
+        typed — its adjudications would desync from the promoted
+        router's; the claimant's ordered router list retries there."""
+        try:
+            req_id, epoch, sid, owner_id, addr = \
+                protocol.decode_shard_failover(body)
+        except framing.ProtocolError as e:
+            session.send(framing.MSG_ERROR, str(e).encode())
+            return False
+        if self.host.draining:
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_DRAINING, "router draining"))
+            return True
+        if self.deposed:
+            self._count("router.shard_failover.deposed")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_STALE_EPOCH,
+                "router deposed (stale router epoch) — claim the "
+                "keyspace at the promoted router"))
+            return True
+        try:
+            record = self.failover_shard(sid, epoch, addr,
+                                         owner=owner_id)
+        except KeyError:
+            self._count("router.rejects.invalid")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_INVALID,
+                f"unknown shard id {sid!r}"))
+            return True
+        except protocol.StaleShardEpoch as e:
+            self._count("router.rejects.stale_shard_epoch")
+            session.send(protocol.MSG_REJECT, protocol.encode_reject(
+                req_id, protocol.REJECT_STALE_SHARD_EPOCH, str(e)))
+            return True
+        session.send(protocol.MSG_SHARD_FAILOVER_REPLY,
+                     protocol.encode_shard_failover_reply(req_id, record))
+        return True
+
+    def failover_shard(self, sid: str, epoch: int, addr: Addr, *,
+                       owner: str = "?") -> Dict[str, object]:
+        """Adopt ``addr`` as shard ``sid``'s active downstream member
+        under shard epoch ``epoch`` (module-level story: the promoted
+        standby's claim).  Durable-before-act: the adjudicated epoch
+        map persists first, then the link swaps (new ordered roster,
+        claimed member first), then the active-first address order
+        persists into the committed ring record so a router restart
+        redials the promoted member.  Raises typed
+        ``StaleShardEpoch`` for a claim at or below the adjudicated
+        epoch from a different address (the resurrected old primary),
+        ``KeyError`` for an unknown sid.  An echo of the adjudicated
+        state is idempotent-ok (``swapped: False``)."""
+        addr = (addr[0], int(addr[1]))
+        with self._failover_lock:
+            with self._lock:
+                link = self._links.get(sid)
+                if link is None:
+                    raise KeyError(sid)
+                cur = self._shard_epochs.get(sid, 0)
+                active = link.addrs[0]
+                roster = list(link.addrs)
+            if epoch < cur or (epoch == cur and addr != active):
+                raise protocol.StaleShardEpoch(
+                    f"shard epoch {epoch} for {sid} is stale: epoch "
+                    f"{cur} already adjudicated at "
+                    f"{active[0]}:{active[1]} (a standby was promoted "
+                    "past this member)")
+            if epoch == cur and addr == active:
+                # the active member's idempotent announce probe
+                return {"sid": sid, "shard_epoch": cur,
+                        "swapped": False, "addr": list(active)}
+            # 1. durable adjudication BEFORE the swap: a crash between
+            # the two leaves the fence armed and the swap re-claimable
+            # (the standby's announce is idempotent)
+            with self._lock:
+                epochs = dict(self._shard_epochs)
+            epochs[sid] = epoch
+            persist_shard_epochs(self._state_dir, epochs)
+            # 2. the swap: a NEW link whose roster leads with the
+            # claimed member (the old roster rides behind it so a
+            # later failover can rotate back)
+            new_roster = [addr] + [a for a in roster if a != addr]
+            retired = None
+            with self._lock:
+                if self._closed.is_set():
+                    raise HandoffError("router closed during failover")
+                self._shard_epochs[sid] = epoch
+                new_link = self._new_link(sid, new_roster)
+                retired = self._links.get(sid)
+                self._links[sid] = new_link
+            # the swapped member may be a different binary/replica:
+            # its cached member set and DSUM classification must not
+            # survive the swap (the drop_sid eviction discipline)
+            with self._member_cache_lock:
+                self._member_cache.pop(sid, None)
+                self._dsum_unsupported.discard(sid)
+                self._dsum_supported.discard(sid)
+                self._member_cache_epoch += 1
+            if retired is not None:
+                retired.close()
+            # 3. persist the active-first order for restarts
+            self._persist_addr_roster()
+            self._count("router.shard_failovers")
+            return {"sid": sid, "shard_epoch": epoch, "swapped": True,
+                    "addr": list(addr), "owner": owner}
+
+    def _persist_addr_roster(self) -> None:
+        """Write the committed ring record with the CURRENT active-
+        first address rosters (the failover half of ring persistence —
+        membership and generation unchanged).  Single-addr rosters
+        persist in the legacy pair shape, so pre-HA records stay
+        byte-compatible."""
+        if self._state_dir is None:
+            return
+        rt = self.route()
+        links = self.links_snapshot()
+        shards = {}
+        for sid in rt.ring.shards:
+            link = links.get(sid)
+            if link is None:
+                continue
+            shards[sid] = (list(link.addrs[0]) if len(link.addrs) == 1
+                           else [list(a) for a in link.addrs])
+        write_json_atomic(self._state_dir, RING_FILE, {
+            "epoch": self.handoff.epoch,
+            "phase": PHASE_COMMITTED,
+            "shards": shards,
+            "seed": rt.ring.seed,
+            "elements": self.num_elements,
+            "generation": rt.generation,
+            "digest": rt.digest,
+        })
 
     # -- OP forwarding ------------------------------------------------------
 
@@ -1327,9 +1517,19 @@ class ShardRouter:
         # these (DESIGN.md §22)
         with self._lock:
             seen = self._max_epoch_seen
+            shard_epochs = dict(self._shard_epochs)
         ring_info["router_epoch"] = self.router_epoch
         ring_info["router_id"] = self.router_id
         ring_info["max_epoch_seen"] = seen
+        # the shard-replication observability half (DESIGN.md §23):
+        # which member serves each keyspace (active-first rosters) and
+        # under which adjudicated shard epoch — the failover soak and
+        # the autopilot's decision records read these
+        ring_info["shard_epochs"] = shard_epochs
+        ring_info["shard_addrs"] = {
+            sid: [list(a) for a in link.addrs]
+            for sid, link in self.links_snapshot().items()
+            if sid in rt.ring.shards}
         session.send(protocol.MSG_STATS_REPLY, protocol.encode_stats_reply(
             req_id, {"counters": counters,
                      "observations": {},

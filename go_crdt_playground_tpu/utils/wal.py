@@ -58,6 +58,22 @@ _CRC_LEN = 4
 _MAX_RECORD = 1 << 30
 
 
+class WalTruncated(Exception):
+    """A ``stream_from`` cursor points below the oldest RETAINED record:
+    a checkpoint truncated (or ``drop_segments`` retired) the records
+    the reader still wanted.  Typed, never a silent gap — the tailing
+    standby must catch up out of band (digest sync against the live
+    state, shard/replica.py) and resume from ``next_seq``."""
+
+    def __init__(self, wanted: int, min_seq: int, next_seq: int):
+        super().__init__(
+            f"WAL records below seq {min_seq} are truncated "
+            f"(wanted {wanted}; next append is {next_seq})")
+        self.wanted = wanted
+        self.min_seq = min_seq
+        self.next_seq = next_seq
+
+
 def encode_record(body: bytes) -> bytes:
     """One framed WAL record for ``body`` (see module docstring)."""
     if len(body) > _MAX_RECORD:
@@ -128,12 +144,34 @@ class DeltaWal:
         os.makedirs(self.path, exist_ok=True)
         # race-ok: written only by construction-time repair, then frozen
         self.torn_tail_repaired = False
+        # per-segment record counts, filled by the ONE construction
+        # scan _repair already does (the seq numbering below reuses it
+        # instead of re-reading every retained segment); deleted once
+        # consumed — only construction needs it
+        self._seg_counts: dict = {}
         segs = self._segments()
         if segs:
             self._repair(segs)
             segs = self._segments()
         self._seq = segs[-1] if segs else self._next_seq()  # guarded-by: _lock
+        # record sequence numbering (the replication cursor,
+        # shard/replica.py): every COMMITTED record gets a seq that is
+        # monotone within this DeltaWal instance's lifetime — across
+        # rotation, seal and truncate (a truncate advances the minimum
+        # retained seq, it never reuses one).  _seg_first maps segment
+        # -> the seq of its first record, so stream_from can skip whole
+        # segments without scanning them.  Numbering restarts at 1 per
+        # instance (a primary restart resets its standbys' cursors via
+        # the WAL_SYNC instance nonce, serve/frontend.py).
+        self._seg_first: dict = {}  # guarded-by: _lock
+        self._next_rec = 1  # guarded-by: _lock
+        for seg in segs:
+            self._seg_first[seg] = self._next_rec
+            self._next_rec += self._seg_counts[seg]
+        del self._seg_counts
         self._open_segment(self._seq, fresh=not segs)
+        if not segs:
+            self._seg_first[self._seq] = self._next_rec
 
     # -- segment bookkeeping -----------------------------------------------
 
@@ -170,12 +208,16 @@ class DeltaWal:
     def _repair(self, segs: List[int]) -> None:
         """Truncate the first torn segment to its valid prefix and drop
         every segment after it — the prefix property made physical, so
-        later appends can never land beyond a tear."""
+        later appends can never land beyond a tear.  Also records each
+        surviving segment's record count (``_seg_counts``): this scan
+        reads every retained byte anyway, and the record-seq numbering
+        built right after construction would otherwise re-read it all."""
         for i, seq in enumerate(segs):
             p = self._seg_path(seq)
             with open(p, "rb") as f:
                 data = f.read()
-            _, valid_end, torn = scan_records(data)
+            bodies, valid_end, torn = scan_records(data)
+            self._seg_counts[seq] = len(bodies)
             if not torn:
                 continue
             self.torn_tail_repaired = True
@@ -189,6 +231,7 @@ class DeltaWal:
                     os.unlink(self._seg_path(later))
                 except OSError:
                     pass
+                self._seg_counts.pop(later, None)
             _fsync_dir(self.path)
             return
 
@@ -227,6 +270,10 @@ class DeltaWal:
                     self._dirty = True
                     raise
                 self._file_size += len(rec)
+                # committed (fsync returned): the record owns its seq —
+                # a FAILED append never consumes one (the partial bytes
+                # are healed away, so numbering matches the scan)
+                self._next_rec += 1
         except OSError:
             self._count("wal.append_errors")
             raise
@@ -285,6 +332,7 @@ class DeltaWal:
             # trust
             self._file_size = 0
             self._open_segment(self._seq, fresh=True)
+            self._seg_first[self._seq] = self._next_rec
         except OSError:
             # armed HERE, not only in append's wrapper: seal() rotates
             # too, and a failure must leave the log retryable-degraded
@@ -319,6 +367,10 @@ class DeltaWal:
             # OSError classification forever
             self._dirty = True
             self._open_segment(self._seq, fresh=True)
+            # every retained record is gone: the minimum available
+            # seq jumps to the next append's — a replication cursor
+            # below it surfaces typed WalTruncated, never a silent gap
+            self._seg_first = {self._seq: self._next_rec}
             self._post_open_tears.clear()
             self._dirty = False  # every poisoned byte was just unlinked
             _fsync_dir(self.path)
@@ -350,6 +402,7 @@ class DeltaWal:
                     os.unlink(self._seg_path(seq))
                 except OSError:
                     pass
+                self._seg_first.pop(seq, None)
             _fsync_dir(self.path)
         self._count("wal.truncations")
 
@@ -376,6 +429,86 @@ class DeltaWal:
 
     def record_count(self) -> int:
         return sum(1 for _ in self.records())
+
+    # -- replication tail (seq-addressed reads, shard/replica.py) ------------
+
+    def next_seq(self) -> int:
+        """The seq the NEXT committed append will get (== 1 + the last
+        committed record's seq).  A fully-caught-up tail cursor equals
+        this."""
+        with self._lock:
+            return self._next_rec
+
+    def min_seq(self) -> int:
+        """The seq of the oldest RETAINED record (== ``next_seq`` when
+        the log is empty).  A cursor below this is typed-truncated."""
+        with self._lock:
+            return self._min_seq_locked()
+
+    # requires-lock: _lock
+    def _min_seq_locked(self) -> int:
+        segs = sorted(self._seg_first)
+        return self._seg_first[segs[0]] if segs else self._next_rec
+
+    def stream_from(self, from_seq: int):
+        """Tail-follow read: yield ``(seq, body)`` for every COMMITTED
+        record with ``seq >= from_seq``, oldest first, across segment
+        rotation, then stop at the tail — the caller re-invokes with
+        its advanced cursor to follow new appends (the WAL_SYNC serve
+        verb's poll shape).  Stops silently at an unparsable record: a
+        torn tail (to be healed by the next append) and a concurrent
+        in-flight append look identical from here, and both resolve
+        the same way — the next call resumes past the heal.  Never
+        yields a record committed after the call started (a record's
+        fsync may not have returned yet — shipping it would let a
+        standby hold state the primary's restart path provably loses).
+
+        Raises typed ``WalTruncated`` when ``from_seq`` predates the
+        oldest retained record (a checkpoint truncated the log under
+        the cursor): the reader must catch up out of band, never
+        silently skip the gap."""
+        if from_seq < 1:
+            raise ValueError(f"stream_from wants a seq >= 1, "
+                             f"got {from_seq}")
+        with self._lock:
+            segs = sorted(self._seg_first)
+            first = dict(self._seg_first)
+            limit = self._next_rec
+            min_avail = self._min_seq_locked()
+        if from_seq < min_avail:
+            raise WalTruncated(from_seq, min_avail, limit)
+        if from_seq >= limit:
+            # caught up: nothing committed past the cursor — return
+            # empty WITHOUT touching the disk (the WAL_SYNC long-poll
+            # spins on this path many times per idle poll)
+            return iter(())
+
+        def _iter():
+            for i, seg in enumerate(segs):
+                start = first[seg]
+                if start >= limit:
+                    return
+                nxt = first[segs[i + 1]] if i + 1 < len(segs) else None
+                if nxt is not None and nxt <= from_seq:
+                    continue  # wholly below the cursor: skip the scan
+                try:
+                    with open(self._seg_path(seg), "rb") as f:
+                        data = f.read()
+                except FileNotFoundError:
+                    # truncated under us after the snapshot: the NEXT
+                    # call adjudicates the cursor against the new
+                    # minimum (typed there, silence here would yield a
+                    # gap only if we kept going — so stop)
+                    return
+                bodies, _, _ = scan_records(data)
+                for j, body in enumerate(bodies):
+                    seq = start + j
+                    if seq >= limit:
+                        return
+                    if seq >= from_seq:
+                        yield seq, body
+
+        return _iter()
 
     def close(self) -> None:
         with self._lock:

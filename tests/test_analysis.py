@@ -801,6 +801,13 @@ def test_gate_fast(tmp_path):
     # tail loop, promotion path, and observer readers cross threads on
     # the standby lock and must be inside the sweep
     assert "RouterStandby" in covered, covered
+    # ... and the shard replication tier (the shard-replication ISSUE):
+    # the publisher's condition crosses WAL_SYNC readers with the
+    # batcher's ack gate, the shard standby's tail loop crosses
+    # promote()/observers, and both serving ladders poll the shared
+    # degrade-window latch cross-thread
+    assert {"ReplicationPublisher", "ShardStandby",
+            "DegradeWindow"} <= covered, covered
     # the wire-contract suite (the protocol-contract ISSUE): W001-W004
     # + M001 must have swept the dialect modules, every registered
     # dispatcher, the full codec registry, and the metric-name surface
@@ -817,9 +824,11 @@ def test_gate_fast(tmp_path):
         assert d["required"], d  # no dispatcher checked an empty set
     assert pc["recv_frame_sites"] >= 9, pc
     assert pc["reject_sites"] >= 16, pc
-    assert pc["codes"] >= 6, pc
+    assert pc["codes"] >= 9, pc  # REJECT_STALE_SHARD_EPOCH included
     cs = report["passes"]["codec_symmetry"]["stats"]
-    assert cs["codecs"] >= 24 and cs["codec_functions"] >= 40, cs
+    # the WAL_SYNC / SHARD_FAILOVER codec pairs (shard replication)
+    # are registered alongside everything prior
+    assert cs["codecs"] >= 28 and cs["codec_functions"] >= 48, cs
     mc = report["passes"]["metrics_contract"]["stats"]
     assert mc["emitted"] >= 60 and mc["referenced"] >= 20, mc
     # model-merging joins ride the lattice pass with their declared

@@ -1,0 +1,89 @@
+"""utils/degrade.DegradeWindow — the shared probe-window latch both
+serving ladders ride (storage degradation in serve/batcher.py,
+replication degradation in shard/replica.py).  Direct unit tests:
+arm / probe-success clears / probe-failure re-arms / concurrent arm."""
+
+import threading
+
+import pytest
+
+from go_crdt_playground_tpu.utils.degrade import DegradeWindow
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        DegradeWindow(0.0)
+    with pytest.raises(ValueError):
+        DegradeWindow(-1.0)
+
+
+def test_arm_activates_then_expires():
+    clk = FakeClock()
+    w = DegradeWindow(1.0, clk)
+    assert not w.active() and not w.armed_ever() and w.windows == 0
+    assert w.arm() is True          # a fresh episode
+    assert w.active() and w.armed_ever() and w.windows == 1
+    clk.t += 0.5
+    assert w.active()
+    clk.t += 0.6                    # past the deadline: probe time
+    assert not w.active()           # degraded behavior stops holding
+    assert w.armed_ever()           # ...but the probe dispatcher still
+    #                                 knows a probe is owed
+
+
+def test_probe_success_clears():
+    clk = FakeClock()
+    w = DegradeWindow(1.0, clk)
+    w.arm()
+    clk.t += 2.0
+    assert not w.active() and w.armed_ever()
+    w.clear()                       # the probe succeeded
+    assert not w.active() and not w.armed_ever()
+    # a later failure is a NEW episode
+    assert w.arm() is True
+    assert w.windows == 2
+
+
+def test_probe_failure_rearms_one_episode():
+    clk = FakeClock()
+    w = DegradeWindow(1.0, clk)
+    assert w.arm() is True
+    clk.t += 1.5                    # window lapsed; probe runs...
+    assert w.arm() is False         # ...and fails: same episode extends
+    assert w.windows == 1           # degraded EPISODES, not failures
+    assert w.active()
+    # arming while still active also extends without counting
+    clk.t += 0.2
+    assert w.arm() is False
+    assert w.windows == 1
+
+
+def test_concurrent_arm_counts_sanely():
+    """Many threads arming at once (the batcher loop vs a re-raising
+    teardown path): the latch must end ACTIVE with a sane episode
+    count — at least one, never more than the racers."""
+    w = DegradeWindow(5.0)
+    n = 8
+    barrier = threading.Barrier(n)
+
+    def racer():
+        barrier.wait()
+        w.arm()
+
+    threads = [threading.Thread(target=racer) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert w.active()
+    assert 1 <= w.windows <= n
+    w.clear()
+    assert not w.active() and not w.armed_ever()

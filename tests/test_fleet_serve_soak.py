@@ -192,6 +192,56 @@ def test_fleet_serve_soak_router_ha_quick_mode(tmp_path):
 
 
 @pytest.mark.slow
+def test_fleet_serve_soak_shard_repl_quick_mode(tmp_path):
+    """The shard-replication soak (--shard-repl --quick, DESIGN.md
+    §23): WAL-shipped warm shard standbys — the replication link
+    survives deterministic chaos with typed degrade-to-async and
+    digest catch-up on heal; a mid-stream primary SIGKILL with NO
+    restart promotes the standby inside the declared budget and the
+    router swaps the keyspace under a bumped fenced shard epoch; a
+    quiesced kill proves the promoted replica byte-identical to the
+    restore_durable restart path; and a resurrected old primary boots
+    self-fenced.  Zero acked-op loss, zero phantoms, unresolved 0."""
+    import fleet_serve_soak
+
+    out = str(tmp_path / "REPL_CURVE.json")
+    rc = fleet_serve_soak.main(["--shard-repl", "--quick",
+                                "--out", out])
+    assert rc == 0, "shard-replication soak failed (late promotion, " \
+                    "bitwise drift vs the restart path, fence " \
+                    "breach, unresolved ops, or acked-op loss)"
+    with open(out) as f:
+        artifact = json.load(f)
+
+    ch = artifact["legs"]["chaos"]
+    assert ch["proxy"]["truncated"] > 0 and ch["proxy"]["refused"] > 0
+    assert ch["degraded_windows"] >= 1, ch
+    assert ch["acked_s0_during_partition"] >= \
+        ch["goodput_floor_ops_s"] * ch["partition_s"], ch
+    assert ch["lag_records_after_heal"] == 0, ch
+    assert ch["catchups_served"] >= 1, ch
+
+    fo = artifact["legs"]["failover"]
+    assert fo["promote_s"] <= fo["promote_budget_s"], fo
+    assert fo["shard_epochs"]["s0"] == 2, fo
+    assert fo["acked_s0_after_promotion"] >= 10, fo
+
+    bw = artifact["legs"]["bitwise"]
+    assert bw["slices_bitwise_equal"], bw
+    assert bw["promote_s"] <= bw["promote_budget_s"], bw
+    assert bw["shard_epochs"]["s1"] == 2, bw
+
+    rz = artifact["legs"]["resurrection"]
+    assert rz["write_shed_typed"] and rz["shed_counted"] >= 1, rz
+    assert rz["router_shard_epochs"]["s0"] == 2, rz
+
+    assert artifact["traffic"]["unresolved"] == 0, artifact["traffic"]
+    assert artifact["finished"] and artifact["unfinished"] == []
+    assert artifact["lost_acked_ops"] == []
+    assert artifact["phantom_members"] == []
+
+
+@pytest.mark.slow
 def test_fleet_serve_soak_autopilot_quick_mode(tmp_path):
     """The fleet-autopilot soak (--autopilot --quick, DESIGN.md §21):
     a REAL ``autopilot`` CLI subprocess watching a real fleet must

@@ -510,9 +510,10 @@ def test_real_dispatchers_are_exhaustive():
     assert set(stats["dispatchers"]) == {"frontend", "router", "peer",
                                          "serve-client"}
     # the router's driven-verb exclusions are on record, not silent
+    # (MSG_WAL_SYNC: standbys tail their primary SHARD, not the router)
     assert stats["dispatchers"]["router"]["ignored"] == [
         "MSG_DSUM", "MSG_FRONTIER", "MSG_GC", "MSG_SLICE_PULL",
-        "MSG_SLICE_PUSH"]
+        "MSG_SLICE_PUSH", "MSG_WAL_SYNC"]
     # every reply frame the servers ignore is armed in the client
     client = stats["dispatchers"]["serve-client"]
     assert set(client["required"]) <= set(client["handled"])

@@ -1,0 +1,473 @@
+"""Shard replication groups (shard/replica.py, DESIGN.md §23):
+WAL-shipped warm standbys, semi-synchronous group commit, fenced shard
+epochs, keyspace failover at the router, deposed-member containment.
+
+In-process, wall-clock-light: the state machines expose their seams
+(``poll_once``, ``ReplicationPublisher.gate``, ``failover_shard``) so
+the suite drives them directly; the real-subprocess acceptance rides
+``tools/fleet_serve_soak.py --shard-repl`` (REPL_CURVE.json).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from go_crdt_playground_tpu.serve import protocol
+from go_crdt_playground_tpu.serve.client import ServeClient
+from go_crdt_playground_tpu.serve.frontend import ServeFrontend
+from go_crdt_playground_tpu.shard.replica import (POLL_CAUGHT_UP,
+                                                  POLL_FAILED,
+                                                  POLL_PROMOTED,
+                                                  POLL_TAILED,
+                                                  ReplicationPublisher,
+                                                  ShardStandby,
+                                                  load_shard_epoch,
+                                                  load_shard_epoch_seen,
+                                                  persist_shard_epoch)
+
+E, A = 48, 4
+
+
+def _frontend(dirpath, *, actor=0, sid="s0", epoch=0, announce=None):
+    return ServeFrontend(E, A, actor=actor, durable_dir=str(dirpath),
+                         max_batch=4, flush_ms=1.0, shard_id=sid,
+                         shard_epoch=epoch, announce_to=announce,
+                         repl_ack_timeout_ms=150.0)
+
+
+def _full_slice(node) -> bytes:
+    return node.extract_slice(np.ones(E, bool))
+
+
+# -- shard-epoch persistence -------------------------------------------------
+
+
+def test_shard_epoch_file_roundtrip(tmp_path):
+    d = str(tmp_path)
+    assert load_shard_epoch(d) == 0 and load_shard_epoch_seen(d) == 0
+    assert load_shard_epoch(None) == 0
+    persist_shard_epoch(d, 3, "s0-standby")
+    assert load_shard_epoch(d) == 3
+    assert load_shard_epoch_seen(d) == 3  # seen >= own always
+    persist_shard_epoch(d, 3, "s0", seen=7)
+    assert load_shard_epoch(d) == 3 and load_shard_epoch_seen(d) == 7
+    # unreadable record reads as the pre-HA configuration
+    with open(os.path.join(d, "shard_epoch.json"), "w") as f:
+        f.write("not json")
+    assert load_shard_epoch(d) == 0
+
+
+# -- ReplicationPublisher: the semi-sync gate (no sockets, no jax) -----------
+
+
+class _Wal:
+    def __init__(self, n=1):
+        self.n = n
+
+    def next_seq(self):
+        return self.n
+
+
+def test_publisher_gate_no_standby_is_transparent():
+    p = ReplicationPublisher(ack_timeout_s=0.05)
+    assert p.gate(_Wal(5)) is True          # dormant: pre-HA ack path
+    assert p.gate(None) is True             # non-durable target
+    assert p.window.windows == 0
+
+
+def test_publisher_anonymous_poll_not_enrolled():
+    p = ReplicationPublisher(ack_timeout_s=0.05)
+    p.note_poll("", 99)                     # observability read
+    assert not p.has_standby()
+    assert p.gate(_Wal(5)) is True
+
+
+def test_publisher_gate_waits_for_cursor_then_degrades():
+    from go_crdt_playground_tpu.obs import Recorder
+
+    rec = Recorder()
+    p = ReplicationPublisher(rec, ack_timeout_s=0.2,
+                             degrade_retry_s=0.15)
+    wal = _Wal(4)                           # records 1..3 committed
+    p.note_poll("sb", 1)
+
+    def late_ack():
+        time.sleep(0.05)
+        p.note_poll("sb", 4)                # covers the tail
+
+    t = threading.Thread(target=late_ack)
+    t.start()
+    assert p.gate(wal) is True              # woken by the ack
+    t.join()
+    assert p.window.windows == 0
+    # now the standby goes silent: the gate times out, arms the window
+    wal.n = 9
+    t0 = time.monotonic()
+    assert p.gate(wal) is False
+    assert time.monotonic() - t0 >= 0.15    # it really waited
+    assert p.window.active()
+    assert rec.snapshot()["counters"]["repl.degraded_windows"] == 1
+    # degraded: the next gate is immediate (async acks)
+    t0 = time.monotonic()
+    assert p.gate(wal) is False
+    assert time.monotonic() - t0 < 0.1
+    # window lapses -> the next gate is the PROBE; the standby is back
+    time.sleep(0.2)
+    p.note_poll("sb", 9)
+    assert p.gate(wal) is True              # probe succeeded
+    assert not p.window.armed_ever()        # healed
+    snap = rec.snapshot()["counters"]
+    assert snap["repl.heals"] == 1
+    assert snap["repl.degraded_windows"] == 1  # one EPISODE
+
+
+def test_publisher_waits_for_slowest_live_standby(monkeypatch):
+    p = ReplicationPublisher(ack_timeout_s=0.05)
+    p.note_poll("sb1", 9)
+    p.note_poll("sb2", 3)                   # the one that may promote
+    wal = _Wal(9)
+    assert p.lag_records(wal.next_seq()) == 6  # min over live cursors
+    assert p.gate(wal) is False             # sb2 has not covered 8
+    # sb2 goes stale: only live members gate acks (the degrade ladder
+    # owns dead ones)
+    monkeypatch.setattr(ReplicationPublisher, "STALE_AFTER_S", 0.0)
+    p.window.clear()
+    assert p.lag_records(wal.next_seq()) == 0
+    snap = p.snapshot()
+    assert set(snap["standbys"]) == {"sb1", "sb2"}
+
+
+# -- the WAL_SYNC serve verb against a real frontend -------------------------
+
+
+@pytest.fixture(scope="module")
+def primary(tmp_path_factory):
+    fe = _frontend(tmp_path_factory.mktemp("primary"), epoch=1)
+    addr = fe.serve(port=0)
+    client = ServeClient(addr, timeout=10.0)
+    for e in range(10):
+        client.add(e)
+    client.delete(3)
+    yield fe, addr, client
+    client.close()
+    fe.close()
+
+
+def test_wal_sync_tail_serves_records_and_acks(primary):
+    fe, addr, client = primary
+    r = client.wal_sync(1, standby_id="t-ack")
+    assert r.shard_epoch == 1 and r.shard_id == "s0"
+    assert r.first_seq == 1 and len(r.records) >= 11
+    assert r.next_seq == r.first_seq + len(r.records)
+    assert r.min_seq == 1 and r.flags == 0 and r.payload is None
+    # the poll enrolled the standby and its cursor IS the ack
+    snap = fe.repl.snapshot()
+    assert snap["standbys"]["t-ack"]["acked_seq"] == 1
+    r2 = client.wal_sync(r.next_seq, standby_id="t-ack")
+    assert r2.records == () and r2.next_seq == r.next_seq
+    assert fe.repl.snapshot()["standbys"]["t-ack"]["acked_seq"] \
+        == r.next_seq
+    assert r2.nonce == r.nonce
+    # a cursor beyond this instance's numbering is a typed reset
+    r3 = client.wal_sync(r.next_seq + 1000, standby_id="t-ack")
+    assert r3.flags & protocol.WAL_TRUNCATED
+    assert r3.records == ()
+
+
+def test_wal_sync_truncation_then_digest_catchup(primary):
+    fe, addr, client = primary
+    from go_crdt_playground_tpu.net import digestsync
+
+    # checkpoint: seal + drop retires the tail under any old cursor
+    fe.supervisor.checkpoint()
+    r = client.wal_sync(1, standby_id="t-cu")
+    assert r.flags & protocol.WAL_TRUNCATED
+    assert r.min_seq > 1
+    # catch-up: ship OUR (empty replica's) summary, get O(diff) payload
+    import tempfile
+
+    from go_crdt_playground_tpu.net.peer import Node
+
+    scratch = Node(0, E, A)
+    summary = digestsync.node_summary(scratch)
+    rc = client.wal_sync(r.next_seq, standby_id="t-cu", summary=summary)
+    assert rc.payload is not None
+    assert rc.flags & protocol.WAL_CATCHUP_PAYLOAD
+    scratch.apply_payload_body(rc.payload)
+    # the caught-up replica mirrors the primary bitwise
+    assert _full_slice(scratch) == _full_slice(fe.node)
+    assert rc.next_seq >= r.min_seq
+
+
+def test_wal_sync_epoch_claim_deposes_writes_not_reads(tmp_path):
+    fe = _frontend(tmp_path / "dep", epoch=1)
+    addr = fe.serve(port=0)
+    with ServeClient(addr, timeout=10.0) as c:
+        c.add(1, 2)
+        assert not fe.shard_deposed
+        # the promoting standby's deposition notice
+        r = c.wal_sync(1, epoch=4, standby_id="sb")
+        assert r.shard_epoch == 1
+        assert fe.shard_deposed
+        with pytest.raises(protocol.StaleShardEpoch):
+            c.add(5)
+        members, _vv = c.members()  # reads keep serving (lower bound)
+        assert set(int(e) for e in members) == {1, 2}
+        # a STALER claim than the adjudicated one is typed-rejected
+        with pytest.raises(protocol.StaleShardEpoch):
+            c.wal_sync(1, epoch=2, standby_id="older")
+    fe.close()
+    # the adjudication persisted: a restart boots fenced even with no
+    # router reachable
+    fe2 = _frontend(tmp_path / "dep", epoch=1)
+    assert fe2.shard_deposed
+    fe2.close()
+
+
+# -- the standby state machine ----------------------------------------------
+
+
+def test_standby_tail_mirror_promote_and_resurrection(tmp_path):
+    """The full in-process failover story on one replication group
+    behind a real router: tail to a bitwise mirror, quiesce, kill,
+    promote (epoch bump + router keyspace swap), serve, restart the
+    old primary and watch it boot self-fenced."""
+    from go_crdt_playground_tpu.net.peer import Node
+    from go_crdt_playground_tpu.shard.fleet import free_port
+    from go_crdt_playground_tpu.shard.router import ShardRouter
+
+    p_dir = tmp_path / "p0"
+    fe = _frontend(p_dir, epoch=1)
+    a0 = fe.serve(port=0)
+    standby_port = free_port()
+    router = ShardRouter({"s0": [a0, ("127.0.0.1", standby_port)]}, E,
+                         state_dir=str(tmp_path / "router"))
+    raddr = router.serve(port=0)
+    client = ServeClient(raddr, timeout=10.0)
+    for e in range(14):
+        client.add(e)
+    client.delete(2, 7)
+
+    sfe = _frontend(tmp_path / "sb")
+    sb = ShardStandby(a0, sfe, sid="s0", standby_id="s0-standby",
+                      listen_addr=("127.0.0.1", standby_port),
+                      announce_to=raddr, poll_interval_s=0.02,
+                      failure_threshold=2, wait_ms=50)
+    assert sb.poll_once() == POLL_TAILED
+    assert sb.tailed_ever
+    # quiesced: the standby is a BITWISE mirror
+    assert _full_slice(sfe.node) == _full_slice(fe.node)
+
+    # kill the primary; poll failures cross the threshold and promote
+    fe.close()
+    verdicts = [sb.poll_once(), sb.poll_once()]
+    assert verdicts[-1] == POLL_PROMOTED, verdicts
+    assert sb.promoted and sb.promote_reason
+    assert sb.announce_result and sb.announce_result["swapped"]
+    # the promoted member claims epoch tailed(1) + 1 and persists it
+    assert load_shard_epoch(str(tmp_path / "sb")) == 2
+    assert router.shard_epochs() == {"s0": 2}
+
+    # the keyspace serves THROUGH THE ROUTER via the promoted standby,
+    # with every pre-kill acked op present (zero acked-op loss) —
+    # promotion equals what a restore_durable restart would have given
+    restored = Node.restore_durable(str(p_dir))
+    assert _full_slice(restored) == _full_slice(sfe.node)
+    for e in range(14, 20):
+        client.add(e)
+    members, _vv = client.members()
+    assert set(int(m) for m in members) == set(range(20)) - {2, 7}
+
+    # resurrection: the old primary restarts on its old disk, announces
+    # its stale epoch, and boots self-fenced — writes shed typed, the
+    # promoted member untouched
+    fe_old = _frontend(p_dir, epoch=1, announce=raddr)
+    a_old = fe_old.serve(port=0)
+    assert fe_old.shard_deposed
+    with ServeClient(a_old, timeout=5.0) as c2:
+        with pytest.raises(protocol.StaleShardEpoch):
+            c2.add(40)
+        m_old, _ = c2.members()  # reads serve the stale lower bound
+        assert len(m_old) > 0
+    assert router.shard_epochs() == {"s0": 2}
+
+    client.close()
+    fe_old.close()
+    sb.close()
+    router.close()
+
+
+def test_standby_nonce_reset_catches_primary_restart(tmp_path):
+    """A primary restart renumbers its WAL; the standby detects the
+    instance-nonce change, resets its cursor TYPED (never a silent
+    gap) and digest-catches-up to the restarted primary's state."""
+    from go_crdt_playground_tpu.shard.fleet import free_port
+
+    port = free_port()
+    p_dir = tmp_path / "p"
+    fe1 = _frontend(p_dir, epoch=1)
+    fe1.serve(port=port)
+    with ServeClient(("127.0.0.1", port), timeout=10.0) as c:
+        for e in range(6):
+            c.add(e)
+    sfe = _frontend(tmp_path / "sb")
+    sb = ShardStandby(("127.0.0.1", port), sfe, sid="s0",
+                      poll_interval_s=0.02, failure_threshold=99,
+                      wait_ms=20)
+    assert sb.poll_once() == POLL_TAILED
+    cursor_before = sb.cursor
+    assert cursor_before > 1
+    fe1.close()
+    assert sb.poll_once() == POLL_FAILED
+    # restart on the same port: fresh WAL numbering, fresh nonce; the
+    # drain checkpoint truncated the log, so the record space is empty
+    fe2 = _frontend(p_dir, epoch=1)
+    fe2.serve(port=port)
+    with ServeClient(("127.0.0.1", port), timeout=10.0) as c:
+        c.add(40)
+    v1 = sb.poll_once()          # detects the nonce change, resets
+    v2 = sb.poll_once()          # ...and catches up O(diff)
+    assert POLL_CAUGHT_UP in (v1, v2), (v1, v2)
+    assert _full_slice(sfe.node) == _full_slice(fe2.node)
+    sb.close()
+    fe2.close()
+
+
+def test_standby_never_tailed_blocks_promotion(tmp_path):
+    """A standby that never tailed (and holds no persisted epoch) must
+    NOT promote — it would serve an empty replica under a colliding
+    epoch.  The counter records the refusal."""
+    sfe = _frontend(tmp_path / "sb")
+    dead = ("127.0.0.1", 1)  # nothing listens there
+    sb = ShardStandby(dead, sfe, sid="s0", poll_interval_s=0.01,
+                      failure_threshold=2, poll_timeout_s=0.2)
+    assert sb.poll_once() == POLL_FAILED
+    assert sb.poll_once() == POLL_FAILED  # threshold crossed, blocked
+    assert not sb.promoted
+    snap = sfe.recorder.snapshot()["counters"]
+    assert snap["repl.promote_blocked"] >= 1
+    sb.close()
+
+
+# -- router-side failover adjudication (no shard processes) ------------------
+
+
+def test_router_failover_adjudication_and_restart(tmp_path):
+    from go_crdt_playground_tpu.shard.router import ShardRouter
+
+    state = str(tmp_path / "router")
+    p0, sb0 = ("127.0.0.1", 7001), ("127.0.0.1", 7002)
+    router = ShardRouter({"s0": [p0, sb0], "s1": ("127.0.0.1", 7003)},
+                         E, state_dir=state)
+    try:
+        # unknown sid
+        with pytest.raises(KeyError):
+            router.failover_shard("nope", 2, sb0)
+        # the claim: adopt + swap (roster reorders, claimed first)
+        rec = router.failover_shard("s0", 2, sb0, owner="s0-standby")
+        assert rec["swapped"] and rec["shard_epoch"] == 2
+        assert router.link("s0").addrs == [sb0, p0]
+        assert router.shard_epochs() == {"s0": 2}
+        # idempotent echo (the announce retry path)
+        rec2 = router.failover_shard("s0", 2, sb0)
+        assert not rec2["swapped"] and rec2["shard_epoch"] == 2
+        # the deposed old primary's probe: typed, nothing swapped
+        with pytest.raises(protocol.StaleShardEpoch):
+            router.failover_shard("s0", 1, p0)
+        assert router.link("s0").addrs == [sb0, p0]
+        # equal epoch from a DIFFERENT address is stale too
+        with pytest.raises(protocol.StaleShardEpoch):
+            router.failover_shard("s0", 2, p0)
+    finally:
+        router.close()
+    # a restarted router adopts the adjudicated epochs AND the
+    # active-first roster order — it can never redial the deposed
+    # member as the keyspace's active downstream
+    router2 = ShardRouter({"s0": [p0, sb0], "s1": ("127.0.0.1", 7003)},
+                          E, state_dir=state)
+    try:
+        assert router2.shard_epochs() == {"s0": 2}
+        assert router2.link("s0").addrs == [sb0, p0]
+        assert router2.link("s1").addrs == [("127.0.0.1", 7003)]
+    finally:
+        router2.close()
+
+
+def test_batcher_gate_rides_live_standby(tmp_path):
+    """End to end through the real batcher: a tailing standby's acks
+    keep semi-sync satisfied — no degrade window opens while the
+    standby follows the tail.  A dedicated frontend: the GROUP is the
+    unit (the gate waits on the slowest live member, so any stale
+    enrolled cursor from another test would rightly degrade it)."""
+    fe = _frontend(tmp_path / "gate", epoch=1)
+    addr = fe.serve(port=0)
+    client = ServeClient(addr, timeout=10.0)
+    stop = threading.Event()
+
+    def tail():
+        with ServeClient(addr, timeout=5.0) as tc:
+            cursor = tc.wal_sync(1, standby_id="live-sb").next_seq
+            while not stop.is_set():
+                r = tc.wal_sync(cursor, standby_id="live-sb",
+                                wait_ms=50)
+                cursor = r.next_seq
+
+    t = threading.Thread(target=tail, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    windows_before = fe.repl.window.windows
+    for e in range(20, 30):
+        client.add(e)
+    stop.set()
+    t.join(timeout=5.0)
+    assert fe.repl.window.windows == windows_before
+    snap = fe.repl.snapshot()
+    assert snap["standbys"]["live-sb"]["acked_seq"] > 1
+    client.close()
+    fe.close()
+
+
+def test_epoch_zero_primary_adopts_one_at_announce(tmp_path):
+    """The review-found collision: an announce-configured primary left
+    at the default epoch 0 must ADOPT (and persist) epoch 1 as its own
+    claim — otherwise its boot announce registers epoch 1 at the
+    router while its WAL_SYNC replies ship 0, its standby promotes at
+    0+1 = 1, and the failover claim collides typed with the primary's
+    own registration (equal epoch, different address): the keyspace
+    could never swap."""
+    from go_crdt_playground_tpu.shard.router import ShardRouter
+
+    router = ShardRouter({"s0": ("127.0.0.1", 7009)}, E,
+                         state_dir=str(tmp_path / "router"))
+    raddr = router.serve(port=0)
+    fe = _frontend(tmp_path / "p", epoch=0, announce=raddr)
+    addr = fe.serve(port=0)
+    try:
+        # the member's own epoch is now 1, durably, and the replies
+        # agree with what the router adjudicated
+        assert load_shard_epoch(str(tmp_path / "p")) == 1
+        with ServeClient(addr, timeout=5.0) as c:
+            assert c.wal_sync(1, standby_id="probe-x").shard_epoch == 1
+        assert router.shard_epochs().get("s0") == 1
+        # a standby that tailed epoch 1 claims 2: the swap SUCCEEDS
+        rec = router.failover_shard("s0", 2, ("127.0.0.1", 7010))
+        assert rec["swapped"] and rec["shard_epoch"] == 2
+    finally:
+        fe.close()
+        router.close()
+
+
+def test_publisher_gate_skips_wait_with_no_live_standby(monkeypatch):
+    """A decommissioned standby (enrolled once, long stale) must not
+    cost one ack_timeout per probe forever: with zero LIVE members the
+    gate goes straight to the degrade path."""
+    p = ReplicationPublisher(ack_timeout_s=5.0, degrade_retry_s=0.05)
+    p.note_poll("gone", 1)
+    monkeypatch.setattr(ReplicationPublisher, "STALE_AFTER_S", 0.0)
+    t0 = time.monotonic()
+    assert p.gate(_Wal(9)) is False
+    assert time.monotonic() - t0 < 1.0  # never waited the 5s budget
+    assert p.window.armed_ever()

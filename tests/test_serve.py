@@ -65,7 +65,8 @@ def test_protocol_ack_reject_members_roundtrip():
         protocol.REJECT_OVERLOADED, protocol.REJECT_EXPIRED,
         protocol.REJECT_DRAINING, protocol.REJECT_INVALID,
         protocol.REJECT_UNAVAILABLE, protocol.REJECT_MOVING,
-        protocol.REJECT_STALE_EPOCH, protocol.REJECT_STORAGE}
+        protocol.REJECT_STALE_EPOCH, protocol.REJECT_STORAGE,
+        protocol.REJECT_STALE_SHARD_EPOCH}
     for code, exc in protocol.REJECT_EXCEPTIONS.items():
         assert protocol.REJECT_CODES[exc] == code
 

@@ -196,3 +196,120 @@ def test_truncate_resets_and_never_reuses_seq(tmp_path):
 def test_validation():
     with pytest.raises(ValueError):
         DeltaWal("/tmp/never-created-wal-x", segment_bytes=8)
+
+
+# -- stream_from: the replication tail reader (DESIGN.md §23) ----------------
+
+
+def test_stream_from_basic_and_follow(tmp_path):
+    """Contiguous (seq, body) pairs from the cursor; re-invoking with
+    the advanced cursor follows new appends — the WAL_SYNC poll
+    shape."""
+    from go_crdt_playground_tpu.utils.wal import WalTruncated  # noqa: F401
+
+    with DeltaWal(str(tmp_path / "wal")) as w:
+        assert w.min_seq() == 1 and w.next_seq() == 1
+        assert list(w.stream_from(1)) == []
+        for b in _bodies(5):
+            w.append(b)
+        got = list(w.stream_from(1))
+        assert got == list(enumerate(_bodies(5), start=1))
+        assert list(w.stream_from(4)) == [(4, _bodies(5)[3]),
+                                          (5, _bodies(5)[4])]
+        # follow: the next batch starts where the last one ended
+        cursor = got[-1][0] + 1
+        assert cursor == w.next_seq() == 6
+        w.append(b"later")
+        assert list(w.stream_from(cursor)) == [(6, b"later")]
+        with pytest.raises(ValueError):
+            w.stream_from(0)
+
+
+def test_stream_from_crosses_rotation_and_seal(tmp_path):
+    """Record seqs stay contiguous across segment rotation AND an
+    explicit seal (the checkpoint two-phase): no gap, no repeat."""
+    with DeltaWal(str(tmp_path / "wal"), segment_bytes=64) as w:
+        bodies = _bodies(12, size=40)  # ~46B framed: rotates every rec
+        for b in bodies[:8]:
+            w.append(b)
+        sealed = w.seal()
+        assert len(sealed) > 1  # rotation really happened
+        for b in bodies[8:]:
+            w.append(b)
+        assert [s for s, _ in w.stream_from(1)] == list(range(1, 13))
+        assert [b for _, b in w.stream_from(9)] == bodies[8:]
+
+
+def test_stream_from_truncate_surfaces_typed(tmp_path):
+    """A checkpoint truncation under the cursor is TYPED WalTruncated
+    — never a silent gap — and carries the resume bounds."""
+    from go_crdt_playground_tpu.utils.wal import WalTruncated
+
+    with DeltaWal(str(tmp_path / "wal")) as w:
+        for b in _bodies(4):
+            w.append(b)
+        w.truncate()
+        assert w.min_seq() == w.next_seq() == 5
+        with pytest.raises(WalTruncated) as ei:
+            w.stream_from(3)
+        assert ei.value.wanted == 3
+        assert ei.value.min_seq == 5 and ei.value.next_seq == 5
+        # the fresh cursor streams the post-truncate records
+        w.append(b"after")
+        assert list(w.stream_from(5)) == [(5, b"after")]
+
+
+def test_stream_from_drop_segments_surfaces_typed(tmp_path):
+    """The save_durable two-phase (seal + drop) retires sealed
+    segments: a cursor below the new minimum is typed, a cursor at it
+    streams the fresh-segment records."""
+    from go_crdt_playground_tpu.utils.wal import WalTruncated
+
+    with DeltaWal(str(tmp_path / "wal")) as w:
+        for b in _bodies(6):
+            w.append(b)
+        sealed = w.seal()
+        w.append(b"fresh-1")
+        w.drop_segments(sealed)
+        assert w.min_seq() == 7
+        with pytest.raises(WalTruncated):
+            w.stream_from(1)
+        assert list(w.stream_from(7)) == [(7, b"fresh-1")]
+
+
+def test_stream_from_torn_tail_stops_then_resumes(tmp_path):
+    """A torn tail stops the stream AT the tear (committed prefix only,
+    no exception — an in-flight append looks identical); after the
+    next append heals the tail, the same cursor resumes cleanly."""
+    p = str(tmp_path / "wal")
+    with DeltaWal(p) as w:
+        for b in _bodies(3):
+            w.append(b)
+        seg = max(int(n[4:-4]) for n in os.listdir(p)
+                  if n.endswith(".log"))
+        seg_path = os.path.join(p, f"wal-{seg:012d}.log")
+        # a partial record past the committed end (a mid-append crash)
+        with open(seg_path, "ab") as f:
+            f.write(encode_record(b"torn!")[:-3])
+        assert [s for s, _ in w.stream_from(1)] == [1, 2, 3]
+        # heal: the dirty-append path truncates the partial back first
+        w._dirty = True
+        w.append(b"healed")
+        assert list(w.stream_from(4)) == [(4, b"healed")]
+        assert [s for s, _ in w.stream_from(1)] == [1, 2, 3, 4]
+
+
+def test_stream_seq_numbering_rebuilds_at_open(tmp_path):
+    """Record numbering is an INSTANCE property rebuilt from the scan:
+    a reopened log re-counts from 1 (the WAL_SYNC nonce is how tailing
+    standbys learn their cursors died with the old instance)."""
+    p = str(tmp_path / "wal")
+    with DeltaWal(p, segment_bytes=64) as w:
+        for b in _bodies(5, size=40):
+            w.append(b)
+        assert w.next_seq() == 6
+    with DeltaWal(p) as w2:
+        assert w2.min_seq() == 1 and w2.next_seq() == 6
+        assert [s for s, _ in w2.stream_from(1)] == [1, 2, 3, 4, 5]
+        w2.append(b"post-reopen")
+        assert list(w2.stream_from(6)) == [(6, b"post-reopen")]

@@ -67,6 +67,18 @@ single-node load generator runs against the fleet as-is.
   typed StaleRouterEpoch, data plane shed typed, promoted ring digest
   untouched).  Writes HA_CURVE.json.
 
+* **shard-replication mode** (``--shard-repl``, DESIGN.md §23) — two
+  replication groups (each a primary shard + a WAL-tailing warm
+  standby) behind one router: deterministic chaos on the
+  primary↔standby replication link (typed degrade of semi-sync to
+  async, goodput floor held, digest catch-up on heal), a MID-STREAM
+  primary SIGKILL with NO restart (bounded promotion, keyspace
+  failover at the router under a bumped fenced shard epoch), a
+  QUIESCED kill whose promoted replica must be byte-identical to the
+  ``restore_durable`` restart path, and a deposed-primary
+  resurrection leg (write typed-rejected, never applied).  Zero
+  acked-op loss, zero phantoms.  Writes REPL_CURVE.json.
+
 * **autopilot mode** (``--autopilot``, DESIGN.md §21) — the
   closed-loop acceptance soak: a REAL ``autopilot`` CLI subprocess
   watching the router must split a flash-crowded keyspace onto
@@ -566,6 +578,14 @@ def chaos_leg(root: str, elements: int, seed: int) -> Dict[str, object]:
                     proxy.set_scenario(truncate_rate=0.0)
                     proxy.partition()
                     proxy.sever()
+                    # hold the partition past the link's breaker
+                    # cooldown AND its backoff cap (2s): the phases
+                    # are op-index-anchored, and on a fast machine the
+                    # window would otherwise close before a single
+                    # half-open probe dial can land refused — the
+                    # adjudication requires the partition to have
+                    # REALLY refused someone, not merely been armed
+                    time.sleep(2.5)
                 elif i == heal_at:
                     proxy.heal()
                     chaos_window = False
@@ -1880,6 +1900,535 @@ def adjudicate_router_ha(r: Dict[str, object]) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# shard-replication mode (`--shard-repl`, DESIGN.md §23)
+# ---------------------------------------------------------------------------
+
+
+class _ReplTraffic(threading.Thread):
+    """Ledgered add-only load through the (single, never-killed) router
+    while SHARD primaries die under it: typed rejects requeue,
+    transport ambiguity requeues counted, true unresolved adjudicated
+    to zero.  ``pause()`` stops submissions without ending the thread
+    (the bitwise leg needs a quiesced fleet mid-soak).  The ack log
+    carries (t, element) so legs can ask about one keyspace's acks in
+    one time window."""
+
+    def __init__(self, addr, elements: int, seed: int):
+        super().__init__(daemon=True)
+        from collections import deque
+
+        self.addr = addr
+        self.elements = elements
+        self.seed = seed
+        self._cycle = 0
+        self.todo = deque(workloads.shuffled_universe(elements, seed))
+        self.acked: Set[int] = set()
+        self.submitted: Set[int] = set()
+        self.counts = {"typed_unavailable": 0, "typed_moving": 0,
+                       "typed_storage": 0, "typed_stale_shard": 0,
+                       "typed_other": 0, "transport_retries": 0,
+                       "unresolved": 0}
+        self._ack_log: List[Tuple[float, int]] = []
+        self._log_lock = threading.Lock()
+        self._paused = threading.Event()
+        self._halt = threading.Event()
+
+    def acks_in(self, t0: float, t1: float, owned=None) -> int:
+        with self._log_lock:
+            return sum(1 for ts, e in self._ack_log
+                       if t0 <= ts <= t1
+                       and (owned is None or e in owned))
+
+    def pause(self) -> None:
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+
+    def run(self) -> None:
+        client = None
+        try:
+            while not self._halt.is_set():
+                if self._paused.is_set():
+                    time.sleep(0.02)
+                    continue
+                if not self.todo:
+                    # keep offering idempotent re-adds of the same
+                    # universe: the failover legs need live heat long
+                    # after the first pass lands; the ledger sets are
+                    # unchanged by resubmission
+                    self._cycle += 1
+                    self.todo.extend(workloads.shuffled_universe(
+                        self.elements, self.seed + self._cycle))
+                e = self.todo.popleft()
+                self.submitted.add(e)
+                try:
+                    if client is None or client.closed:
+                        if client is not None:
+                            client.close()
+                        client = ServeClient(self.addr, timeout=30.0,
+                                             connect_timeout=2.0)
+                    client.add(e, deadline_s=5.0)
+                    self.acked.add(e)
+                    with self._log_lock:
+                        self._ack_log.append((time.monotonic(), e))
+                except protocol.ShardUnavailable:
+                    self.counts["typed_unavailable"] += 1
+                    self.todo.append(e)
+                    time.sleep(0.02)
+                except protocol.KeyspaceMoving:
+                    self.counts["typed_moving"] += 1
+                    self.todo.append(e)
+                    time.sleep(0.01)
+                except protocol.StorageDegraded:
+                    self.counts["typed_storage"] += 1
+                    self.todo.append(e)
+                    time.sleep(0.02)
+                except protocol.StaleShardEpoch:
+                    # a deposed member answered (the router should
+                    # never relay this post-swap; counted loudly)
+                    self.counts["typed_stale_shard"] += 1
+                    self.todo.append(e)
+                    time.sleep(0.02)
+                except protocol.ServeError:
+                    self.counts["typed_other"] += 1
+                    self.todo.append(e)
+                    time.sleep(0.01)
+                except socket.timeout:
+                    self.counts["unresolved"] += 1
+                    self.todo.append(e)
+                except (ConnectionError, OSError):
+                    self.counts["transport_retries"] += 1
+                    self.todo.append(e)
+                    time.sleep(0.02)
+        finally:
+            if client is not None:
+                client.close()
+
+    def drain(self, timeout_s: float) -> bool:
+        """Finish the CURRENT universe pass (everything acked at least
+        once), then stop."""
+        self.resume()
+        deadline = time.monotonic() + timeout_s
+        while (len(self.acked) < self.elements
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        self._halt.set()
+        self.join(timeout=10.0)
+        return len(self.acked) >= self.elements and not self.is_alive()
+
+
+def _shard_stats(router_addr, sid: str) -> Tuple[dict, dict, dict]:
+    """(shard counters, shard gauges, ring info) from one STATS poll."""
+    with ServeClient(router_addr, timeout=15.0) as c:
+        stats = c.stats()
+    snap = (stats.get("shards") or {}).get(sid) or {}
+    return (snap.get("counters", {}) or {},
+            snap.get("gauges", {}) or {},
+            stats.get("ring", {}) or {})
+
+
+def _await_repl(router_addr, sid: str, pred, timeout_s: float,
+                what: str) -> Tuple[dict, dict]:
+    deadline = time.monotonic() + timeout_s
+    counters: dict = {}
+    gauges: dict = {}
+    while time.monotonic() < deadline:
+        try:
+            counters, gauges, _ = _shard_stats(router_addr, sid)
+            if pred(counters, gauges):
+                return counters, gauges
+        except (OSError, ConnectionError, socket.timeout):
+            pass
+        time.sleep(0.25)
+    raise RuntimeError(f"timed out waiting for {what}: "
+                       f"counters={counters} gauges={gauges}")
+
+
+def run_shard_repl_mode(args) -> int:
+    """``--shard-repl``: the shard-replication acceptance soak
+    (DESIGN.md §23), four legs over ONE real fleet of two replication
+    groups (s0 + warm standby through a ChaosProxy on the replication
+    link, s1 + warm standby direct) behind one router:
+
+    1. **chaos** — torn frames, then an asymmetric partition +
+       ``sever()`` on the PRIMARY↔STANDBY link while s0 checkpoints
+       rotate its WAL: replication degrades TYPED to async
+       (``repl.degraded_windows`` ≥ 1) and s0's keyspace keeps acking
+       above the floor; on heal the standby digest-catches-up
+       (``repl.catchups`` ≥ 1, ``repl.lag_records`` back to 0).
+    2. **failover** — SIGKILL s0's primary MID-STREAM under the
+       continuous ledger, NO restart: the standby promotes within the
+       budget, the router swaps the keyspace under shard epoch 2, and
+       s0-owned elements ack again through the promoted member.
+    3. **bitwise** — quiesce (s1 ``repl.lag_records == 0``), SIGKILL
+       s1's primary, promote, and BEFORE any new traffic pull the
+       promoted standby's full-universe slice: byte-identical to an
+       in-process ``restore_durable`` of the dead primary's disk —
+       promotion IS the restart path, bit for bit.
+    4. **resurrection** — restart s0's OLD primary on its old
+       port/disk: its announce learns the adjudicated epoch and it
+       boots self-fenced (direct write typed-rejected and never
+       applied; reads serve; router mapping untouched).
+
+    Throughout: every op resolves ack-or-typed, zero acked-op loss,
+    zero phantoms, whole keyspace in.  Writes REPL_CURVE.json.
+    """
+    import numpy as np
+
+    from go_crdt_playground_tpu.net.faults import ChaosProxy
+    from go_crdt_playground_tpu.net.peer import Node
+    from go_crdt_playground_tpu.shard.fleet import (RouterProc, ShardProc,
+                                                    StandbyShardProc,
+                                                    free_port)
+    from go_crdt_playground_tpu.shard.ring import HashRing
+
+    if args.quick:
+        elements = 96
+        promote_budget_s = 30.0
+    else:
+        elements = 192
+        promote_budget_s = 20.0
+    t0 = time.time()
+    root = tempfile.mkdtemp(prefix="shard-repl-soak-")
+    spec = FleetSpec(n_shards=2, elements=elements, seed=args.seed,
+                     queue_depth=64, max_batch=8, flush_ms=2.0)
+    procs: List[object] = []
+    proxy = None
+    traffic = None
+    result: Dict[str, object] = {}
+    try:
+        p0_port, p1_port = free_port(), free_port()
+        sb0_port, sb1_port = free_port(), free_port()
+        router_port = free_port()
+        router_addr = ("127.0.0.1", router_port)
+        announce = f"127.0.0.1:{router_port}"
+
+        # replication-group primaries: shard ids + epoch 1 + the
+        # router announce; s0 additionally checkpoints on a cadence so
+        # a partitioned standby's cursor gets TRUNCATED under it (the
+        # digest catch-up trigger)
+        s0 = ShardProc(REPO, os.path.join(root, "s0"), spec, 0, p0_port,
+                       extra_args=("--shard-id", "s0",
+                                   "--shard-epoch", "1",
+                                   "--announce-to", announce,
+                                   "--repl-ack-timeout-ms", "150",
+                                   "--checkpoint-every", "40"))
+        s1 = ShardProc(REPO, os.path.join(root, "s1"), spec, 1, p1_port,
+                       extra_args=("--shard-id", "s1",
+                                   "--shard-epoch", "1",
+                                   "--announce-to", announce,
+                                   "--repl-ack-timeout-ms", "150"))
+        procs += [s0, s1]
+        a0 = s0.await_address()
+        a1 = s1.await_address()
+        # the replication link under test rides the proxy: the standby
+        # tails THROUGH it, so the chaos leg can tear/partition just
+        # that hop while clients and the router stay clean
+        proxy = ChaosProxy(a0, seed=args.seed)
+        router = RouterProc(
+            REPO, os.path.join(root, "router"), spec,
+            {"s0": [a0, ("127.0.0.1", sb0_port)],
+             "s1": [a1, ("127.0.0.1", sb1_port)]},
+            router_port, state_dir=os.path.join(root, "router-state"))
+        procs.append(router)
+        router.await_address()
+        # sb0's failure threshold must RIDE OUT the chaos leg: its
+        # poll path IS the link under chaos, and a standby cannot
+        # distinguish a partitioned link from a dead primary — the
+        # fence makes a false-positive promotion SAFE, but this soak
+        # wants the chaos leg to prove degradation, not failover.  The
+        # cost is declared detection latency (~threshold x poll) inside
+        # the promotion budget.
+        sb0 = StandbyShardProc(REPO, os.path.join(root, "sb0"), spec, 0,
+                               sb0_port, ("127.0.0.1", proxy.port),
+                               "s0", announce_to=router_addr,
+                               poll_interval_s=0.1,
+                               failure_threshold=90)
+        sb1 = StandbyShardProc(REPO, os.path.join(root, "sb1"), spec, 1,
+                               sb1_port, a1, "s1",
+                               announce_to=router_addr,
+                               poll_interval_s=0.1, failure_threshold=5)
+        procs += [sb0, sb1]
+        for sb in (sb0, sb1):
+            sb.await_engaged()
+            # only a TAILED standby promotes: the kills must not race
+            # the first tail poll
+            sb.await_tailed()
+
+        ring = HashRing(["s0", "s1"], seed=args.seed)
+        owners = ring.owner_map(elements)
+        s0_owned = {int(e) for e in
+                    (owners == ring.shards.index("s0")).nonzero()[0]}
+        s1_owned = set(range(elements)) - s0_owned
+
+        traffic = _ReplTraffic(router_addr, elements, args.seed)
+        traffic.start()
+        base_deadline = time.monotonic() + 90.0
+        while (len(traffic.acked) < elements // 3
+               and time.monotonic() < base_deadline):
+            time.sleep(0.05)
+
+        # ---- leg 1: chaos on the replication link ---------------------
+        # semi-sync is live before the chaos: the standby's cursor has
+        # been covering the tail (lag drains to 0 under load)
+        _await_repl(router_addr, "s0",
+                    lambda c, g: c.get("repl.polls", 0) > 0
+                    and g.get("repl.lag_records", 1) == 0,
+                    60.0, "s0 semi-sync live")
+        t_chaos0 = time.monotonic()
+        proxy.set_scenario(truncate_rate=1.0)
+        proxy.sever()
+        time.sleep(2.0)
+        proxy.set_scenario(truncate_rate=0.0)
+        proxy.partition()
+        proxy.sever()
+        t_part0 = time.monotonic()
+        time.sleep(4.0)  # s0's checkpoint cadence truncates its WAL
+        t_part1 = time.monotonic()
+        counters_mid = _shard_stats(router_addr, "s0")[0]
+        proxy.heal()
+        # on heal: typed degrade happened, the standby digest-catches-
+        # up past the truncation, and the lag drains to zero
+        counters_heal, gauges_heal = _await_repl(
+            router_addr, "s0",
+            lambda c, g: g.get("repl.lag_records", 1) == 0
+            and c.get("repl.degraded_windows", 0) >= 1,
+            60.0, "s0 heal + lag drain")
+        leg_chaos = {
+            "proxy": proxy.counters(),
+            "degraded_windows": int(
+                counters_heal.get("repl.degraded_windows", 0)),
+            "heals": int(counters_heal.get("repl.heals", 0)),
+            "ship_errors": int(counters_heal.get("repl.ship_errors", 0)),
+            "acked_s0_during_partition": traffic.acks_in(
+                t_part0, t_part1, s0_owned),
+            "partition_s": round(t_part1 - t_part0, 2),
+            "goodput_floor_ops_s": 1.0,
+            "lag_records_after_heal": int(
+                gauges_heal.get("repl.lag_records", -1)),
+            "chaos_s": round(time.monotonic() - t_chaos0, 2),
+            "catchups_served": int(
+                counters_heal.get("repl.catchups_served", 0)),
+            "repl_counters_mid_partition": {
+                k: v for k, v in counters_mid.items()
+                if k.startswith("repl.")},
+        }
+        print(json.dumps({"chaos": leg_chaos}), flush=True)
+
+        # ---- leg 2: mid-stream primary SIGKILL, NO restart ------------
+        t_kill = time.monotonic()
+        s0.sigkill()
+        s0.log.close()
+        promoted0 = sb0.await_address(timeout_s=promote_budget_s + 60.0)
+        t_promoted = time.monotonic()
+        # the router adjudicated the claim and swapped the keyspace
+        _, _, ring_info = _shard_stats(router_addr, "s0")
+        ack_deadline = time.monotonic() + 60.0
+        while (traffic.acks_in(t_promoted, time.monotonic(),
+                               s0_owned) < 10
+               and time.monotonic() < ack_deadline):
+            time.sleep(0.05)
+        leg_failover = {
+            "promote_s": round(t_promoted - t_kill, 3),
+            "promote_budget_s": promote_budget_s,
+            "promoted_listen": list(promoted0),
+            "shard_epochs": ring_info.get("shard_epochs"),
+            "s0_active_addr": (ring_info.get("shard_addrs", {})
+                               .get("s0", [[None, None]])[0]),
+            "acked_s0_after_promotion": traffic.acks_in(
+                t_promoted, time.monotonic(), s0_owned),
+        }
+        print(json.dumps({"failover": leg_failover}), flush=True)
+
+        # ---- leg 3: quiesced SIGKILL — the bitwise pin ----------------
+        traffic.pause()
+        time.sleep(1.0)  # in-flight submissions resolve
+        _await_repl(router_addr, "s1",
+                    lambda c, g: g.get("repl.lag_records", 1) == 0,
+                    60.0, "s1 quiesced lag 0")
+        t_kill1 = time.monotonic()
+        s1.sigkill()
+        s1.log.close()
+        promoted1 = sb1.await_address(timeout_s=promote_budget_s + 60.0)
+        promote1_s = time.monotonic() - t_kill1
+        # BEFORE any new traffic: the promoted standby's full-universe
+        # slice must be byte-identical to what a restore_durable
+        # restart of the dead primary would serve
+        with ServeClient(tuple(promoted1), timeout=30.0) as c:
+            standby_slice = c.slice_pull(list(range(elements)))
+        # the restart-path counterfactual: checkpoint ⊔ WAL tail of
+        # the DEAD primary's disk (fallback_init: a SIGKILLed shard
+        # that never checkpointed recovers from the WAL alone)
+        restored = Node.restore_durable(
+            os.path.join(root, "s1", "state"),
+            fallback_init=lambda: Node(1, elements, spec.actors))
+        restored_slice = restored.extract_slice(
+            np.ones(elements, bool))
+        _, _, ring_info3 = _shard_stats(router_addr, "s1")
+        leg_bitwise = {
+            "promote_s": round(promote1_s, 3),
+            "promote_budget_s": promote_budget_s,
+            "slices_bitwise_equal": standby_slice == restored_slice,
+            "slice_bytes": len(standby_slice),
+            "shard_epochs": ring_info3.get("shard_epochs"),
+        }
+        print(json.dumps({"bitwise": leg_bitwise}), flush=True)
+        traffic.resume()
+
+        # ---- leg 4: deposed-primary resurrection ----------------------
+        s0b = ShardProc(REPO, os.path.join(root, "s0"), spec, 0, p0_port,
+                        extra_args=("--shard-id", "s0",
+                                    "--shard-epoch", "1",
+                                    "--announce-to", announce,
+                                    "--repl-ack-timeout-ms", "150"))
+        procs.append(s0b)
+        s0b.await_address()
+        write_typed = False
+        try:
+            with ServeClient(a0, timeout=10.0) as c:
+                try:
+                    c.add(0, deadline_s=5.0)
+                except protocol.StaleShardEpoch:
+                    write_typed = True
+                members_old, _vv = c.members()
+                old_stats = c.stats()
+        except (OSError, ConnectionError) as e:
+            members_old, old_stats = [], {"error": str(e)}
+        _, _, ring_info4 = _shard_stats(router_addr, "s0")
+        old_counters = old_stats.get("counters", {})
+        leg_resurrection = {
+            "write_shed_typed": write_typed,
+            "deposed_boot_counted": int(
+                old_counters.get("serve.shard.deposed_boot", 0)),
+            "shed_counted": int(
+                old_counters.get("serve.shed.shard_deposed", 0)),
+            "reads_served_members": len(members_old),
+            "router_s0_active_addr": (ring_info4.get("shard_addrs", {})
+                                      .get("s0", [[None, None]])[0]),
+            "router_shard_epochs": ring_info4.get("shard_epochs"),
+        }
+        print(json.dumps({"resurrection": leg_resurrection}),
+              flush=True)
+
+        # ---- final ledger adjudication --------------------------------
+        finished = traffic.drain(timeout_s=180.0)
+        with ServeClient(router_addr, timeout=60.0) as c:
+            members, _vv = c.members()
+        members_set = set(int(m) for m in members)
+        result = {
+            "elements": elements,
+            "s0_keyspace": len(s0_owned),
+            "s1_keyspace": len(s1_owned),
+            "workload": workloads.SHUFFLED_UNIVERSE,
+            "legs": {"chaos": leg_chaos, "failover": leg_failover,
+                     "bitwise": leg_bitwise,
+                     "resurrection": leg_resurrection},
+            "traffic": dict(traffic.counts),
+            "finished": finished,
+            "acked_ops": len(traffic.acked),
+            "submitted_ops": len(traffic.submitted),
+            "final_members": len(members_set),
+            # MUST be []: an acked op vanished across a shard failover
+            "lost_acked_ops": sorted(traffic.acked - members_set),
+            # MUST be []: a member nobody submitted (e.g. the deposed
+            # primary's rejected write applied anyway)
+            "phantom_members": sorted(members_set - traffic.submitted),
+            "unfinished": sorted(set(range(elements)) - traffic.acked),
+        }
+    finally:
+        if traffic is not None and traffic.is_alive():
+            traffic._halt.set()
+        if proxy is not None:
+            proxy.close()
+        for pr in procs:
+            try:
+                pr.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+    out = args.out or os.path.join(REPO, "REPL_CURVE.json")
+    artifact = {
+        "metric": (
+            "shard replication groups: a warm standby tails its "
+            "primary's committed δ-WAL over WAL_SYNC under semi-"
+            "synchronous group commit, degrades typed to async when "
+            "the link is torn/partitioned (goodput floor held, digest "
+            "catch-up on heal), promotes on a primary SIGKILL with NO "
+            "restart inside the declared budget under a bumped fenced "
+            "shard epoch (the router swaps the keyspace and persists "
+            "the adjudication), the promoted replica is byte-identical "
+            "to the restore_durable restart path when quiesced, and a "
+            "resurrected old primary boots self-fenced (write typed-"
+            "rejected, never applied) — zero acked-op loss, zero "
+            "phantoms, unresolved == 0"),
+        "value": result.get("legs", {}).get("bitwise", {})
+        .get("promote_s"),
+        "unit": "seconds from primary-shard SIGKILL to standby "
+                "promotion (quiesced leg, default failure threshold "
+                "5; the mid-stream leg's promote_s is dominated by "
+                "its chaos-hardened threshold-90 detection window — "
+                "both adjudicated against their declared budgets)",
+        "fleet": {"elements": result.get("elements"),
+                  "replication_groups": 2, "seed": args.seed,
+                  "quick": bool(args.quick),
+                  "ha_poll_interval_s": 0.1,
+                  "ha_failure_threshold": {"s0-standby": 90,
+                                           "s1-standby": 5},
+                  "repl_ack_timeout_ms": 150.0},
+        "platform": "cpu",
+        "elapsed_s": round(time.time() - t0, 1),
+        **result,
+    }
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"wrote {out}")
+    return 0 if adjudicate_shard_repl(result) else 1
+
+
+def adjudicate_shard_repl(r: Dict[str, object]) -> bool:
+    """The acceptance shape of the shard-replication soak (mirrored by
+    tests/test_fleet_serve_soak.py)."""
+    if not r:
+        return False
+    ch = r["legs"]["chaos"]
+    # chaos REALLY happened on the replication link, degradation was
+    # typed-async (primary kept acking its keyspace), catch-up healed
+    ok = ch["proxy"]["truncated"] > 0 and ch["proxy"]["refused"] > 0
+    ok = ok and ch["degraded_windows"] >= 1
+    ok = ok and ch["acked_s0_during_partition"] \
+        >= ch["goodput_floor_ops_s"] * ch["partition_s"]
+    ok = ok and ch["lag_records_after_heal"] == 0
+    # the O(diff) catch-up really ran (the primary's checkpoint
+    # cadence truncated the WAL under the partitioned cursor)
+    ok = ok and ch["catchups_served"] >= 1
+    fo = r["legs"]["failover"]
+    ok = ok and fo["promote_s"] <= fo["promote_budget_s"]
+    ok = ok and fo["shard_epochs"].get("s0") == 2
+    ok = ok and list(map(str, fo["s0_active_addr"][:1]))  # present
+    ok = ok and fo["acked_s0_after_promotion"] >= 10
+    bw = r["legs"]["bitwise"]
+    ok = ok and bw["promote_s"] <= bw["promote_budget_s"]
+    ok = ok and bw["slices_bitwise_equal"]
+    ok = ok and bw["shard_epochs"].get("s1") == 2
+    rz = r["legs"]["resurrection"]
+    ok = ok and rz["write_shed_typed"]
+    ok = ok and rz["shed_counted"] >= 1
+    ok = ok and rz["router_shard_epochs"].get("s0") == 2
+    # the ledger: every op resolved typed, the whole keyspace landed,
+    # nothing acked lost, nothing phantom
+    ok = ok and r["traffic"]["unresolved"] == 0
+    ok = ok and r["finished"] and r["unfinished"] == []
+    ok = ok and r["lost_acked_ops"] == []
+    ok = ok and r["phantom_members"] == []
+    return ok
+
+
+# ---------------------------------------------------------------------------
 # sweep
 # ---------------------------------------------------------------------------
 
@@ -1909,6 +2458,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "leg, and an autopilot split through the "
                          "promoted router — HA_CURVE.json (DESIGN.md "
                          "§22)")
+    ap.add_argument("--shard-repl", dest="shard_repl",
+                    action="store_true",
+                    help="shard replication-group soak instead of the "
+                         "shard sweep: WAL-shipped warm shard standbys "
+                         "— chaos on the replication link, mid-stream "
+                         "primary SIGKILL with NO restart (bounded "
+                         "promotion, keyspace failover at the router), "
+                         "a quiesced bitwise-vs-restore pin, and a "
+                         "deposed-primary resurrection fence leg — "
+                         "REPL_CURVE.json (DESIGN.md §23)")
     ap.add_argument("--out", default=None,
                     help="artifact path (default SHARD_CURVE.json, or "
                          "MESH_CURVE.json with --mesh)")
@@ -1921,6 +2480,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return run_autopilot_mode(args)
     if args.router_ha:
         return run_router_ha_mode(args)
+    if args.shard_repl:
+        return run_shard_repl_mode(args)
     args.out = args.out or os.path.join(REPO, "SHARD_CURVE.json")
 
     if args.quick:
