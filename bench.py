@@ -1443,9 +1443,91 @@ def measure_mesh(num_elements=8192, num_actors=8, batch=32, keys=4,
             "digest_read_ms": round(digest_s * 1e3, 3),
             "digest_summary_bytes": len(summary),
         })
+    # per-device parallel efficiency (ISSUE 15 satellite): throughput
+    # at n devices over n x the 1-device throughput — the number that
+    # makes the dispatch-layering fall-off VISIBLE in the artifact
+    # (on 2 CPU cores the 8-"device" leg time-slices, eff << 1; an
+    # on-chip capture should hold eff near 1 until the batch is too
+    # small to fill the lanes)
+    if curve and curve[0]["devices"] == 1:
+        base = curve[0]["ops_per_s"]
+        for leg in curve:
+            leg["parallel_efficiency"] = round(
+                leg["ops_per_s"] / (leg["devices"] * base), 3)
     # the config rides back with the curve so the artifact records
     # what was MEASURED, not a separately-maintained literal
     return curve, avail, {"elements": num_elements, "batch": batch}
+
+
+def measure_mesh2d(num_elements=8192, num_actors=8, batch=32, keys=4,
+                   repeats=30,
+                   shape_ladder=((1, 2), (2, 2), (4, 2), (1, 4),
+                                 (2, 4))):
+    """2-D dp×mp mesh kernel ladder (ISSUE 15, DESIGN.md §24): per
+    (dp, mp) shape, wall-time of the one-dispatch striped super-batch
+    apply (``Mesh2DApplyTarget.ingest_batch`` over dp × ``batch``
+    KEY-DISJOINT rows — the batcher's width contract — incl. the δ
+    device_get + WAL record encode, fsync off) and the collective
+    digest summary read.  ``ops_per_s`` counts the SUPER-batch rows,
+    so dp scaling shows as throughput at (near-)flat dispatch time;
+    ``dp_scaling`` is ops_per_s over the (1, mp) leg's at the same mp
+    — the goodput-scales-with-dp claim, kernel edition."""
+    import tempfile
+
+    import jax
+
+    from go_crdt_playground_tpu.net import digestsync
+    from go_crdt_playground_tpu.parallel.meshtarget2d import \
+        Mesh2DApplyTarget
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    avail = jax.device_count()
+    shapes = [(dp, mp) for dp, mp in shape_ladder
+              if dp * mp <= avail and num_elements % mp == 0]
+    rng = np.random.default_rng(7)
+    curve = []
+    for dp, mp in shapes:
+        B = dp * batch
+        # key-disjoint rows (each row draws from its own lane band):
+        # the striping planner packs them into dp full stripes with
+        # zero cuts, so the leg measures the parallel apply, not the
+        # conflict fallback
+        band = num_elements // B
+        add = np.zeros((B, num_elements), bool)
+        for b in range(B):
+            lanes = b * band + rng.choice(band, size=min(keys, band),
+                                          replace=False)
+            add[b, lanes] = True
+        dl = np.zeros((B, num_elements), bool)
+        live = np.ones(B, bool)
+        with tempfile.TemporaryDirectory() as d:
+            node = Mesh2DApplyTarget(
+                0, num_elements, num_actors, mesh_shape=(dp, mp),
+                wal=DeltaWal(os.path.join(d, "wal"), fsync=False))
+            node.ingest_batch(add, dl, live)  # warm/compile
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                node.ingest_batch(add, dl, live)
+            ingest_s = (time.perf_counter() - t0) / repeats
+            digestsync.node_summary(node)  # warm the collective read
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                summary = digestsync.node_summary(node)
+            digest_s = (time.perf_counter() - t0) / repeats
+        curve.append({
+            "dp": dp, "mp": mp, "rows_per_dispatch": B,
+            "ingest_ms_per_batch": round(ingest_s * 1e3, 3),
+            "ops_per_s": round(B / ingest_s, 1),
+            "digest_read_ms": round(digest_s * 1e3, 3),
+            "digest_summary_bytes": len(summary),
+        })
+    base_by_mp = {leg["mp"]: leg["ops_per_s"] for leg in curve
+                  if leg["dp"] == 1}
+    for leg in curve:
+        base = base_by_mp.get(leg["mp"])
+        leg["dp_scaling"] = (round(leg["ops_per_s"] / base, 3)
+                             if base else None)
+    return curve, avail
 
 
 def run_mesh(out=_MESH_ARTIFACT):
@@ -1477,6 +1559,19 @@ def run_mesh(out=_MESH_ARTIFACT):
             }))
             return None
     curve, avail, config = measure_mesh()
+    curve_2d, _ = measure_mesh2d()
+    if not curve_2d and prior.get("kernel_curve_2d"):
+        # a host without enough (forced) devices measures NOTHING for
+        # the 2-D ladder — keep the committed ladder instead of
+        # overwriting it with [] (which would also flip the
+        # capture_predicates mesh_2d_complete gate back to incomplete)
+        print(json.dumps({
+            "metric": "mesh 2-D ladder",
+            "skipped": "no (dp, mp) shape fits this host's "
+                       f"{avail} visible devices; keeping the prior "
+                       "kernel_curve_2d",
+        }))
+        curve_2d = prior["kernel_curve_2d"]
     # start from the prior artifact and overwrite ONLY the kernel
     # keys (mirror of fleet_serve_soak's run_mesh_mode): the soak's
     # serve-level half survives a kernel re-capture without a
@@ -1487,16 +1582,21 @@ def run_mesh(out=_MESH_ARTIFACT):
         "metric": ("device-mesh replica tier: ms/batch of the one-"
                    "dispatch lane-sharded ingest+δ write path and the "
                    "collective digest read, vs mesh device count "
-                   "(parallel/meshtarget.py)"),
+                   "(parallel/meshtarget.py), plus the 2-D dp×mp "
+                   "striped super-batch ladder "
+                   "(parallel/meshtarget2d.py, DESIGN.md §24)"),
         "platform": platform,
         "devices_visible": avail,
         "kernel_curve": curve,
+        "kernel_curve_2d": curve_2d,
         **config,
     })
     with open(out, "w") as f:
         json.dump(artifact, f, indent=2)
         f.write("\n")
     for leg in curve:
+        print(json.dumps(leg))
+    for leg in curve_2d:
         print(json.dumps(leg))
     print(f"wrote {out}")
     return artifact

@@ -131,6 +131,17 @@ def _cmd_serve(port: int) -> int:
         return 0
 
 
+def _fmt_mesh(spec) -> str:
+    """One banner token for any mesh spec: ``off``, ``N``, or
+    ``DPxMP`` — harnesses parse it back (``mesh=(\\w+)``), so a tuple
+    must never print with parens/commas."""
+    if spec is None:
+        return "off"
+    if isinstance(spec, tuple):
+        return f"{spec[0]}x{spec[1]}"
+    return str(spec)
+
+
 def _ingest_banner(args, host: str, bound: int) -> None:
     """The standard serving banner — printed by the normal launch AND
     at a shard standby's promotion (the address line doubles as the
@@ -142,7 +153,7 @@ def _ingest_banner(args, host: str, bound: int) -> None:
           f"durable={'yes' if args.durable_dir else 'NO'} "
           f"fused={'yes' if args.fused_ingest else 'NO'} "
           f"sync={args.sync_mode} "
-          f"mesh={args.mesh_devices or 'off'} "
+          f"mesh={_fmt_mesh(args.mesh_devices)} "
           f"shard={args.shard_id or 'off'} "
           f"compaction={args.compact_interval or 'off'})", flush=True)
 
@@ -597,6 +608,18 @@ def main(argv=None) -> int:
                 f"peer must be HOST:PORT, got {text!r}")
         return host, int(port)
 
+    def _mesh_devices_spec(text: str):
+        """Typed ``--mesh-devices`` parser (the --gc-participants
+        parser-hardening precedent): ``N`` or ``DPxMP``, anything else
+        exits 2 with a usage line instead of a traceback."""
+        from go_crdt_playground_tpu.parallel.meshtarget2d import \
+            parse_mesh_spec
+
+        try:
+            return parse_mesh_spec(text)
+        except ValueError as e:
+            raise argparse.ArgumentTypeError(str(e)) from e
+
     s.add_argument("--peer", action="append", default=[], type=_peer_addr,
                    metavar="HOST:PORT",
                    help="anti-entropy peer to disseminate merged state "
@@ -706,17 +729,25 @@ def main(argv=None) -> int:
                    type=int, default=5,
                    help="consecutive failed WAL_SYNC polls before the "
                         "standby promotes itself")
-    s.add_argument("--mesh-devices", dest="mesh_devices", type=int,
-                   default=None, metavar="N",
-                   help="hold the replica state lane-sharded across a "
-                        "1-D device mesh of N devices "
-                        "(parallel/meshtarget.py, DESIGN.md §20): "
-                        "shard-local batch applies, collective digest "
-                        "reads, lane-gather slice transfers — WAL, "
-                        "checkpoints, sync and resharding unchanged.  "
-                        "E must divide by N.  CPU testing: export "
-                        "XLA_FLAGS=--xla_force_host_platform_device_"
-                        "count=8 before launch")
+    s.add_argument("--mesh-devices", dest="mesh_devices",
+                   type=_mesh_devices_spec, default=None,
+                   metavar="N|DPxMP",
+                   help="hold the replica state on a device mesh "
+                        "(typed: malformed specs exit 2).  N = 1-D "
+                        "lane mesh of N devices (parallel/"
+                        "meshtarget.py, DESIGN.md §20): shard-local "
+                        "batch applies, collective digest reads, "
+                        "lane-gather slice transfers.  DPxMP (e.g. "
+                        "2x4) = 2-D mesh (parallel/meshtarget2d.py, "
+                        "§24): lane fields shard E over the MP axis "
+                        "while DP replicated ingest stripes apply up "
+                        "to DP micro-batches per dispatch — dp× batch "
+                        "throughput at mp× state capacity, bitwise-"
+                        "pinned to the 1-D worker.  WAL, checkpoints, "
+                        "sync and resharding unchanged either way; E "
+                        "must divide by the lane-shard count.  CPU "
+                        "testing: export XLA_FLAGS=--xla_force_host_"
+                        "platform_device_count=8 before launch")
 
     def _shard_spec(text: str):
         """``ID=HOST:PORT`` — or ``ID=HOST:PORT,HOST:PORT`` for an

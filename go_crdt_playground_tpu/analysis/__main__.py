@@ -64,6 +64,10 @@ LOCK_TARGETS = ["net/peer.py", "net/antientropy.py", "net/digestsync.py",
                 # whose compiled-program caches and re-pin paths run
                 # under the node lock like every other state mutation
                 "parallel/meshtarget.py",
+                # the 2-D dp×mp tier (ISSUE 15): its striping planner
+                # and chunked apply loop run under the node lock; the
+                # stripe/program caches follow the 1-D discipline
+                "parallel/meshtarget2d.py",
                 # the fleet autopilot (ISSUE 12): the controller loop
                 # thread owns most state (race-ok-annotated), but the
                 # signal poller, standby pool and actuator cross the
@@ -92,7 +96,8 @@ PURITY_TARGETS = ["ops/merge.py", "ops/delta.py", "ops/lattices.py",
                   "ops/vv.py", "ops/compact.py", "ops/pallas_merge.py",
                   "ops/pallas_delta.py", "ops/ingest.py",
                   "ops/pallas_ingest.py", "ops/digest.py",
-                  "ops/pallas_digest.py", "parallel/meshtarget.py"]
+                  "ops/pallas_digest.py", "parallel/meshtarget.py",
+                  "parallel/meshtarget2d.py"]
 # attribute-name -> class hints for cross-class lock-order edges
 ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "recorder": "Recorder", "_store": "CheckpointStore",

@@ -320,18 +320,14 @@ def decode_summary(body: bytes, num_elements: int, num_actors: int
 
 
 def node_summary(node, group_size: int = DIGEST_GROUP_LANES) -> bytes:
-    """This node's current digest summary frame body.  The state
-    reference is snapshotted under the node lock; the digest kernel
-    runs OUTSIDE it (states are immutable pytrees), so a summary never
-    holds the lock across a dispatch."""
-    import jax
-
-    with node._lock:
-        me = jax.tree.map(lambda x: x[0], node._state)
-    digests = np.asarray(node._digest_fn(me, group_size))
+    """This node's current digest summary frame body.  The array read
+    is the node's ``digest_summary_arrays`` hook — the base ``Node``
+    snapshots the state reference under the lock and digests outside
+    it; mesh targets run ONE collective dispatch instead of slicing
+    every field eagerly (the MESH_CURVE digest-fall-off fix)."""
+    vv, processed, digests = node.digest_summary_arrays(group_size)
     return encode_summary(node.actor, node.num_elements, group_size,
-                          np.asarray(me.vv), np.asarray(me.processed),
-                          digests)
+                          vv, processed, digests)
 
 
 def warm(node, group_size: int = DIGEST_GROUP_LANES) -> None:

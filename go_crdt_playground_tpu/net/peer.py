@@ -662,6 +662,23 @@ class Node:
             self._digest_regime = digest_regime(self.num_elements)
         return self._digest_regime(state_slice, group_size)
 
+    def digest_summary_arrays(self, group_size: int):
+        """The digest-summary read's ``(vv, processed, digests)``
+        triple — the array half of ``net/digestsync.node_summary``
+        (the codec half stays there).  Split out as a replica-flavor
+        hook: this base form snapshots the state reference under the
+        lock and runs the digest kernel outside it; the mesh targets
+        override it with a one-dispatch collective read that never
+        materializes the per-field ``x[0]`` slices
+        (parallel/meshtarget.py ``build_mesh_summary`` — the
+        MESH_CURVE digest-fall-off fix)."""
+        import jax
+
+        with self._lock:
+            me = jax.tree.map(lambda x: x[0], self._state)
+        digests = np.asarray(self._digest_fn(me, group_size))
+        return np.asarray(me.vv), np.asarray(me.processed), digests
+
     def note_peer_processed(self, src_actor: int, processed) -> None:
         """Record a peer's advertised causal-stability vector — the
         ``_apply_payload`` GC bookkeeping, callable WITHOUT a payload:

@@ -501,6 +501,63 @@ def dissemination_offsets(num_replicas: int):
     return offs
 
 
+def disjoint_update_join(local, base, axis_name: str, num_shards: int):
+    """Converge per-device copies of a REPLICATED state whose devices
+    applied KEY-DISJOINT updates, via dissemination-doubling ring
+    rounds over ``axis_name`` — the 2-D serve mesh's dp-axis
+    convergence (parallel/meshtarget2d.py): each dp replica applies
+    its own stripe of a super-batch, then ceil(log2 dp) ring rounds
+    (offsets 1, 2, 4, ... — the ``dissemination_offsets`` schedule,
+    realized as ``ppermute`` neighbor exchanges under shard_map) leave
+    every replica holding the exact join.
+
+    The join rule leans on the striping invariant instead of the
+    general merge kernel: every lane was updated by AT MOST ONE
+    replica (the batcher's key-disjoint stripes), so "partner's lane
+    differs from the shared pre-update ``base``" identifies the unique
+    writer and a plain select reconstructs the sequential result
+    BITWISE — dots included, which the general full-merge rule cannot
+    promise (its both-present overwrite is order-sensitive).  Clocks
+    join elementwise (vv/processed are monotone counters, max IS their
+    join).  Overlapping dissemination windows are safe: two rounds
+    that both carry a lane carry the identical value (unique writer),
+    so the select is idempotent.
+
+    Must run inside ``shard_map`` with ``axis_name`` bound; ``local``
+    and ``base`` are single-replica slices (fields [E_loc]/[A]).
+    """
+    from go_crdt_playground_tpu.models.layout import (ACTOR_AXIS_FIELDS,
+                                                      REPLICA_ONLY_FIELDS)
+
+    if num_shards == 1:
+        return local
+    clock_fields = set(ACTOR_AXIS_FIELDS) | set(REPLICA_ONLY_FIELDS)
+    lane_fields = [f for f in type(local)._fields
+                   if f not in clock_fields]
+
+    def lane_diff(candidate):
+        d = None
+        for f in lane_fields:
+            neq = getattr(candidate, f) != getattr(base, f)
+            d = neq if d is None else (d | neq)
+        return d
+
+    for off in dissemination_offsets(num_shards):
+        pairs = [((d + off) % num_shards, d) for d in range(num_shards)]
+        partner = jax.tree.map(
+            lambda x: jax.lax.ppermute(x, axis_name, pairs), local)
+        take = lane_diff(partner)
+        updates = {f: jnp.where(take, getattr(partner, f),
+                                getattr(local, f))
+                   for f in lane_fields}
+        for f in ACTOR_AXIS_FIELDS:
+            if f in type(local)._fields:
+                updates[f] = jnp.maximum(getattr(local, f),
+                                         getattr(partner, f))
+        local = local._replace(**updates)
+    return local
+
+
 @functools.partial(jax.jit, static_argnames=("delta", "delta_semantics"))
 def all_pairs_converge(state, delta: bool = False,
                        delta_semantics: str = "v2"):

@@ -35,6 +35,23 @@ REPLICA_AXIS = "replica"
 ELEMENT_AXIS = "element"
 
 
+def take_devices(num_devices: Optional[int] = None) -> list:
+    """The first ``num_devices`` devices in jax's stable enumeration
+    (default: all), with the shared bounds check — every serve-tier
+    mesh builder (the 1-D ``"batch"`` mesh and the 2-D ``("dp", "mp")``
+    mesh) slices its device set through here so restarts of the same
+    topology place shards identically and the CPU-testing hint lives
+    in ONE error message."""
+    devices = jax.devices()
+    n = len(devices) if num_devices is None else int(num_devices)
+    if not 1 <= n <= len(devices):
+        raise ValueError(
+            f"mesh wants {n} devices; {len(devices)} visible "
+            f"(CPU runs force more via "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    return list(devices[:n])
+
+
 def make_mesh(mesh_shape: Optional[Tuple[int, int]] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build a (replica_shards, element_shards) mesh.  Default: all devices

@@ -68,10 +68,11 @@ from go_crdt_playground_tpu.net.peer import Node
 from go_crdt_playground_tpu.ops.delta import DeltaPayload, delta_extract
 from go_crdt_playground_tpu.parallel.gossip import _shard_map
 
-# the serve tier's mesh is 1-D on purpose (the SNIPPETS exemplar): lane
-# parallelism is the only axis a single replica needs — dp x mp meshes
-# (replicas x lanes) compose later by pairing this with the existing
-# parallel/mesh.py replica-axis layout (ROADMAP).
+# the serve tier's original mesh is 1-D: lane parallelism is the only
+# axis a single replica needs.  The 2-D ("dp", "mp") composition —
+# replicated ingest stripes over lane shards — lives in
+# parallel/meshtarget2d.py and reuses this module's lane-axis layout
+# with MP_AXIS as the lane axis.
 BATCH_AXIS = "batch"
 
 
@@ -79,32 +80,35 @@ def make_batch_mesh(num_devices: Optional[int] = None) -> Mesh:
     """A 1-D ``"batch"`` mesh over the first ``num_devices`` devices
     (default: all).  Device order is jax's stable enumeration, so every
     restart of the same topology places shards identically."""
-    devices = jax.devices()
-    n = len(devices) if num_devices is None else int(num_devices)
-    if not 1 <= n <= len(devices):
-        raise ValueError(
-            f"mesh wants {n} devices; {len(devices)} visible "
-            f"(CPU runs force more via "
-            f"XLA_FLAGS=--xla_force_host_platform_device_count=N)")
-    return Mesh(np.asarray(devices[:n]), (BATCH_AXIS,))
+    from go_crdt_playground_tpu.parallel.mesh import take_devices
+
+    return Mesh(np.asarray(take_devices(num_devices)), (BATCH_AXIS,))
 
 
-def state_partition_specs(state_cls):
+def state_partition_specs(state_cls, lane_axis: str = BATCH_AXIS):
     """PartitionSpecs for a FULL ``(R=1, ...)``-shaped state pytree:
-    lane fields shard their trailing E axis over the mesh; the actor-
-    axis clocks and the actor id replicate (models/layout.py is the
-    shared field-role table)."""
+    lane fields shard their trailing E axis over ``lane_axis``; the
+    actor-axis clocks and the actor id replicate (models/layout.py is
+    the shared field-role table).  The 2-D tier passes its ``"mp"``
+    axis — any mesh axis NOT named here replicates, which is exactly
+    how the dp ingest replicas share one logical state."""
     return state_cls(**{
         name: (P(None) if name in REPLICA_ONLY_FIELDS
                else P(None, None) if name in ACTOR_AXIS_FIELDS
-               else P(None, BATCH_AXIS))
+               else P(None, lane_axis))
         for name in state_cls._fields})
 
 
-_PAYLOAD_SPECS = DeltaPayload(
-    src_vv=P(None), changed=P(BATCH_AXIS), ch_da=P(BATCH_AXIS),
-    ch_dc=P(BATCH_AXIS), deleted=P(BATCH_AXIS), del_da=P(BATCH_AXIS),
-    del_dc=P(BATCH_AXIS), src_actor=P(), src_processed=P(None))
+def payload_partition_specs(lane_axis: str = BATCH_AXIS) -> DeltaPayload:
+    """PartitionSpecs for a single-replica ``DeltaPayload``: lane
+    sections shard over ``lane_axis``, clocks replicate."""
+    return DeltaPayload(
+        src_vv=P(None), changed=P(lane_axis), ch_da=P(lane_axis),
+        ch_dc=P(lane_axis), deleted=P(lane_axis), del_da=P(lane_axis),
+        del_dc=P(lane_axis), src_actor=P(), src_processed=P(None))
+
+
+_PAYLOAD_SPECS = payload_partition_specs(BATCH_AXIS)
 
 
 # Compiled mesh programs, memoized at MODULE level by (device ids,
@@ -125,14 +129,22 @@ _PROGRAM_CACHE: dict = {}
 # ---------------------------------------------------------------------------
 
 
-def _mesh_add_row(st, row, base_off, total):
+def _mesh_add_row(st, row, base_off, total, base=None):
     """One Add(k...) row on THIS SHARD's lanes.  ``base_off`` is the
     count of touched lanes in shards left of this one (host-built
     exclusive prefix), ``total`` the row's global touched count — with
     those replicated-in, the dot positions need only a LOCAL cumsum and
-    come out bitwise equal to ``ops/ingest._apply_add_row``'s."""
+    come out bitwise equal to ``ops/ingest._apply_add_row``'s.
+
+    ``base`` overrides the clock read: the 2-D tier's striped stripes
+    pass the row's ABSOLUTE pre-row counter (host-precomputed global
+    prefix over the whole super-batch) so rows interleaved across dp
+    replicas land the exact counters the sequential kernel assigns;
+    ``None`` (the 1-D path) reads the replica clock — within one
+    sequential stripe the two are the same number."""
     a = st.actor.astype(jnp.int32)
-    base = st.vv[a]
+    if base is None:
+        base = st.vv[a]
     pos1 = (jnp.cumsum(row.astype(jnp.uint32)) + base_off) * row
     new_vv = base + total
     return st._replace(
@@ -144,12 +156,16 @@ def _mesh_add_row(st, row, base_off, total):
     )
 
 
-def _mesh_del_row(st, row, tick):
+def _mesh_del_row(st, row, tick, base=None):
     """One Del(k...) row on this shard's lanes; ``tick`` (0/1, host-
     computed ``any(row)`` over the GLOBAL row) replaces the kernel's
-    cross-lane ``jnp.any`` — ``ops/ingest._apply_del_row`` otherwise."""
+    cross-lane ``jnp.any`` — ``ops/ingest._apply_del_row`` otherwise.
+    ``base`` as in ``_mesh_add_row``: the absolute post-add counter of
+    this row when striped (None = read the clock)."""
     a = st.actor.astype(jnp.int32)
-    new_counter = st.vv[a] + tick
+    if base is None:
+        base = st.vv[a]
+    new_counter = base + tick
     hit = row & st.present
     return st._replace(
         vv=st.vv.at[a].set(new_counter),
@@ -217,28 +233,32 @@ def build_mesh_ingest(mesh: Mesh, state_cls, with_delta: bool):
     return fn
 
 
-def build_mesh_digests(mesh: Mesh, num_elements: int, group_size: int):
+def build_mesh_digests(mesh: Mesh, num_elements: int, group_size: int,
+                       lane_axis: str = BATCH_AXIS):
     """The collective summary read: per-shard ``ops/digest`` lane
     fingerprints (GLOBAL lane ids via ``axis_index`` so the fold is
     comparison-stable across placements) XOR-folded into group digests
     shard-locally and concatenated along the mesh — bitwise equal to
     ``ops/digest.state_group_digests`` whenever group boundaries align
     with shard boundaries (the caller checks divisibility and falls
-    back to the GSPMD pass otherwise)."""
+    back to the GSPMD pass otherwise).  ``lane_axis`` names the mesh
+    axis the lanes shard over (the 2-D tier's ``"mp"``); any other
+    mesh axis replicates the read."""
     from go_crdt_playground_tpu.ops import digest as digest_ops
 
-    n = mesh.shape[BATCH_AXIS]
+    n = mesh.shape[lane_axis]
     e_loc = num_elements // n
     if e_loc % group_size or num_elements % n:
         raise ValueError("shard/group boundary mismatch")
     key = ("digests", tuple(d.id for d in mesh.devices.flat),
-           num_elements, group_size)
+           tuple(mesh.shape.items()), lane_axis, num_elements,
+           group_size)
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached
 
     def body(present, deleted, del_da, del_dc):
-        lane0 = jax.lax.axis_index(BATCH_AXIS).astype(jnp.uint32) \
+        lane0 = jax.lax.axis_index(lane_axis).astype(jnp.uint32) \
             * jnp.uint32(e_loc)
         ids = lane0 + jnp.arange(e_loc, dtype=jnp.uint32)
         fp = digest_ops.lane_fingerprint_arrays(ids, present, deleted,
@@ -246,8 +266,39 @@ def build_mesh_digests(mesh: Mesh, num_elements: int, group_size: int):
         return digest_ops.group_fold(fp, group_size)
 
     fn = jax.jit(_shard_map(body, mesh=mesh,
-                            in_specs=(P(BATCH_AXIS),) * 4,
-                            out_specs=P(BATCH_AXIS), check_vma=False))
+                            in_specs=(P(lane_axis),) * 4,
+                            out_specs=P(lane_axis), check_vma=False))
+    _PROGRAM_CACHE[key] = fn
+    return fn
+
+
+def build_mesh_summary(mesh: Mesh, num_elements: int, group_size: int,
+                       lane_axis: str = BATCH_AXIS):
+    """The WHOLE digest-summary read as ONE compiled program over the
+    node's resident ``(1, ...)``-shaped state arrays: leading-axis
+    squeeze + per-shard fingerprints + group fold + the clock reads,
+    returning ``(digests, vv, processed)``.  This is the re-gather fix
+    for the MESH_CURVE digest fall-off (ISSUE 15): the summary path
+    used to eagerly slice ``x[0]`` off all NINE state fields — nine
+    per-device dispatch rounds whose cost grew monotonically with mesh
+    width (0.63→7.0 ms across 1→8 forced host devices) before the
+    digest program even ran.  One program, one digest device_get, two
+    replicated A-word clock pulls."""
+    digests_fn = build_mesh_digests(mesh, num_elements, group_size,
+                                    lane_axis)
+    key = ("summary", tuple(d.id for d in mesh.devices.flat),
+           tuple(mesh.shape.items()), lane_axis, num_elements,
+           group_size)
+    cached = _PROGRAM_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    @jax.jit
+    def fn(present, deleted, del_da, del_dc, vv, processed):
+        return (digests_fn(present[0], deleted[0], del_da[0],
+                           del_dc[0]),
+                vv[0], processed[0])
+
     _PROGRAM_CACHE[key] = fn
     return fn
 
@@ -289,22 +340,29 @@ class MeshApplyTarget(Node):
     def __init__(self, actor: int, num_elements: int, num_actors: int,
                  mesh_devices: Optional[int] = None, **node_kwargs):
         super().__init__(actor, num_elements, num_actors, **node_kwargs)
-        self._mesh = make_batch_mesh(mesh_devices)
+        self._mesh = self._build_mesh(mesh_devices)
         # race-ok: read-only configuration after __init__
-        self.mesh_devices = int(self._mesh.shape[BATCH_AXIS])
-        if num_elements % self.mesh_devices:
+        self.mesh_devices = int(self._mesh.devices.size)
+        # lane shards = the extent of the lane axis (for this 1-D tier
+        # that IS the device count; the 2-D tier's mp extent)
+        # race-ok: read-only configuration after __init__
+        self.lane_shards = int(self._mesh.shape[self.LANE_AXIS])
+        if num_elements % self.lane_shards:
             raise ValueError(
                 f"element universe E={num_elements} must divide over "
-                f"the {self.mesh_devices}-device mesh (lane shards are "
+                f"the {self.lane_shards} lane shards (shards are "
                 "equal-sized)")
         # race-ok: read-only configuration after __init__
         self._shardings = jax.tree.map(
             lambda spec: NamedSharding(self._mesh, spec),
-            state_partition_specs(type(self._state)),
+            state_partition_specs(type(self._state), self.LANE_AXIS),
             is_leaf=lambda x: isinstance(x, P))
         # (group_size -> fn) collective digest programs
         # race-ok: idempotent lazy init (same program either way)
         self._mesh_digests = {}
+        # (group_size -> fn) one-dispatch summary programs
+        # race-ok: idempotent lazy init (same program either way)
+        self._mesh_summary = {}
         # ``_lock`` is inherited, so this __init__ gets no implicit
         # hold from the lint's pre-sharing rule — take it for real
         with self._lock:
@@ -312,6 +370,12 @@ class MeshApplyTarget(Node):
             # δ-less one only exists for WAL-less runs)
             self._mesh_ingest = {}  # guarded-by: _lock
             self._repin_state()
+
+    def _build_mesh(self, mesh_devices):
+        """The mesh-construction hook: this tier builds the 1-D
+        ``"batch"`` lane mesh; ``Mesh2DApplyTarget`` overrides it with
+        the ``("dp", "mp")`` serve mesh."""
+        return make_batch_mesh(mesh_devices)
 
     # -- placement ----------------------------------------------------------
 
@@ -331,7 +395,7 @@ class MeshApplyTarget(Node):
     def _apply_batch_locked(self, add_rows: np.ndarray,
                             del_rows: np.ndarray, live: np.ndarray,
                             pre_vv: Optional[np.ndarray]) -> None:
-        n = self.mesh_devices
+        n = self.lane_shards
         B = add_rows.shape[0]
         # host-side prefix data: the ONLY cross-shard facts of the row
         # algebra, computed from the selector masks the batcher already
@@ -364,6 +428,11 @@ class MeshApplyTarget(Node):
 
     # -- read path (summary-first) ------------------------------------------
 
+    # the mesh axis lane fields shard over — the 2-D subclass
+    # (parallel/meshtarget2d.py) overrides it with its "mp" axis and
+    # every collective read below follows
+    LANE_AXIS = BATCH_AXIS
+
     def _digest_fn(self, state_slice, group_size):
         """Collective group digests: shard-local fingerprint+fold when
         shard and group boundaries align (the common case — group size
@@ -374,7 +443,7 @@ class MeshApplyTarget(Node):
         if fn is None:
             try:
                 fn = build_mesh_digests(self._mesh, self.num_elements,
-                                        group_size)
+                                        group_size, self.LANE_AXIS)
             except ValueError:
                 fn = False  # boundary mismatch: remember the fallback
             self._mesh_digests[group_size] = fn
@@ -402,6 +471,35 @@ class MeshApplyTarget(Node):
         if group_size is None:
             group_size = DIGEST_GROUP_LANES
         return digestsync.node_summary(self, group_size)
+
+    def digest_summary_arrays(self, group_size: int):
+        """The summary read's array triple ``(vv, processed, digests)``
+        as ONE compiled dispatch over the resident sharded state (see
+        ``build_mesh_summary``) — overriding ``Node``'s default, which
+        eagerly slices ``x[0]`` off every state field (nine per-device
+        dispatch rounds before the digest program runs; the measured
+        MESH_CURVE digest fall-off).  The misaligned-boundary config
+        keeps the base fallback."""
+        fn = self._mesh_summary.get(group_size)
+        if fn is None:
+            try:
+                fn = build_mesh_summary(self._mesh, self.num_elements,
+                                        group_size, self.LANE_AXIS)
+            except ValueError:
+                fn = False  # boundary mismatch: remember the fallback
+            self._mesh_summary[group_size] = fn
+        if fn is False:
+            return super().digest_summary_arrays(group_size)
+        with self._lock:
+            state = self._state
+        digests, vv, processed = fn(state.present, state.deleted,
+                                    state.del_dot_actor,
+                                    state.del_dot_counter, state.vv,
+                                    state.processed)
+        digests, vv, processed = jax.device_get(
+            (digests, vv, processed))
+        return (np.asarray(vv), np.asarray(processed),
+                np.asarray(digests))
 
     # -- payload / recovery paths (GSPMD + re-pin) --------------------------
 

@@ -1,0 +1,376 @@
+"""2-D ``("dp", "mp")`` serve mesh: replicated ingest stripes over
+lane-sharded state in one process (ISSUE 15, DESIGN.md §24).
+
+PR 10's ``parallel/meshtarget.py`` shards one replica's lane axis over
+a 1-D device mesh — state capacity scales with devices, but batch
+throughput is pinned to ONE micro-batch per dispatch.  This module
+composes the second axis (the SNIPPETS.md pjit dp×mp exemplar shape):
+lane fields shard their trailing E over ``mp``; the ``dp`` axis holds
+REPLICATED copies of that sharded state, and each dp replica applies
+its own STRIPE of a super-batch concurrently, so one
+``serve --mesh-devices DPxMP`` process applies up to dp micro-batches
+per dispatch at mp× the per-device state capacity.
+
+The parity contract (the hard part and the point) is BITWISE — state,
+dots, WAL record bytes — against the 1-D worker fed the same op log.
+Three mechanisms together make that exact rather than eventual:
+
+1. **Key-disjoint striping** (``plan_stripes``).  The host packs ops
+   into up to dp stripes such that no element key is touched by two
+   stripes of one super-batch; an op whose keys span two stripes CUTS
+   the super-batch (the remainder dispatches next, in order).  Each
+   lane therefore has at most ONE writer per dispatch, which is what
+   turns the dp join below into an exact select instead of a merge.
+2. **Absolute counter bases.**  The row algebra's only cross-row
+   couplings are clock prefix sums; the host precomputes every row's
+   GLOBAL pre-row counter offset over the super-batch (replica-
+   independent by construction — the ROADMAP seam), so rows
+   interleaved across stripes assign the exact dot/deletion counters
+   the sequential kernel assigns.  Striping changes WHERE a row runs,
+   never WHAT it writes.
+3. **Dissemination join over dp** (``gossip.disjoint_update_join``).
+   After the stripes apply, ceil(log2 dp) ring rounds (the gossip
+   dissemination-offset schedule, ``ppermute`` under shard_map) leave
+   every dp replica holding the unique-writer select of all stripes —
+   bitwise the sequential post-state, dots included (a general merge
+   could not promise that: its both-present rule is order-sensitive).
+   Replicas CONVERGE INSIDE every dispatch, so the replicated
+   ``NamedSharding`` invariant holds at every read point and QUERY /
+   DSUM / slice extraction see the joined replica by construction —
+   no read-side reduce over dp is needed.
+
+The batch δ for the WAL record is ``delta_extract`` of the joined
+state against the pre-batch vv, in the same dispatch — identical
+payload, identical record bytes (single-chunk batches) to the 1-D and
+single-device paths.  A key-conflicted super-batch logs one record per
+chunk; replay composes them in order, so durability semantics are
+unchanged (the records ride the same causal guard).
+
+Everything else — WAL/checkpoints, anti-entropy, digest summaries,
+compaction, resharding slice transfer, the serve frontend — runs
+UNCHANGED: this is a ``MeshApplyTarget`` whose lane axis is ``mp``,
+and every collective read follows ``LANE_AXIS``.
+
+CPU testing: ``XLA_FLAGS=--xla_force_host_platform_device_count=8``
+(the root conftest.py forces it) gives dp×mp ≤ 8 real coverage;
+``serve --mesh-devices DPxMP`` is the CLI wiring.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from go_crdt_playground_tpu.parallel.gossip import (_shard_map,
+                                                    disjoint_update_join)
+from go_crdt_playground_tpu.parallel.meshtarget import (
+    _PROGRAM_CACHE, MeshApplyTarget, _mesh_add_row, _mesh_del_row,
+    payload_partition_specs, state_partition_specs)
+from go_crdt_playground_tpu.ops.delta import delta_extract
+
+DP_AXIS = "dp"
+MP_AXIS = "mp"
+
+MeshSpec = Union[int, Tuple[int, int], str]
+
+
+def parse_mesh_spec(spec: MeshSpec):
+    """Normalize a ``--mesh-devices`` value: ``"N"``/``N`` stays an int
+    (the 1-D lane mesh), ``"DPxMP"``/``(dp, mp)`` becomes a 2-tuple
+    (this module's mesh).  Raises ``ValueError`` with an operator-
+    grade message on anything else — the serve CLI converts it to a
+    typed argparse error (the ``--gc-participants`` precedent)."""
+    one_d = False
+    if isinstance(spec, (tuple, list)):
+        if len(spec) != 2:
+            raise ValueError(
+                f"mesh spec {spec!r}: expected (dp, mp)")
+        dp, mp = int(spec[0]), int(spec[1])
+    elif isinstance(spec, int):
+        one_d, dp, mp = True, 1, int(spec)
+    else:
+        text = str(spec).strip().lower()
+        head, sep, tail = text.partition("x")
+        if not head.isdigit() or (sep and not tail.isdigit()):
+            raise ValueError(
+                f"mesh spec {spec!r}: expected N (1-D lane mesh) or "
+                "DPxMP (2-D replicated-ingest mesh), e.g. 8 or 2x4")
+        if not sep:
+            one_d, dp, mp = True, 1, int(head)
+        else:
+            dp, mp = int(head), int(tail)
+    if dp < 1 or mp < 1:
+        raise ValueError(
+            f"mesh spec {spec!r}: every mesh extent must be >= 1")
+    return int(mp) if one_d else (dp, mp)
+
+
+def make_serve_mesh(dp: int, mp: int) -> Mesh:
+    """The 2-D ``("dp", "mp")`` serve mesh over the first dp*mp devices
+    in jax's stable enumeration — restarts of one topology place
+    shards identically (the make_batch_mesh discipline)."""
+    from go_crdt_playground_tpu.parallel.mesh import take_devices
+
+    devices = take_devices(dp * mp)
+    return Mesh(np.asarray(devices).reshape(dp, mp), (DP_AXIS, MP_AXIS))
+
+
+# ---------------------------------------------------------------------------
+# Host-side striping: key-disjoint stripes with global counter prefixes
+# ---------------------------------------------------------------------------
+
+
+class StripePlan:
+    """One dispatch's packed stripes (all arrays ready for the mesh
+    program; counter offsets are ABSOLUTE over the chunk's global row
+    order — see the module docstring)."""
+
+    __slots__ = ("add", "dl", "prefix", "add_total", "del_tick",
+                 "rows", "stripes_used")
+
+    def __init__(self, add, dl, prefix, add_total, del_tick, rows,
+                 stripes_used):
+        self.add = add                  # bool[dp, cap, E]
+        self.dl = dl                    # bool[dp, cap, E]
+        self.prefix = prefix            # uint32[dp, cap] pre-row ticks
+        self.add_total = add_total      # uint32[dp, cap]
+        self.del_tick = del_tick        # uint32[dp, cap]
+        self.rows = rows                # keyed rows packed this chunk
+        self.stripes_used = stripes_used
+
+
+def plan_stripes(add_rows: np.ndarray, del_rows: np.ndarray,
+                 live: np.ndarray, dp: int, cap: int
+                 ) -> Tuple[List[StripePlan], int]:
+    """Greedy order-preserving striping of one ``(B, E)`` op-batch
+    into chunks of ≤ dp key-disjoint stripes of ≤ ``cap`` rows each.
+
+    Rows are considered in batch order (the op-log order the sequential
+    kernel applies).  A row lands in the stripe already owning one of
+    its keys, or the least-loaded stripe when its keys are unowned.  A
+    row whose keys span TWO stripes — or whose target stripe is full —
+    cuts the chunk: everything before it dispatches now, it and every
+    later row re-stripe fresh.  Cutting (never reordering) is what
+    keeps the global counter prefixes, and therefore the assigned
+    dots, bitwise the sequential kernel's.  Dead/empty rows are
+    dropped (they are padding: no tick, no lanes — the sequential
+    kernel's masked no-op).
+
+    Returns ``(plans, cuts)``.  An all-padding batch yields one empty
+    plan, so the caller still runs one dispatch and logs one (empty)
+    WAL record — byte-compatible with the single-device path.
+    """
+    B, E = add_rows.shape
+    eff_add = add_rows & live[:, None]
+    eff_del = del_rows & live[:, None]
+    keyed = [r for r in range(B)
+             if eff_add[r].any() or eff_del[r].any()]
+    plans: List[StripePlan] = []
+    cuts = 0
+    i = 0
+    while True:
+        key_owner = np.full(E, -1, np.int32)
+        loads = np.zeros(dp, np.int64)
+        stripe_rows: List[List[int]] = [[] for _ in range(dp)]
+        chunk: List[int] = []
+        while i < len(keyed):
+            r = keyed[i]
+            keys = np.flatnonzero(eff_add[r] | eff_del[r])
+            owners = np.unique(key_owner[keys])
+            owners = owners[owners >= 0]
+            if owners.size > 1:
+                cuts += 1
+                break  # cross-stripe keys: serialize at the cut
+            s = int(owners[0]) if owners.size else int(np.argmin(loads))
+            if loads[s] >= cap:
+                cuts += 1
+                break  # stripe full: the remainder dispatches next
+            stripe_rows[s].append(r)
+            chunk.append(r)
+            loads[s] += 1
+            key_owner[keys] = s
+            i += 1
+        # global counter prefixes over the chunk, in batch order
+        add = np.zeros((dp, cap, E), bool)
+        dl = np.zeros((dp, cap, E), bool)
+        add_total = np.zeros((dp, cap), np.uint32)
+        del_tick = np.zeros((dp, cap), np.uint32)
+        row_prefix = {}
+        run = 0
+        for r in chunk:
+            row_prefix[r] = run
+            run += int(eff_add[r].sum()) + int(eff_del[r].any())
+        # padding slots carry the end-of-chunk prefix: their (no-op)
+        # clock writes land ≤ the chunk's final counter, and the vv
+        # join's elementwise max recovers the exact final value
+        prefix = np.full((dp, cap), run, np.uint32)
+        for s, rlist in enumerate(stripe_rows):
+            for j, r in enumerate(rlist):
+                add[s, j] = eff_add[r]
+                dl[s, j] = eff_del[r]
+                prefix[s, j] = row_prefix[r]
+                add_total[s, j] = eff_add[r].sum()
+                del_tick[s, j] = bool(eff_del[r].any())
+        plans.append(StripePlan(add, dl, prefix, add_total, del_tick,
+                                len(chunk),
+                                int(sum(1 for x in stripe_rows if x))))
+        if i >= len(keyed):
+            return plans, cuts
+
+
+# ---------------------------------------------------------------------------
+# The one-dispatch 2-D program
+# ---------------------------------------------------------------------------
+
+
+def build_mesh2d_ingest(mesh: Mesh, state_cls, with_delta: bool):
+    """Compile the 2-D super-batch apply: full ``(1, ...)`` state in
+    (lane fields mp-sharded, replicated over dp), merged state (+ the
+    super-batch δ vs the pre-batch vv when ``with_delta``) out.  Per
+    (dp, mp) device: scan THIS stripe's rows over THIS lane shard with
+    the host's absolute counter bases, then the dp dissemination join
+    (gossip.disjoint_update_join) converges the stripes in-dispatch —
+    the output honestly satisfies its replicated-over-dp out_spec.
+    Memoized in the shared ``_PROGRAM_CACHE``."""
+    dp = mesh.shape[DP_AXIS]
+    # the mesh SHAPE is part of the key: one device set factors as
+    # (2, 2) or (1, 4) with identical flat ids but different programs
+    key = ("ingest2d", tuple(d.id for d in mesh.devices.flat),
+           (dp, mesh.shape[MP_AXIS]), state_cls, bool(with_delta))
+    cached = _PROGRAM_CACHE.get(key)
+    if cached is not None:
+        return cached
+    st_specs = state_partition_specs(state_cls, MP_AXIS)
+
+    def body(state, add, dl, prefix, add_base, add_total, del_tick):
+        st = jax.tree.map(lambda x: x[0], state)
+        pre_vv = st.vv
+        a = st.actor.astype(jnp.int32)
+        pre_ctr = pre_vv[a]
+
+        def step(s, x):
+            add_row, del_row, pre, base_off, a_tot, d_tick = x
+            s = _mesh_add_row(s, add_row, base_off, a_tot,
+                              base=pre_ctr + pre)
+            s = _mesh_del_row(s, del_row, d_tick,
+                              base=pre_ctr + pre + a_tot)
+            return s, None
+
+        stripe, _ = jax.lax.scan(
+            step, st, (add[0], dl[0], prefix[0], add_base[0, :, 0],
+                       add_total[0], del_tick[0]))
+        joined = disjoint_update_join(stripe, st, DP_AXIS, dp)
+        full = jax.tree.map(lambda r: r[None], joined)
+        if not with_delta:
+            return full
+        return full, delta_extract(joined, pre_vv)
+
+    in_specs = (st_specs,
+                P(DP_AXIS, None, MP_AXIS),   # add stripes
+                P(DP_AXIS, None, MP_AXIS),   # del stripes
+                P(DP_AXIS, None),            # absolute row prefixes
+                P(DP_AXIS, None, MP_AXIS),   # per-(row, mp) base offs
+                P(DP_AXIS, None),            # per-row add totals
+                P(DP_AXIS, None))            # per-row del ticks
+    out_specs = ((st_specs, payload_partition_specs(MP_AXIS))
+                 if with_delta else st_specs)
+    # check_vma=False for the same reason as the 1-D program, plus the
+    # join's replication-by-construction claim: after the dissemination
+    # rounds every dp replica holds the identical joined state (the
+    # unique-writer select), which the static checker cannot see
+    # through ppermute — the bitwise pins vs the sequential kernel are
+    # the actual correctness gate (tests/test_meshtarget.py)
+    fn = jax.jit(_shard_map(body, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, check_vma=False))
+    _PROGRAM_CACHE[key] = fn
+    return fn
+
+
+class Mesh2DApplyTarget(MeshApplyTarget):
+    """A ``Node`` serving dp replicated ingest stripes over mp lane
+    shards (module docstring).  Drop-in for every Node role; the
+    ``(1, N)`` and ``(N, 1)`` degenerate meshes are bitwise the 1-D
+    mesh / single-device paths (pinned in tests/test_meshtarget.py).
+
+    ``ingest_stripes`` is the serve batcher's width multiplier: the
+    micro-batcher packs up to ``dp * max_batch`` admitted ops per
+    super-batch (serve/batcher.py), which is where the dp× throughput
+    comes from — more rows per dispatch, one WAL fsync per chunk.
+    """
+
+    LANE_AXIS = MP_AXIS
+
+    def __init__(self, actor: int, num_elements: int, num_actors: int,
+                 mesh_shape: MeshSpec = None, **node_kwargs):
+        if node_kwargs.get("delta_semantics", "v2") != "v2":
+            # the in-dispatch δ extraction + record composition lean on
+            # v2's deletion-record join; the serve tier is v2-only
+            # already (compaction, digest sync) — refuse loudly rather
+            # than diverge quietly
+            raise ValueError(
+                "Mesh2DApplyTarget requires delta_semantics='v2'")
+        super().__init__(actor, num_elements, num_actors,
+                         mesh_devices=mesh_shape, **node_kwargs)
+        # race-ok: read-only configuration after __init__
+        self.dp = int(self._mesh.shape[DP_AXIS])
+        self.mp = int(self._mesh.shape[MP_AXIS])
+        # the batcher's width multiplier (serve/apply.py contract)
+        # race-ok: read-only configuration after __init__
+        self.ingest_stripes = self.dp
+
+    def _build_mesh(self, mesh_devices):
+        spec = parse_mesh_spec(mesh_devices if mesh_devices is not None
+                               else (1, 1))
+        if isinstance(spec, int):
+            spec = (1, spec)
+        return make_serve_mesh(*spec)
+
+    # requires-lock: _lock
+    def _apply_batch_locked(self, add_rows: np.ndarray,
+                            del_rows: np.ndarray, live: np.ndarray,
+                            pre_vv: Optional[np.ndarray]) -> None:
+        B = add_rows.shape[0]
+        cap = max(1, -(-B // self.dp))
+        plans, cuts = plan_stripes(add_rows, del_rows, live, self.dp,
+                                   cap)
+        if cuts:
+            self._count("mesh.stripe.cuts", cuts)
+        with_delta = pre_vv is not None
+        fn = self._mesh_ingest.get(with_delta)
+        if fn is None:
+            fn = build_mesh2d_ingest(self._mesh, type(self._state),
+                                     with_delta)
+            self._mesh_ingest[with_delta] = fn
+        for k, plan in enumerate(plans):
+            if k > 0 and with_delta:
+                # chunk k's record compresses against the post-chunk-
+                # (k-1) clock — the same guard discipline as any two
+                # successive batches
+                pre_vv = np.asarray(self._state.vv[0]).copy()
+            dp, cap_ = plan.add.shape[0], plan.add.shape[1]
+            counts = plan.add.reshape(dp, cap_, self.mp, -1).sum(
+                axis=3, dtype=np.uint32)
+            add_base = np.cumsum(counts, axis=2, dtype=np.uint32) \
+                - counts
+            args = (self._state, jnp.asarray(plan.add),
+                    jnp.asarray(plan.dl), jnp.asarray(plan.prefix),
+                    jnp.asarray(add_base), jnp.asarray(plan.add_total),
+                    jnp.asarray(plan.del_tick))
+            self._count("ingest.dispatches")
+            self._count("mesh.stripe.dispatches")
+            if plan.rows:
+                self._count("mesh.stripe.rows", plan.rows)
+                self._count("mesh.stripe.width", plan.stripes_used)
+            if with_delta:
+                self._state, payload = fn(*args)
+                # ONE device→host pull for the chunk's δ pytree; the
+                # record encoder's host-side break-even ladder runs on
+                # numpy, exactly the 1-D path
+                payload = jax.device_get(payload)
+                self._append_delta_record(pre_vv, payload, None)
+            else:
+                self._state = fn(*args)

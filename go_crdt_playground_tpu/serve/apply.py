@@ -28,7 +28,16 @@ import numpy as np
 @runtime_checkable
 class ApplyTarget(Protocol):
     """What the micro-batcher requires of the replica it feeds.
-    ``net/peer.Node`` satisfies it as-is (the local target)."""
+    ``net/peer.Node`` satisfies it as-is (the local target).
+
+    Optional attribute ``ingest_stripes`` (int, default 1): how many
+    micro-batches the target applies CONCURRENTLY per durable group
+    commit.  The batcher multiplies its drain watermark by it, so a
+    target with replicated ingest stripes (the 2-D dp×mp mesh replica,
+    parallel/meshtarget2d.py — ``ingest_stripes == dp``) receives
+    stripes × max_batch rows per ``ingest_batch`` call; the target
+    owns striping them (key-disjoint planning, counter parity) — the
+    batcher only widens the packed arrays."""
 
     num_elements: int
     actor: int

@@ -69,6 +69,15 @@ class MicroBatcher:
         self.target = target
         self.queue = queue
         self.max_batch = max_batch
+        # effective super-batch width: a target serving replicated
+        # ingest stripes (serve/apply.py ``ingest_stripes``; the 2-D
+        # dp×mp mesh replica, parallel/meshtarget2d.py) takes
+        # stripes × max_batch rows per durable group commit — that
+        # multiplier IS the dp throughput axis, so it belongs to the
+        # batcher's drain watermark, not just the kernel
+        # race-ok: read-only after construction
+        self.width = max_batch * max(
+            1, int(getattr(target, "ingest_stripes", 1)))
         self.flush_s = flush_s
         self.idle_wait_s = idle_wait_s
         self.recorder = recorder
@@ -154,7 +163,7 @@ class MicroBatcher:
         raced the stop flag) is applied inline so no admitted op is ever
         silently dropped."""
         while True:
-            batch = self.queue.take_batch(self.max_batch, 0.0, 0.0)
+            batch = self.queue.take_batch(self.width, 0.0, 0.0)
             if not batch:
                 return
             self._apply(batch)
@@ -164,7 +173,7 @@ class MicroBatcher:
     def _loop(self) -> None:
         while not self._stop.is_set():
             batch = self.queue.take_batch(
-                self.max_batch, self.idle_wait_s, self.flush_s)
+                self.width, self.idle_wait_s, self.flush_s)
             if self.recorder is not None:
                 self.recorder.set_gauge("serve.queue.depth",
                                         self.queue.depth())
@@ -196,12 +205,14 @@ class MicroBatcher:
                 live.append(r)
         if not live:
             return
-        # one packed (B, E) pair, B static = max_batch so every
-        # occupancy reuses one compiled program (ops/ingest.ingest_rows)
+        # one packed (B, E) pair, B static = the effective width so
+        # every occupancy reuses one compiled program
+        # (ops/ingest.ingest_rows; the striped 2-D program likewise
+        # compiles once per (dp, width/dp) shape)
         E = self.target.num_elements
-        add_rows = np.zeros((self.max_batch, E), bool)
-        del_rows = np.zeros((self.max_batch, E), bool)
-        live_mask = np.zeros(self.max_batch, bool)
+        add_rows = np.zeros((self.width, E), bool)
+        del_rows = np.zeros((self.width, E), bool)
+        live_mask = np.zeros(self.width, bool)
         for b, r in enumerate(live):
             rows = add_rows if r.kind == protocol.OP_ADD else del_rows
             rows[b, r.elements] = True

@@ -75,18 +75,33 @@ class ServeFrontend:
 
         self.recorder = recorder if recorder is not None else Recorder()
         self.durable_dir = durable_dir
-        # the replica flavor: a plain single-device Node, or the
-        # device-mesh target (parallel/meshtarget.py, DESIGN.md §20)
-        # with the SAME durability/dissemination surface — everything
-        # below this constructor line is flavor-agnostic
+        # the replica flavor: a plain single-device Node, the 1-D
+        # device-mesh target (parallel/meshtarget.py, DESIGN.md §20),
+        # or the 2-D dp×mp replicated-ingest mesh
+        # (parallel/meshtarget2d.py, §24) — all with the SAME
+        # durability/dissemination surface; everything below this
+        # constructor line is flavor-agnostic.  ``mesh_devices``
+        # accepts an int N (1-D), an "N"/"DPxMP" string, or a
+        # (dp, mp) tuple.
         node_cls = Node
         node_kwargs: dict = {}
         if mesh_devices is not None:
-            from go_crdt_playground_tpu.parallel.meshtarget import \
-                MeshApplyTarget
+            from go_crdt_playground_tpu.parallel.meshtarget2d import \
+                parse_mesh_spec
 
-            node_cls = MeshApplyTarget
-            node_kwargs = {"mesh_devices": mesh_devices}
+            spec = parse_mesh_spec(mesh_devices)
+            if isinstance(spec, tuple):
+                from go_crdt_playground_tpu.parallel.meshtarget2d import \
+                    Mesh2DApplyTarget
+
+                node_cls = Mesh2DApplyTarget
+                node_kwargs = {"mesh_shape": spec}
+            else:
+                from go_crdt_playground_tpu.parallel.meshtarget import \
+                    MeshApplyTarget
+
+                node_cls = MeshApplyTarget
+                node_kwargs = {"mesh_devices": spec}
         # the flavor seam, kept for every later scratch construction
         # (_warmup must build the SAME class with the SAME kwargs or
         # it warms a program the serving node never runs)
@@ -376,7 +391,11 @@ class ServeFrontend:
 
         from go_crdt_playground_tpu.utils.wal import DeltaWal
 
-        B, E = self.batcher.max_batch, self.node.num_elements
+        # the batcher's EFFECTIVE width: a striped 2-D replica serves
+        # super-batches of ingest_stripes x max_batch rows — warming
+        # the bare max_batch shape would leave the real serving shape
+        # to compile on the first live super-batch
+        B, E = self.batcher.width, self.node.num_elements
         with tempfile.TemporaryDirectory(prefix="serve-warmup-") as d:
             # same ingest regime as the REAL node: a --no-fused-ingest
             # worker must warm the seed two-dispatch programs, not the

@@ -789,6 +789,10 @@ def test_gate_fast(tmp_path):
     # target's compiled-program caches and re-pin paths run under the
     # node lock across batcher/sync/compaction threads
     assert "MeshApplyTarget" in covered, covered
+    # ... and the 2-D dp×mp tier (the 2-D mesh ISSUE): the striping
+    # planner + chunked apply loop run under the node lock like every
+    # other state mutation
+    assert "Mesh2DApplyTarget" in covered, covered
     # ... and the fleet autopilot (the control-loop ISSUE): the
     # controller loop thread, signal poller, standby pool, actuator,
     # and the per-peer adaptive digest-group tuner are all inside the

@@ -96,12 +96,13 @@ def test_fleet_serve_soak_quick_mode(tmp_path):
 
 @pytest.mark.slow
 def test_fleet_serve_soak_mesh_quick_mode(tmp_path):
-    """The device-mesh soak (--mesh --quick, DESIGN.md §20): real
-    ``serve --mesh-devices`` workers through the router — every op
-    resolves ack-or-typed-reject per device count, lockstep bitwise
-    parity vs a single-device worker on the same op log, and zero
-    acked-op loss across SIGKILL + restore_durable of the mesh
-    worker."""
+    """The device-mesh soak (--mesh --quick, DESIGN.md §20/§24): real
+    ``serve --mesh-devices`` workers (1-D AND the 2-D dp×mp ladder)
+    through the router — every op resolves ack-or-typed-reject per
+    mesh spec, lockstep bitwise parity vs a single-device worker AND
+    vs the 1-D worker on the same op log, rows-per-commit scaling
+    with dp, and zero acked-op loss across SIGKILL + restore_durable
+    of both mesh flavors."""
     import fleet_serve_soak
 
     out = str(tmp_path / "MESH_CURVE.json")
@@ -120,6 +121,24 @@ def test_fleet_serve_soak_mesh_quick_mode(tmp_path):
         # requested mesh width (a silently-single-device worker would
         # make every other assertion vacuous)
         assert leg["worker_banner_mesh"] == str(leg["mesh_devices"])
+
+    curve_2d = artifact["serve_curve_2d"]
+    assert [leg["mesh_devices"] for leg in curve_2d] == ["1x2", "2x2"]
+    for leg in curve_2d:
+        assert leg["unresolved"] == 0, leg
+        assert leg["worker_banner_mesh"] == str(leg["mesh_devices"])
+    # the dp mechanism engaged: rows per durable commit doubled from
+    # the dp=1 leg to the dp=2 leg (each worker's own counters —
+    # weather-proof, unlike cross-worker goodput ratios)
+    rpd = [leg["server_mesh"]["rows_per_dispatch"] for leg in curve_2d]
+    assert rpd[0] > 0 and rpd[-1] > 1.5 * rpd[0], rpd
+
+    parity_2d = artifact["parity_2d"]
+    assert parity_2d["bitwise_equal"], parity_2d
+    assert parity_2d["vs"] == "2"  # the 2-D worker vs the 1-D worker
+    crash_2d = artifact["crash_2d"]
+    assert crash_2d["lost_acked_ops"] == []
+    assert crash_2d["phantom_members"] == []
 
     parity = artifact["parity"]
     assert parity["bitwise_equal"], parity

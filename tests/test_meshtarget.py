@@ -22,6 +22,8 @@ from go_crdt_playground_tpu.net.peer import Node
 from go_crdt_playground_tpu.parallel.meshtarget import (BATCH_AXIS,
                                                         MeshApplyTarget,
                                                         make_batch_mesh)
+from go_crdt_playground_tpu.parallel.meshtarget2d import (
+    DP_AXIS, MP_AXIS, Mesh2DApplyTarget, parse_mesh_spec, plan_stripes)
 
 E, A, B = 1024, 4, 8
 
@@ -286,6 +288,255 @@ def test_single_device_frontend_degenerates_bitwise(tmp_path):
         str(tmp_path / "plain"), node_kwargs={"mesh_devices": 1})
     _assert_states_equal(r_plain.state_slice(), r_mesh.state_slice(),
                          "cross-restore")
+
+
+def _disjoint_batches(rng, n, e=E, bands=B, keys=4):
+    """Key-disjoint op batches (row b draws only from its own lane
+    band): the striping planner packs them into full stripes with
+    zero cuts, so these pin the PARALLEL apply path specifically."""
+    band = e // bands
+    for _ in range(n):
+        add = np.zeros((B, e), bool)
+        dl = np.zeros((B, e), bool)
+        for b in range(B):
+            lanes = b * band + rng.choice(band, size=keys,
+                                          replace=False)
+            add[b, lanes[:keys - 1]] = True
+            dl[b, lanes[keys - 1:]] = True
+        yield add, dl, np.ones(B, bool)
+
+
+# ---------------------------------------------------------------------------
+# the 2-D dp×mp tier (parallel/meshtarget2d.py, DESIGN.md §24)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_mesh_spec():
+    assert parse_mesh_spec("8") == 8
+    assert parse_mesh_spec(4) == 4
+    assert parse_mesh_spec("2x4") == (2, 4)
+    assert parse_mesh_spec((2, 2)) == (2, 2)
+    assert parse_mesh_spec("1X4".lower()) == (1, 4)
+    for bad in ("", "x", "2x", "x4", "0", "0x4", "2x0", "axb", "2x4x2",
+                (0, 4), (2,)):
+        with pytest.raises(ValueError):
+            parse_mesh_spec(bad)
+
+
+def test_plan_stripes_disjoint_and_cuts():
+    """Planner invariants: key-disjoint batches pack into one chunk
+    with balanced stripes; a key shared across rows chains them into
+    ONE stripe; a row bridging two stripes cuts the chunk (order
+    preserved: the cut row leads the next chunk)."""
+    e = 64
+    add = np.zeros((4, e), bool)
+    for b in range(4):
+        add[b, b * 16] = True
+    dl = np.zeros((4, e), bool)
+    live = np.ones(4, bool)
+    plans, cuts = plan_stripes(add, dl, live, dp=2, cap=2)
+    assert len(plans) == 1 and cuts == 0
+    assert plans[0].stripes_used == 2 and plans[0].rows == 4
+    # same key in rows 0 and 2: both must land in one stripe
+    add2 = add.copy()
+    add2[2] = add2[0]
+    plans, cuts = plan_stripes(add2, dl, live, dp=2, cap=3)
+    assert len(plans) == 1 and cuts == 0
+    # rows 0 and 2 share lane 0: exactly one stripe holds lane 0 twice
+    lane0 = plans[0].add[:, :, 0].sum(axis=1)
+    assert sorted(lane0.tolist()) == [0, 2]
+    # bridge: row 2 touches rows 0's and 1's keys -> cut
+    add3 = np.zeros((3, e), bool)
+    add3[0, 0] = True
+    add3[1, 16] = True
+    add3[2, 0] = add3[2, 16] = True
+    plans, cuts = plan_stripes(add3, np.zeros((3, e), bool),
+                               np.ones(3, bool), dp=2, cap=4)
+    assert cuts == 1 and len(plans) == 2
+    assert plans[0].rows == 2 and plans[1].rows == 1
+
+
+@pytest.mark.parametrize("shape", ["1x2", "2x1", "2x2", "4x2", "2x4",
+                                   "8x1", "1x8"])
+def test_mesh2d_bitwise_parity(shape):
+    """The tentpole pin: a striped 2-D target fed the same op log as a
+    plain node lands BITWISE identical — every field, dots included —
+    across degenerate and genuinely 2-D shapes, on random (conflicting)
+    batches that exercise the cut path too."""
+    dp, mp = (int(x) for x in shape.split("x"))
+    if jax.device_count() < dp * mp:
+        pytest.skip(f"needs {dp * mp} devices")
+    rng = np.random.default_rng(21)
+    plain = Node(0, E, A)
+    mesh = Mesh2DApplyTarget(0, E, A, mesh_shape=shape)
+    assert mesh.ingest_stripes == dp
+    for add, dl, live in _random_batches(rng, 4, add_p=0.02):
+        plain.ingest_batch(add, dl, live)
+        mesh.ingest_batch(add, dl, live)
+    # striped (disjoint) batches ride the parallel path specifically
+    for add, dl, live in _disjoint_batches(rng, 2):
+        plain.ingest_batch(add, dl, live)
+        mesh.ingest_batch(add, dl, live)
+    _assert_states_equal(plain.state_slice(), mesh.state_slice(),
+                         f"shape={shape}")
+
+
+def test_mesh2d_wal_byte_identity(tmp_path):
+    """Disjoint batches ⇒ byte-identical WAL records across plain,
+    1-D, and every 2-D shape (one record per batch, identical δ);
+    conflicted batches may SPLIT records (one per chunk) but must
+    REPLAY to the identical state — the durability semantics are the
+    pinned surface, the byte split is the documented cost of a cut."""
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    rng = np.random.default_rng(22)
+    nodes = {
+        "plain": Node(0, E, A, wal=DeltaWal(str(tmp_path / "p"))),
+        "1d": MeshApplyTarget(0, E, A, mesh_devices=4,
+                              wal=DeltaWal(str(tmp_path / "m1"))),
+        "2x2": Mesh2DApplyTarget(0, E, A, mesh_shape="2x2",
+                                 wal=DeltaWal(str(tmp_path / "m22"))),
+        "4x1": Mesh2DApplyTarget(0, E, A, mesh_shape="4x1",
+                                 wal=DeltaWal(str(tmp_path / "m41"))),
+    }
+    for add, dl, live in _disjoint_batches(rng, 3):
+        for n in nodes.values():
+            n.ingest_batch(add, dl, live)
+    recs = {}
+    for name, n in nodes.items():
+        with n._lock:
+            recs[name] = list(n.wal.records())
+    for name in nodes:
+        assert recs[name] == recs["plain"], name
+    assert len(recs["plain"]) == 3
+    # conflicted batch: records may split, replay must converge
+    add = np.zeros((B, E), bool)
+    add[:, 5] = True  # every row touches lane 5: one stripe chain
+    add[0, 100] = add[3, 200] = True
+    for n in nodes.values():
+        n.ingest_batch(add, np.zeros((B, E), bool), np.ones(B, bool))
+    ref = nodes["plain"].state_slice()
+    for name, n in nodes.items():
+        _assert_states_equal(ref, n.state_slice(), f"post-conflict {name}")
+    # replay each WAL into a fresh plain node: identical state again
+    wal_dirs = {"plain": "p", "1d": "m1", "2x2": "m22", "4x1": "m41"}
+    for name, n in nodes.items():
+        with n._lock:
+            n.wal.close()
+        fresh = Node(0, E, A)
+        replayed = fresh.replay_wal(
+            DeltaWal(str(tmp_path / wal_dirs[name])))
+        assert replayed["bad"] == 0 and replayed["future"] == 0
+        _assert_states_equal(ref, fresh.state_slice(),
+                             f"replay {name}")
+
+
+def test_mesh2d_sharding_layout():
+    """Lane fields shard trailing E over mp and REPLICATE over dp; the
+    clocks replicate everywhere — the §24 layout table."""
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    mesh = Mesh2DApplyTarget(0, E, A, mesh_shape="2x2")
+    spec = tuple(mesh._state.present.sharding.spec)
+    assert spec == (None, MP_AXIS)
+    assert mesh._mesh.shape[DP_AXIS] == 2
+    assert mesh._mesh.shape[MP_AXIS] == 2
+    assert tuple(mesh._state.vv.sharding.spec) in ((None, None), ())
+    assert len(mesh._state.present.sharding.device_set) == 4
+    # every digest/summary/slice read sees the joined replica: the
+    # state is ONE logical array (converged in-dispatch), so reads
+    # need no dp reduce — pin via digest parity with a plain node
+    from go_crdt_playground_tpu.net import digestsync
+
+    plain = Node(0, E, A)
+    rng = np.random.default_rng(23)
+    for add, dl, live in _disjoint_batches(rng, 2):
+        plain.ingest_batch(add, dl, live)
+        mesh.ingest_batch(add, dl, live)
+    assert digestsync.node_summary(mesh) == digestsync.node_summary(plain)
+
+
+def test_mesh2d_slice_and_cross_restore(tmp_path):
+    """Handoff + durability across node classes: slice payloads are
+    byte-identical, and a 2-D store restores with the plain/1-D class
+    (and vice versa) — the disk format carries no placement."""
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    rng = np.random.default_rng(24)
+    dirs = {name: tmp_path / name for name in ("plain", "2x2")}
+    nodes = {
+        "plain": Node(0, E, A, wal=DeltaWal(str(dirs["plain"] / "wal"))),
+        "2x2": Mesh2DApplyTarget(0, E, A, mesh_shape=(2, 2),
+                                 wal=DeltaWal(str(dirs["2x2"] / "wal"))),
+    }
+    for add, dl, live in _disjoint_batches(rng, 3):
+        for n in nodes.values():
+            n.ingest_batch(add, dl, live)
+    mask = np.zeros(E, bool)
+    mask[rng.choice(E, 64, replace=False)] = True
+    assert nodes["plain"].extract_slice(mask) == \
+        nodes["2x2"].extract_slice(mask)
+    for name, n in nodes.items():
+        from go_crdt_playground_tpu.utils.checkpoint import \
+            CheckpointStore
+
+        n.save_durable(CheckpointStore(str(dirs[name])))
+        with n._lock:
+            n.wal.close()
+    # cross-class restore: 2-D store with the plain class, plain store
+    # with the 2-D class (restore_durable node_kwargs plumbing)
+    r_plain = Node.restore_durable(str(dirs["2x2"]))
+    r_mesh = Mesh2DApplyTarget.restore_durable(
+        str(dirs["plain"]), node_kwargs={"mesh_shape": "2x2"})
+    _assert_states_equal(r_plain.state_slice(), r_mesh.state_slice(),
+                         "cross-restore")
+    assert tuple(r_mesh._state.present.sharding.spec) == (None, MP_AXIS)
+    # and the restored 2-D node keeps serving striped batches bitwise
+    rng2 = np.random.default_rng(25)
+    add, dl, live = next(_disjoint_batches(rng2, 1))
+    r_plain.ingest_batch(add, dl, live)
+    r_mesh.ingest_batch(add, dl, live)
+    _assert_states_equal(r_plain.state_slice(), r_mesh.state_slice(),
+                         "post-restore ingest")
+
+
+def test_mesh2d_requires_v2_semantics():
+    with pytest.raises(ValueError):
+        Mesh2DApplyTarget(0, E, A, mesh_shape="1x1",
+                          delta_semantics="reference")
+
+
+def test_mesh2d_frontend_stripe_width(tmp_path):
+    """The serve seam: a 2-D frontend's batcher widens its drain
+    watermark to dp × max_batch (the throughput axis), acks ride the
+    same durable group commit, and QUERY sees the joined replica."""
+    from go_crdt_playground_tpu.serve.client import ServeClient
+    from go_crdt_playground_tpu.serve.frontend import ServeFrontend
+
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    fe = ServeFrontend(256, A, actor=0,
+                       durable_dir=str(tmp_path / "s"),
+                       mesh_devices="2x2", flush_ms=1.0, max_batch=8)
+    assert fe.batcher.width == 16
+    addr = fe.serve()
+    try:
+        with ServeClient(addr) as c:
+            for e in range(0, 64, 2):
+                c.add(e)
+            c.delete(4)
+            members, _ = c.members()
+            assert members == sorted(set(range(0, 64, 2)) - {4})
+    finally:
+        fe.close()
+    restored = Node.restore_durable(str(tmp_path / "s"))
+    assert np.nonzero(np.asarray(
+        restored.state_slice().present))[0].tolist() == \
+        sorted(set(range(0, 64, 2)) - {4})
 
 
 def test_mesh_frontend_crash_on_slice_hook_subprocess(tmp_path):

@@ -174,17 +174,19 @@ else
             BENCH_INGEST.json
 fi
 
-if on_tpu MESH_CURVE.json; then
-    step "mesh curve: already on chip, skipping"
+if mesh_2d_complete; then
+    step "mesh curve: already on chip (incl. 2-D ladder), skipping"
 else
-    step "mesh curve (device-mesh replica tier kernels)"
-    # ISSUE 10: the kernel half of MESH_CURVE.json on real devices
-    # (the committed artifact records the CPU regime; run_mesh refuses
-    # a CPU-fallback overwrite once a TPU capture lands, and the
-    # soak's serve_curve/crash keys survive the merge)
+    step "mesh curve (1-D lane + 2-D dp×mp replica tier kernels)"
+    # ISSUE 10 + ISSUE 15: both kernel halves of MESH_CURVE.json on
+    # real devices — the 1-D lane ladder and the 2-D striped
+    # super-batch ladder ride ONE --mesh verb (the committed artifact
+    # records the CPU regime; run_mesh refuses a CPU-fallback
+    # overwrite once a TPU capture lands, and the soak's
+    # serve_curve/parity/crash keys survive the merge)
     timeout -k 10 900 $PY bench.py --mesh >> "$LOG" 2>&1
-    on_tpu MESH_CURVE.json && \
-        commit_if_changed "On-chip MESH_CURVE: lane-sharded ingest+δ and collective digest read vs device count" \
+    mesh_2d_complete && \
+        commit_if_changed "On-chip MESH_CURVE: 1-D lane + 2-D dp×mp ingest and collective digest read" \
             MESH_CURVE.json
 fi
 

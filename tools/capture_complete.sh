@@ -11,4 +11,4 @@ on_tpu NORTHSTAR_DOTPACKED.json || exit 1
 northstar_modeled || exit 1
 ladder_r5_complete || exit 1
 on_tpu BENCH_INGEST.json || exit 1
-on_tpu MESH_CURVE.json || exit 1
+mesh_2d_complete || exit 1

@@ -23,6 +23,19 @@ headline_complete() {
         && grep -q '"layout"' BENCH_SESSION_r05.json 2>/dev/null
 }
 
+mesh_2d_complete() {
+    # ISSUE 15: an on-chip MESH_CURVE must carry BOTH kernel halves —
+    # the 1-D lane ladder and the 2-D dp×mp striped super-batch
+    # ladder (a pre-2D on-chip artifact deserves a re-run; run_mesh
+    # writes both in one verb, so one capture lands both)
+    on_tpu MESH_CURVE.json || return 1
+    python - <<'EOF'
+import json, sys
+a = json.load(open("MESH_CURVE.json"))
+sys.exit(0 if a.get("kernel_curve_2d") else 1)
+EOF
+}
+
 northstar_modeled() {
     on_tpu NORTHSTAR.json || return 1
     python -c "import json, sys; \

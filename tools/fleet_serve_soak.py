@@ -39,8 +39,9 @@ single-node load generator runs against the fleet as-is.
   ack-or-typed-reject (``KeyspaceMoving`` during fences is the typed
   retryable contract), zero acked-op loss, zero phantoms.
 
-* **mesh mode** (``--mesh``, DESIGN.md §20) — the device-mesh replica
-  tier at fleet scope: real ``serve --mesh-devices N`` workers behind
+* **mesh mode** (``--mesh``, DESIGN.md §20/§24) — the device-mesh
+  replica tier at fleet scope: real ``serve --mesh-devices N`` (1-D)
+  and ``--mesh-devices DPxMP`` (2-D replicated-ingest) workers behind
   the router.  Per device count an open-loop goodput/p99 point; a
   lockstep bitwise-parity leg (mesh worker vs single-device worker fed
   the same op log — durable states diffed field-by-field after a
@@ -674,18 +675,33 @@ def adjudicate_chaos(leg: Dict[str, object]) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _mesh_spec(devices: int, elements: int, seed: int,
+def _mesh_device_count(spec) -> int:
+    """Total devices a ``--mesh-devices`` spec needs: N, or dp*mp —
+    resolved through the package's own parser (ONE spec grammar; a
+    malformed spec fails here with the operator-grade message, not at
+    worker launch)."""
+    from go_crdt_playground_tpu.parallel.meshtarget2d import \
+        parse_mesh_spec
+
+    parsed = parse_mesh_spec(str(spec))
+    return parsed if isinstance(parsed, int) else parsed[0] * parsed[1]
+
+
+def _mesh_spec(devices, elements: int, seed: int,
                **kw) -> FleetSpec:
-    """A 1-shard fleet whose worker runs ``serve --mesh-devices N``.
-    CPU workers need the forced host-device-count flag in their OWN
-    env (jax honors it only at process init); a worker that comes up
-    and prints its address PROVES the devices existed — mesh
-    construction refuses a mesh wider than the visible device set."""
+    """A 1-shard fleet whose worker runs ``serve --mesh-devices N``
+    (1-D) or ``--mesh-devices DPxMP`` (the 2-D replicated-ingest mesh,
+    DESIGN.md §24).  CPU workers need the forced host-device-count
+    flag in their OWN env (jax honors it only at process init); a
+    worker that comes up and prints its address PROVES the devices
+    existed — mesh construction refuses a mesh wider than the visible
+    device set."""
+    count = _mesh_device_count(devices)
     extra_env = ()
-    if devices > 1:
+    if count > 1:
         extra_env = (("XLA_FLAGS",
                       "--xla_force_host_platform_device_count="
-                      f"{devices}"),)
+                      f"{count}"),)
     return FleetSpec(n_shards=1, elements=elements, seed=seed,
                      extra_args=("--mesh-devices", str(devices)),
                      extra_env=extra_env, **kw)
@@ -707,32 +723,58 @@ def _worker_mesh_banner(fleet: ShardFleet) -> str:
     return ""
 
 
-def mesh_sweep_leg(root: str, devices: int, elements: int, rate: float,
-                   duration_s: float, seed: int) -> Dict[str, object]:
-    """One device count's open-loop point: a real ``serve
-    --mesh-devices N`` worker behind a real router, unmodified
-    ServeClient load.  On a 2-core CI box the CPU "devices" time-slice
-    the same cores, so the CURVE records the mesh path's goodput/p99
-    per width (regime documentation), not a scaling claim — the
-    on-chip capture rides tools/capture_all.sh."""
-    spec = _mesh_spec(devices, elements, seed)
+def mesh_sweep_leg(root: str, devices, elements: int, rate: float,
+                   duration_s: float, seed: int,
+                   **fleet_kw) -> Dict[str, object]:
+    """One mesh spec's open-loop point: a real ``serve --mesh-devices
+    <spec>`` worker behind a real router, unmodified ServeClient load.
+    On a 2-core CI box the CPU "devices" time-slice the same cores, so
+    the 1-D CURVE records the mesh path's goodput/p99 per width
+    (regime documentation); the 2-D dp ladder DOES make a scaling
+    claim even here — dp multiplies the rows per dispatch+fsync, which
+    is dispatch-count amortization, not core parallelism.  The on-chip
+    capture rides tools/capture_all.sh."""
+    spec = _mesh_spec(devices, elements, seed, **fleet_kw)
     fleet = ShardFleet(REPO, os.path.join(root, f"mesh-{devices}"), spec)
     try:
         addr = fleet.start()
         leg = serve_soak.open_loop_leg(addr, rate, duration_s, elements)
         leg["mesh_devices"] = devices
         leg["worker_banner_mesh"] = _worker_mesh_banner(fleet)
+        # the worker's own dispatch census: rows per durable group
+        # commit is the dp mechanism (stripes × max_batch under
+        # saturation) and — unlike cross-worker goodput ratios on a
+        # shared 2-core/9p box — is weather-proof: it is a ratio
+        # WITHIN one worker's counters
+        try:
+            with ServeClient(addr, timeout=10.0) as c:
+                counters = c.stats()["aggregate"]["counters"]
+            dispatches = counters.get("ingest.dispatches", 0)
+            rows = counters.get("mesh.stripe.rows",
+                                counters.get("serve.ops.acked", 0))
+            leg["server_mesh"] = {
+                "dispatches": dispatches,
+                "stripe_cuts": counters.get("mesh.stripe.cuts", 0),
+                "rows_per_dispatch": (round(rows / dispatches, 2)
+                                      if dispatches else 0.0),
+            }
+        except Exception as e:  # noqa: BLE001 — census is evidence,
+            # not control flow; a failed STATS pull is recorded
+            leg["server_mesh"] = {"error": str(e)}
         return leg
     finally:
         fleet.close()
 
 
-def mesh_parity_leg(root: str, devices: int, elements: int,
-                    seed: int) -> Dict[str, object]:
-    """The bitwise pin at fleet scope: a mesh worker and a
-    single-device worker fed the SAME deterministic op log (serially,
-    through their routers) must land on byte-identical durable state
-    after a graceful drain.  The fleets run SEQUENTIALLY, one at a
+def mesh_parity_leg(root: str, devices, elements: int,
+                    seed: int, vs=None) -> Dict[str, object]:
+    """The bitwise pin at fleet scope: a mesh worker and a reference
+    worker fed the SAME deterministic op log (serially, through their
+    routers) must land on byte-identical durable state after a
+    graceful drain.  ``vs`` names the reference: ``None`` = the plain
+    single-device worker (the PR-10 pin); a mesh spec (e.g. ``"4"``)
+    pins the 2-D worker against the 1-D worker — the ISSUE 15
+    acceptance contract.  The fleets run SEQUENTIALLY, one at a
     time — run concurrently on a 2-core box, ack latency can cross the
     router's downstream read deadline, and a slow-but-applied op comes
     back as a typed reject whose retry applies it TWICE on one worker
@@ -745,8 +787,11 @@ def mesh_parity_leg(root: str, devices: int, elements: int,
     import random
 
     specs = {"mesh": _mesh_spec(devices, elements, seed, flush_ms=1.0),
-             "plain": FleetSpec(n_shards=1, elements=elements,
-                                seed=seed, flush_ms=1.0)}
+             "plain": (FleetSpec(n_shards=1, elements=elements,
+                                 seed=seed, flush_ms=1.0)
+                       if vs is None
+                       else _mesh_spec(vs, elements, seed,
+                                       flush_ms=1.0))}
     roots = {k: os.path.join(root, f"parity-{k}") for k in specs}
     rng = random.Random(seed + 1)
     order = list(range(elements))
@@ -793,13 +838,14 @@ def mesh_parity_leg(root: str, devices: int, elements: int,
         name for name in states["mesh"]._fields
         if not np.array_equal(np.asarray(getattr(states["mesh"], name)),
                               np.asarray(getattr(states["plain"], name)))]
-    return {"mesh_devices": devices, "worker_banner_mesh": banner,
+    return {"mesh_devices": devices, "vs": vs or "plain",
+            "worker_banner_mesh": banner,
             "elements": elements, "ops": len(ops), "retries": retries,
             "bitwise_equal": not mismatched,
             "mismatched_fields": mismatched}
 
 
-def mesh_crash_leg(root: str, devices: int, elements: int,
+def mesh_crash_leg(root: str, devices, elements: int,
                    seed: int) -> Dict[str, object]:
     """The §14 contract against a mesh worker: ledgered add-only
     traffic through the router, SIGKILL the worker MID-STREAM (its
@@ -897,30 +943,67 @@ def run_mesh_mode(args) -> int:
     if args.quick:
         elements = 144
         device_counts = [1, 2]
+        dp_ladder = ["1x2", "2x2"]
         rate, duration_s = 400.0, 3.0
+        rate_2d = 1600.0
     else:
         elements = 288
         device_counts = [1, 2, 4]
+        dp_ladder = ["1x2", "2x2", "4x2"]
         rate, duration_s = 800.0, 6.0
+        rate_2d = 1600.0
     deep = device_counts[-1]
+    deep2d = dp_ladder[-1]
+    # the 2-D dp ladder is deliberately BATCH-BOTTLENECKED (the
+    # CONTROL_CURVE calibration trick): max_batch=4 at flush 10ms caps
+    # a dp=1 worker's service ceiling at ~4/(10ms+apply) ≈ 250-300
+    # ops/s — well under the offered load — so goodput scaling with dp
+    # (dp x max_batch rows per dispatch+fsync) is the measured effect,
+    # not scheduler noise.  The p99 budget is FIXED by the client
+    # deadline (open_loop_leg deadline_s): over-budget ops shed typed,
+    # so goodput is the honest scaling metric and the per-leg p99s are
+    # reported, not adjudicated (9p disk weather, the PR-8 lesson).
+    ladder_kw = dict(max_batch=4, flush_ms=10.0)
 
     t0 = time.time()
     root = tempfile.mkdtemp(prefix="mesh-serve-soak-")
     serve_curve: List[Dict] = []
+    serve_curve_2d: List[Dict] = []
     try:
         for n in device_counts:
             leg = mesh_sweep_leg(root, n, elements, rate, duration_s,
                                  args.seed)
             serve_curve.append(leg)
             print(json.dumps(leg), flush=True)
+        for spec in dp_ladder:
+            leg = mesh_sweep_leg(root, spec, elements, rate_2d,
+                                 duration_s, args.seed, **ladder_kw)
+            serve_curve_2d.append(leg)
+            print(json.dumps(leg), flush=True)
         parity = mesh_parity_leg(root, deep, elements, args.seed)
         print(json.dumps({"mesh_parity": parity}), flush=True)
+        # the ISSUE 15 acceptance pin: the 2-D worker against the 1-D
+        # worker (same total device count) fed the same op log — in
+        # its OWN subdir (mesh_parity_leg derives durable dirs from
+        # the root; sharing the first leg's would restore ITS state)
+        parity_2d = mesh_parity_leg(os.path.join(root, "p2d"), deep2d,
+                                    elements, args.seed + 7,
+                                    vs=str(deep))
+        print(json.dumps({"mesh_parity_2d": parity_2d}), flush=True)
         crash = mesh_crash_leg(root, deep, elements, args.seed)
         print(json.dumps({"mesh_crash": {
             k: crash[k] for k in ("outage", "acked_ops",
                                   "victim_acked_before_kill",
                                   "lost_acked_ops", "phantom_members",
                                   "resubmit_rounds")}}), flush=True)
+        crash_2d = mesh_crash_leg(os.path.join(root, "c2d"), deep2d,
+                                  elements, args.seed + 11)
+        print(json.dumps({"mesh_crash_2d": {
+            k: crash_2d[k] for k in ("outage", "acked_ops",
+                                     "victim_acked_before_kill",
+                                     "lost_acked_ops",
+                                     "phantom_members",
+                                     "resubmit_rounds")}}), flush=True)
     finally:
         import shutil
 
@@ -952,8 +1035,22 @@ def run_mesh_mode(args) -> int:
                         "duration_s": duration_s, "seed": args.seed,
                         "quick": bool(args.quick)},
         "serve_curve": serve_curve,
+        # the 2-D dp ladder (DESIGN.md §24): batch-bottlenecked legs
+        # at FIXED mp — goodput must scale with the dp width under the
+        # fixed client p99 deadline budget; p99s reported per leg
+        # op_deadline_s is the SERVER-side budget (ops whose deadline
+        # passes in queue shed typed at build time); the legs' client
+        # p99s additionally include kernel-socket wait under the
+        # abusive open loop and are reported, never adjudicated
+        "serve_fleet_2d": {"elements": elements,
+                           "offered_rate": rate_2d,
+                           "duration_s": duration_s,
+                           "op_deadline_s": 1.0, **ladder_kw},
+        "serve_curve_2d": serve_curve_2d,
         "parity": parity,
+        "parity_2d": parity_2d,
         "crash": crash,
+        "crash_2d": crash_2d,
         "serve_elapsed_s": round(time.time() - t0, 1),
     })
     with open(out, "w") as f:
@@ -963,14 +1060,32 @@ def run_mesh_mode(args) -> int:
 
     ok = all(leg["unresolved"] == 0 and leg["goodput"] > 0
              and leg["worker_banner_mesh"] == str(leg["mesh_devices"])
-             for leg in serve_curve)
+             for leg in serve_curve + serve_curve_2d)
+    # the dp-scaling claim, adjudicated on the MECHANISM: under the
+    # batch-bottlenecked saturation the widest-dp worker commits
+    # proportionally more rows per dispatch+fsync than the dp=1 worker
+    # (its own counters — weather-proof), and its goodput does not
+    # systematically regress.  Cross-worker goodput RATIOS on a shared
+    # 2-core/9p box are disk weather (the PR-8 lesson: a single fsync
+    # stall inside one 6-second window swings a leg 3x), so the
+    # per-leg goodput/p99 numbers are committed as evidence, not gated
+    # to a brittle factor.
+    rpd_first = serve_curve_2d[0].get("server_mesh", {}).get(
+        "rows_per_dispatch", 0.0)
+    rpd_last = serve_curve_2d[-1].get("server_mesh", {}).get(
+        "rows_per_dispatch", 0.0)
+    ok = ok and rpd_first > 0 and rpd_last > 1.5 * rpd_first
+    ok = ok and (serve_curve_2d[-1]["goodput"]
+                 > 0.9 * serve_curve_2d[0]["goodput"])
     ok = ok and parity["bitwise_equal"] and parity["ops"] > 0
-    ok = ok and crash["outage"]["typed_unavailable"] > 0
-    ok = ok and crash["outage"]["unresolved"] == 0
-    ok = ok and crash["victim_acked_before_kill"] > 0
-    ok = ok and crash["lost_acked_ops"] == []
-    ok = ok and crash["phantom_members"] == []
-    ok = ok and crash["unfinished"] == []
+    ok = ok and parity_2d["bitwise_equal"] and parity_2d["ops"] > 0
+    for leg in (crash, crash_2d):
+        ok = ok and leg["outage"]["typed_unavailable"] > 0
+        ok = ok and leg["outage"]["unresolved"] == 0
+        ok = ok and leg["victim_acked_before_kill"] > 0
+        ok = ok and leg["lost_acked_ops"] == []
+        ok = ok and leg["phantom_members"] == []
+        ok = ok and leg["unfinished"] == []
     return 0 if ok else 1
 
 
