@@ -1530,7 +1530,112 @@ def measure_mesh2d(num_elements=8192, num_actors=8, batch=32, keys=4,
     return curve, avail
 
 
-def run_mesh(out=_MESH_ARTIFACT):
+def measure_mesh2d_zipf(num_elements=8192, num_actors=8, batch=32,
+                        s=1.2, repeats=30, rounds=40,
+                        dp_ladder=(1, 2, 4), mp=2):
+    """Zipf hot-key kernel ladder for the conflict-aware admission
+    scheduler (DESIGN.md §25): per dp at fixed mp, a STREAM of
+    ``rounds`` super-batches of dp×``batch`` SINGLE-KEY rows drawn
+    zipf(s) over the universe — the serve tier's skewed point-op
+    regime, the opposite extreme of ``measure_mesh2d``'s key-disjoint
+    bands.  Reports the host-side planning census per super-batch
+    (``cuts_before``: plan_stripes on arrival order;
+    ``cuts_after``: on the scheduler's emitted order + hint, hot-run
+    tails carried batcher-style into the next round — the scheduled
+    path's steady state, expected ~0) and the DEVICE time of one
+    scheduled apply (``Mesh2DApplyTarget.ingest_batch`` with the
+    hint, fsync off), so the artifact pins both the cut reduction and
+    that the scheduled path's dispatch cost still amortizes with dp
+    (``dp_scaling``)."""
+    import tempfile
+
+    import jax
+
+    from go_crdt_playground_tpu.parallel.meshtarget2d import \
+        Mesh2DApplyTarget, plan_stripes
+    from go_crdt_playground_tpu.serve.scheduler import plan_emit
+    from go_crdt_playground_tpu.utils.wal import DeltaWal
+
+    avail = jax.device_count()
+    dps = [dp for dp in dp_ladder
+           if dp * mp <= avail and num_elements % mp == 0]
+    rng = np.random.default_rng(11)
+    # zipf(s) over shuffled ranks (hot ids scattered through the
+    # universe, tools/workloads.py's ZipfKeys shape)
+    p = np.arange(1, num_elements + 1, dtype=np.float64) ** -s
+    p /= p.sum()
+    keymap = rng.permutation(num_elements)
+
+    def rows_of(keys):
+        add = np.zeros((len(keys), num_elements), bool)
+        add[np.arange(len(keys)), keys] = True
+        dl = np.zeros((len(keys), num_elements), bool)
+        return add, dl, np.ones(len(keys), bool)
+
+    curve = []
+    for dp in dps:
+        B = dp * batch
+        cap = batch  # the batcher contract: width = dp * max_batch
+        cuts_before = cuts_after = 0
+        deferred_rows = 0
+        carry = []  # deferred key ids, batcher-style carryover
+        sched_keys = sched_hint = None
+        for _ in range(rounds):
+            fresh = [int(k) for k in
+                     keymap[rng.choice(num_elements,
+                                       size=B - len(carry), p=p)]]
+            keys = carry + fresh
+            add, dl, live = rows_of(keys)
+            _, c0 = plan_stripes(add, dl, live, dp, cap)
+            cuts_before += c0
+            order, assign, deferred = plan_emit(
+                [[k] for k in keys], dp, cap)
+            emitted = [keys[i] for i in order]
+            hint = np.asarray(assign, np.int32)
+            e_add, e_dl, e_live = rows_of(emitted)
+            _, c1 = plan_stripes(e_add, e_dl, e_live, dp, cap,
+                                 assign=hint)
+            cuts_after += c1
+            deferred_rows += len(deferred)
+            carry = [keys[i] for i in deferred]
+            if sched_keys is None:
+                sched_keys, sched_hint = emitted, hint
+        # device time of the scheduled apply, one representative
+        # emitted super-batch
+        s_add, s_dl, s_live = rows_of(sched_keys)
+        with tempfile.TemporaryDirectory() as d:
+            node = Mesh2DApplyTarget(
+                0, num_elements, num_actors, mesh_shape=(dp, mp),
+                wal=DeltaWal(os.path.join(d, "wal"), fsync=False))
+            node.ingest_batch(s_add, s_dl, s_live,
+                              stripe_hint=sched_hint)  # warm/compile
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                node.ingest_batch(s_add, s_dl, s_live,
+                                  stripe_hint=sched_hint)
+            ingest_s = (time.perf_counter() - t0) / repeats
+        n_rows = len(sched_keys)
+        curve.append({
+            "dp": dp, "mp": mp, "rows_per_super_batch": B, "zipf_s": s,
+            "super_batches": rounds,
+            "cuts_before_per_super_batch": round(cuts_before / rounds,
+                                                 3),
+            "cuts_after_per_super_batch": round(cuts_after / rounds,
+                                                3),
+            "deferred_rows_per_super_batch": round(
+                deferred_rows / rounds, 3),
+            "ingest_ms_per_batch": round(ingest_s * 1e3, 3),
+            "ops_per_s": round(n_rows / ingest_s, 1),
+        })
+    base = next((leg["ops_per_s"] for leg in curve if leg["dp"] == 1),
+                None)
+    for leg in curve:
+        leg["dp_scaling"] = (round(leg["ops_per_s"] / base, 3)
+                             if base else None)
+    return curve, avail
+
+
+def run_mesh(out=_MESH_ARTIFACT, zipf=False):
     """The `--mesh` verb: measure the mesh kernel ladder and write the
     kernel half of MESH_CURVE.json.  Same TPU-overwrite guard as
     run_ingest (a CPU/fallback run refuses to overwrite an on-chip
@@ -1560,6 +1665,17 @@ def run_mesh(out=_MESH_ARTIFACT):
             return None
     curve, avail, config = measure_mesh()
     curve_2d, _ = measure_mesh2d()
+    curve_2d_zipf = prior.get("kernel_curve_2d_zipf", [])
+    if zipf:
+        curve_2d_zipf, _ = measure_mesh2d_zipf()
+        if not curve_2d_zipf and prior.get("kernel_curve_2d_zipf"):
+            print(json.dumps({
+                "metric": "mesh 2-D zipf ladder",
+                "skipped": "no (dp, mp) shape fits this host's "
+                           f"{avail} visible devices; keeping the "
+                           "prior kernel_curve_2d_zipf",
+            }))
+            curve_2d_zipf = prior["kernel_curve_2d_zipf"]
     if not curve_2d and prior.get("kernel_curve_2d"):
         # a host without enough (forced) devices measures NOTHING for
         # the 2-D ladder — keep the committed ladder instead of
@@ -1589,6 +1705,7 @@ def run_mesh(out=_MESH_ARTIFACT):
         "devices_visible": avail,
         "kernel_curve": curve,
         "kernel_curve_2d": curve_2d,
+        "kernel_curve_2d_zipf": curve_2d_zipf,
         **config,
     })
     with open(out, "w") as f:
@@ -1597,6 +1714,8 @@ def run_mesh(out=_MESH_ARTIFACT):
     for leg in curve:
         print(json.dumps(leg))
     for leg in curve_2d:
+        print(json.dumps(leg))
+    for leg in curve_2d_zipf:
         print(json.dumps(leg))
     print(f"wrote {out}")
     return artifact
@@ -1814,8 +1933,9 @@ def main():
         # half of MESH_CURVE.json, TPU-overwrite-guarded by run_mesh;
         # CPU multi-device runs need XLA_FLAGS=
         # --xla_force_host_platform_device_count=N exported BEFORE
-        # launch (jax reads it at init)
-        run_mesh()
+        # launch (jax reads it at init); --zipf adds the hot-key
+        # scheduler ladder (DESIGN.md §25) to the same artifact
+        run_mesh(zipf="--zipf" in sys.argv)
         return
     if os.environ.get("CRDT_BENCH_CHILD") == "1":
         _child_main()

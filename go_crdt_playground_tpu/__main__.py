@@ -154,6 +154,7 @@ def _ingest_banner(args, host: str, bound: int) -> None:
           f"fused={'yes' if args.fused_ingest else 'NO'} "
           f"sync={args.sync_mode} "
           f"mesh={_fmt_mesh(args.mesh_devices)} "
+          f"sched={args.sched} "
           f"shard={args.shard_id or 'off'} "
           f"compaction={args.compact_interval or 'off'})", flush=True)
 
@@ -176,7 +177,8 @@ def _build_frontend(args):
         shard_id=args.shard_id,
         shard_epoch=args.shard_epoch,
         announce_to=args.announce_to,
-        repl_ack_timeout_ms=args.repl_ack_timeout_ms)
+        repl_ack_timeout_ms=args.repl_ack_timeout_ms,
+        sched=args.sched)
 
 
 def _cmd_serve_ingest(args) -> int:
@@ -682,6 +684,16 @@ def main(argv=None) -> int:
                    help="seed-comparison mode: two dispatches per batch "
                         "(apply, then delta_extract for the WAL record) "
                         "and dense WAL records")
+    s.add_argument("--sched", dest="sched", default="auto",
+                   choices=("auto", "on", "off"),
+                   help="conflict-aware admission scheduling (DESIGN.md "
+                        "§25): reorder each drained batch across "
+                        "key-runs (per-key FIFO kept) and pre-stripe it "
+                        "for the 2-D mesh's dp ingest stripes.  'auto' "
+                        "(default) enables it exactly when "
+                        "--mesh-devices is DPxMP with dp > 1; 'off' is "
+                        "the unscheduled FIFO baseline the zipf soak "
+                        "compares against")
     s.add_argument("--shard-id", dest="shard_id", default=None,
                    help="this frontend's shard id in its fleet "
                         "(DESIGN.md §23): names the keyspace in "

@@ -85,7 +85,12 @@ LOCK_TARGETS = ["net/peer.py", "net/antientropy.py", "net/digestsync.py",
                 # standby's tail loop crosses promote()/observers —
                 # plus the shared degrade-window latch both serving
                 # ladders poll cross-thread
-                "shard/replica.py", "utils/degrade.py"]
+                "shard/replica.py", "utils/degrade.py",
+                # the conflict-aware admission scheduler (ISSUE 18):
+                # owned by the batcher loop thread, race-ok-annotated
+                # read-only config — swept so the annotations stay
+                # honest as the scheduler grows state
+                "serve/scheduler.py"]
 # extra files that participate in the lock-ORDER graph (their locks can
 # nest under the runtime's)
 LOCK_ORDER_EXTRA = ["utils/checkpoint.py"]
@@ -97,7 +102,11 @@ PURITY_TARGETS = ["ops/merge.py", "ops/delta.py", "ops/lattices.py",
                   "ops/pallas_delta.py", "ops/ingest.py",
                   "ops/pallas_ingest.py", "ops/digest.py",
                   "ops/pallas_digest.py", "parallel/meshtarget.py",
-                  "parallel/meshtarget2d.py"]
+                  "parallel/meshtarget2d.py",
+                  # the scheduler's planning core (key_runs/plan_emit)
+                  # is pure host-side combinatorics: no I/O, no
+                  # hidden state — hold it to the kernel bar
+                  "serve/scheduler.py"]
 # attribute-name -> class hints for cross-class lock-order edges
 ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "recorder": "Recorder", "_store": "CheckpointStore",
@@ -119,7 +128,8 @@ ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "standby": "RouterStandby",
                 "repl": "ReplicationPublisher",
                 "window": "DegradeWindow",
-                "_storage": "DegradeWindow"}
+                "_storage": "DegradeWindow",
+                "scheduler": "ConflictScheduler"}
 
 # the full pass list (report keys): the report-freshness lint pins the
 # COMMITTED artifact's pass list to this — landing a new pass without

@@ -276,7 +276,8 @@ class Node:
                 self._log_local_delta(pre_vv)
 
     def ingest_batch(self, add_rows: np.ndarray, del_rows: np.ndarray,
-                     live: Optional[np.ndarray] = None) -> None:
+                     live: Optional[np.ndarray] = None,
+                     stripe_hint: Optional[np.ndarray] = None) -> None:
         """Apply one packed ``(B, E)`` micro-batch of client op-rows in a
         single compiled dispatch (row b's add selector is one Add(k...)
         call, its del selector one Del(k...) call, ``live`` masks
@@ -292,7 +293,14 @@ class Node:
         lanes for the record instead of re-extracting a dense O(E)
         payload in a second dispatch.  ``ingest.dispatches`` counts the
         compiled applies per batch (fused: 1; seed path: 2 when a WAL
-        is attached)."""
+        is attached).
+
+        ``stripe_hint`` is the conflict-aware admission scheduler's
+        per-row stripe assignment (serve/scheduler.py; int per batch
+        row, negatives = unhinted).  Only a target with replicated
+        ingest stripes (``parallel/meshtarget2d.Mesh2DApplyTarget``)
+        acts on it — a plain node applies rows in order regardless, so
+        the hint is validated for shape and otherwise advisory."""
         add_rows = np.asarray(add_rows, bool)
         del_rows = np.asarray(del_rows, bool)
         if add_rows.shape != del_rows.shape or add_rows.ndim != 2 \
@@ -306,20 +314,32 @@ class Node:
         if live.shape != (add_rows.shape[0],):
             raise ValueError(f"live mask shape {live.shape} does not "
                              f"match batch axis {add_rows.shape[0]}")
+        if stripe_hint is not None:
+            stripe_hint = np.asarray(stripe_hint, np.int32)
+            if stripe_hint.shape != (add_rows.shape[0],):
+                raise ValueError(
+                    f"stripe hint shape {stripe_hint.shape} does not "
+                    f"match batch axis {add_rows.shape[0]}")
         with self._lock:
             pre_vv = (np.asarray(self._state.vv[0]).copy()
                       if self.wal is not None else None)
-            self._apply_batch_locked(add_rows, del_rows, live, pre_vv)
+            self._apply_batch_locked(add_rows, del_rows, live, pre_vv,
+                                     stripe_hint=stripe_hint)
 
     # requires-lock: _lock
     def _apply_batch_locked(self, add_rows: np.ndarray,
                             del_rows: np.ndarray, live: np.ndarray,
-                            pre_vv: Optional[np.ndarray]) -> None:
+                            pre_vv: Optional[np.ndarray],
+                            stripe_hint: Optional[np.ndarray] = None
+                            ) -> None:
         """The apply+log half of ``ingest_batch`` (validation done):
         the replica-flavor seam — ``parallel/meshtarget.MeshApplyTarget``
         overrides this with the mesh-sharded one-dispatch path while
         the ack-after-durable contract stays in the caller.  Caller
-        holds the lock; ``pre_vv`` is None iff no WAL is attached."""
+        holds the lock; ``pre_vv`` is None iff no WAL is attached;
+        ``stripe_hint`` rides to the 2-D mesh override
+        (parallel/meshtarget2d.py) — the sequential path ignores it
+        (row order already IS the durable order here)."""
         import jax
         import jax.numpy as jnp
 

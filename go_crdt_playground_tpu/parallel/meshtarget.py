@@ -394,7 +394,11 @@ class MeshApplyTarget(Node):
     # requires-lock: _lock
     def _apply_batch_locked(self, add_rows: np.ndarray,
                             del_rows: np.ndarray, live: np.ndarray,
-                            pre_vv: Optional[np.ndarray]) -> None:
+                            pre_vv: Optional[np.ndarray],
+                            stripe_hint: Optional[np.ndarray] = None
+                            ) -> None:
+        # stripe_hint is the 2-D subclass's pre-striping seam; the 1-D
+        # mesh applies the whole batch in one stripe and ignores it
         n = self.lane_shards
         B = add_rows.shape[0]
         # host-side prefix data: the ONLY cross-shard facts of the row

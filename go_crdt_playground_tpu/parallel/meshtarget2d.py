@@ -144,17 +144,23 @@ class StripePlan:
 
 
 def plan_stripes(add_rows: np.ndarray, del_rows: np.ndarray,
-                 live: np.ndarray, dp: int, cap: int
+                 live: np.ndarray, dp: int, cap: int,
+                 assign: Optional[np.ndarray] = None
                  ) -> Tuple[List[StripePlan], int]:
     """Greedy order-preserving striping of one ``(B, E)`` op-batch
     into chunks of ≤ dp key-disjoint stripes of ≤ ``cap`` rows each.
 
     Rows are considered in batch order (the op-log order the sequential
     kernel applies).  A row lands in the stripe already owning one of
-    its keys, or the least-loaded stripe when its keys are unowned.  A
-    row whose keys span TWO stripes — or whose target stripe is full —
-    cuts the chunk: everything before it dispatches now, it and every
-    later row re-stripe fresh.  Cutting (never reordering) is what
+    its keys, or — when its keys are unowned — the stripe its
+    ``assign`` hint names (the conflict-aware admission scheduler's
+    pre-striping, serve/scheduler.py; entries outside ``[0, dp)`` mean
+    unhinted), falling back to the least-loaded stripe.  A row whose
+    keys span TWO stripes — or whose target stripe is full — cuts the
+    chunk: everything before it dispatches now, it and every later row
+    re-stripe fresh.  The hint steers PLACEMENT only; key-disjointness
+    and capacity are enforced here regardless, so a bad hint costs
+    cuts, never correctness.  Cutting (never reordering) is what
     keeps the global counter prefixes, and therefore the assigned
     dots, bitwise the sequential kernel's.  Dead/empty rows are
     dropped (they are padding: no tick, no lanes — the sequential
@@ -185,7 +191,12 @@ def plan_stripes(add_rows: np.ndarray, del_rows: np.ndarray,
             if owners.size > 1:
                 cuts += 1
                 break  # cross-stripe keys: serialize at the cut
-            s = int(owners[0]) if owners.size else int(np.argmin(loads))
+            if owners.size:
+                s = int(owners[0])  # ownership beats any hint
+            elif assign is not None and 0 <= assign[r] < dp:
+                s = int(assign[r])
+            else:
+                s = int(np.argmin(loads))
             if loads[s] >= cap:
                 cuts += 1
                 break  # stripe full: the remainder dispatches next
@@ -332,11 +343,13 @@ class Mesh2DApplyTarget(MeshApplyTarget):
     # requires-lock: _lock
     def _apply_batch_locked(self, add_rows: np.ndarray,
                             del_rows: np.ndarray, live: np.ndarray,
-                            pre_vv: Optional[np.ndarray]) -> None:
+                            pre_vv: Optional[np.ndarray],
+                            stripe_hint: Optional[np.ndarray] = None
+                            ) -> None:
         B = add_rows.shape[0]
         cap = max(1, -(-B // self.dp))
         plans, cuts = plan_stripes(add_rows, del_rows, live, self.dp,
-                                   cap)
+                                   cap, assign=stripe_hint)
         if cuts:
             self._count("mesh.stripe.cuts", cuts)
         with_delta = pre_vv is not None

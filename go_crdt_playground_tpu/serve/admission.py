@@ -34,6 +34,13 @@ class OpRequest:
     ``deadline_us`` — propagation happens once, at the edge.  Single
     writer per field (the reader thread builds it, the batcher consumes
     it); only ``session`` is shared, and it locks itself.
+
+    ``elements`` doubles as the op's KEY SET for the conflict-aware
+    admission scheduler (serve/scheduler.py): ops whose key sets are
+    connected through shared keys form one key-run and keep their
+    queue order; disjoint runs may be reordered and spread across a
+    striped target's dp ingest stripes.  ``key_set()`` is the named
+    accessor for that reading of the field.
     """
 
     __slots__ = ("req_id", "kind", "elements", "deadline", "session",
@@ -48,6 +55,13 @@ class OpRequest:
         self.deadline = deadline
         self.session = session
         self.t_arrival = t_arrival
+
+    def key_set(self) -> frozenset:
+        """The elements this op touches, as the scheduler's conflict
+        domain: two ops commute iff their key sets are disjoint (the
+        AWSet join is per-element), which is the whole license for
+        cross-run reordering (serve/scheduler.py)."""
+        return frozenset(self.elements)
 
 
 class AdmissionQueue:

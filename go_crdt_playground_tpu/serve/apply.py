@@ -37,7 +37,15 @@ class ApplyTarget(Protocol):
     parallel/meshtarget2d.py — ``ingest_stripes == dp``) receives
     stripes × max_batch rows per ``ingest_batch`` call; the target
     owns striping them (key-disjoint planning, counter parity) — the
-    batcher only widens the packed arrays."""
+    batcher only widens the packed arrays.
+
+    A striped target's ``ingest_batch`` additionally accepts a keyword
+    ``stripe_hint`` (int per batch row, negatives = unhinted): the
+    conflict-aware admission scheduler's pre-striping
+    (serve/scheduler.py).  The hint is ADVISORY — the target still
+    enforces key-disjointness and stripe capacity itself — and the
+    batcher only passes it when a scheduler is attached, so plain
+    targets never see the keyword."""
 
     num_elements: int
     actor: int

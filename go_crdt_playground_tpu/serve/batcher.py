@@ -15,6 +15,18 @@ deadline passed while queued is shed with a typed ``REJECT_EXPIRED``
 and never applied — late effects are worse than honest rejection for a
 client that already timed out (it will retry idempotently).
 
+With a ``ConflictScheduler`` attached (serve/scheduler.py, DESIGN.md
+§25) the drained batch is reordered ACROSS key-runs before packing —
+per-key FIFO preserved, same-key runs coalesced into one stripe,
+distinct runs spread least-loaded over a striped target's dp stripes —
+and the emitted order becomes the durable order end to end (packing,
+counter prefixes, WAL records, acks).  The emission always fits one
+striped dispatch; tail rows of a run hotter than a whole stripe carry
+over to the FRONT of the next super-batch (``_carry``).  Cold keys
+ship in the super-batch they were drained into — the §25 starvation
+bound — and a deferred tail precedes every newer arrival, so per-key
+FIFO holds across the deferral.
+
 SLO accounting (obs.Recorder; names are the DESIGN.md §16 contract):
 counters ``serve.ops.acked`` / ``serve.shed.expired`` /
 ``serve.batches`` / ``serve.ack_send_failures``; observations
@@ -61,7 +73,7 @@ class MicroBatcher:
                  max_batch: int = 32, flush_s: float = 0.002,
                  idle_wait_s: float = 0.05, recorder=None,
                  clock: Callable[[], float] = time.monotonic,
-                 repl=None):
+                 repl=None, scheduler=None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         # anything satisfying serve/apply.ApplyTarget (ingest_batch
@@ -88,6 +100,20 @@ class MicroBatcher:
         # keeps the pre-HA ack path byte-identical.
         # race-ok: read-only after construction
         self.repl = repl
+        # conflict-aware admission scheduler (serve/scheduler.py):
+        # reorders each drained batch across key-runs (per-key FIFO
+        # kept) and pre-stripes it for a replicated-ingest target.
+        # The EMITTED order is the durable order — rows are packed,
+        # counter-prefixed, and WAL-logged in it.  None = FIFO, the
+        # pre-scheduler byte-identical path.
+        # race-ok: read-only after construction
+        self.scheduler = scheduler
+        # hot-run tail carryover (serve/scheduler.py): ops the
+        # scheduler deferred from the last super-batch, re-entering
+        # the NEXT one at the front (per-key FIFO across the
+        # deferral).  Loop-thread-only; _flush_remaining runs after
+        # the loop thread is joined.
+        self._carry: List[OpRequest] = []
         self._stop = threading.Event()
         # race-ok: start()/stop() owner thread only
         self._thread: Optional[threading.Thread] = None
@@ -159,12 +185,15 @@ class MicroBatcher:
         return self._storage.active()
 
     def _flush_remaining(self) -> None:
-        """Post-stop sweep: anything still queued (loop died, or drain
-        raced the stop flag) is applied inline so no admitted op is ever
-        silently dropped."""
+        """Post-stop sweep: anything still queued OR carried (loop
+        died, or drain raced the stop flag) is applied inline so no
+        admitted op is ever silently dropped.  Terminates: each pass
+        ships at least one stripe-capacity's worth of any carried run,
+        so the carryover strictly shrinks once the queue is empty."""
         while True:
-            batch = self.queue.take_batch(self.width, 0.0, 0.0)
-            if not batch:
+            batch = self.queue.take_batch(
+                max(1, self.width - len(self._carry)), 0.0, 0.0)
+            if not batch and not self._carry:
                 return
             self._apply(batch)
 
@@ -173,11 +202,12 @@ class MicroBatcher:
     def _loop(self) -> None:
         while not self._stop.is_set():
             batch = self.queue.take_batch(
-                self.width, self.idle_wait_s, self.flush_s)
+                max(1, self.width - len(self._carry)),
+                self.idle_wait_s, self.flush_s)
             if self.recorder is not None:
                 self.recorder.set_gauge("serve.queue.depth",
                                         self.queue.depth())
-            if not batch:
+            if not batch and not self._carry:
                 if self.queue.closed and self.queue.depth() == 0:
                     return  # drained
                 continue
@@ -191,6 +221,13 @@ class MicroBatcher:
                 self._count("serve.batch_errors")
 
     def _apply(self, batch: List[OpRequest]) -> None:
+        if self._carry:
+            # last batch's deferred hot-run tails re-enter FIRST:
+            # their arrival precedes everything drained after them, so
+            # prepending is what keeps per-key FIFO global across the
+            # deferral (they rejoin their run at its head)
+            batch = self._carry + batch
+            self._carry = []
         now = self._clock()
         live: List[OpRequest] = []
         for r in batch:
@@ -205,6 +242,17 @@ class MicroBatcher:
                 live.append(r)
         if not live:
             return
+        # conflict-aware reorder (serve/scheduler.py): coalesce
+        # same-key runs, spread distinct runs across the target's
+        # ingest stripes, and emit the batch pre-striped.  From here
+        # on `live` IS the durable order — rows pack, counter-prefix,
+        # WAL-log, and ack in the scheduler's emitted order.
+        hint = None
+        if self.scheduler is not None and len(live) > 1:
+            live, assign, self._carry = self.scheduler.schedule(
+                live, self.width)
+            hint = np.full(self.width, -1, np.int32)
+            hint[:len(assign)] = assign
         # one packed (B, E) pair, B static = the effective width so
         # every occupancy reuses one compiled program
         # (ops/ingest.ingest_rows; the striped 2-D program likewise
@@ -220,7 +268,11 @@ class MicroBatcher:
         t0 = self._clock()
         try:
             # durable on return: state applied + batch δ WAL-fsync'd
-            self.target.ingest_batch(add_rows, del_rows, live_mask)
+            if hint is None:
+                self.target.ingest_batch(add_rows, del_rows, live_mask)
+            else:
+                self.target.ingest_batch(add_rows, del_rows, live_mask,
+                                         stripe_hint=hint)
         except OSError as e:
             # the DISK failed the durable contract (ENOSPC, an fsync
             # error in the WAL append path — utils/wal.py counts the

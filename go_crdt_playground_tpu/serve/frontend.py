@@ -70,7 +70,8 @@ class ServeFrontend:
                  shard_id: Optional[str] = None,
                  shard_epoch: int = 0,
                  announce_to=None,
-                 repl_ack_timeout_ms: float = 250.0):
+                 repl_ack_timeout_ms: float = 250.0,
+                 sched: str = "auto"):
         from go_crdt_playground_tpu.obs import Recorder
 
         self.recorder = recorder if recorder is not None else Recorder()
@@ -138,10 +139,27 @@ class ServeFrontend:
 
         self.repl = ReplicationPublisher(
             self.recorder, ack_timeout_s=repl_ack_timeout_ms / 1e3)
+        # conflict-aware admission scheduling (serve/scheduler.py,
+        # DESIGN.md §25): "auto" turns it on exactly when the replica
+        # serves >1 ingest stripe (the 2-D dp×mp mesh — the only
+        # flavor where cross-key reordering buys throughput), "on"
+        # forces it (a dp=1 scheduler still coalesces, useful for
+        # parity tests), "off" keeps the byte-identical FIFO path.
+        if sched not in ("auto", "on", "off"):
+            raise ValueError(
+                f"sched must be auto/on/off, got {sched!r}")
+        stripes = max(1, int(getattr(self.node, "ingest_stripes", 1)))
+        self.scheduler = None
+        if sched == "on" or (sched == "auto" and stripes > 1):
+            from go_crdt_playground_tpu.serve.scheduler import \
+                ConflictScheduler
+
+            self.scheduler = ConflictScheduler(
+                stripes, recorder=self.recorder)
         self.batcher = MicroBatcher(
             self.node, self.queue, max_batch=max_batch,
             flush_s=flush_ms / 1000.0, recorder=self.recorder,
-            repl=self.repl)
+            repl=self.repl, scheduler=self.scheduler)
         # the dissemination half rides the EXISTING supervisor; it also
         # owns the durable checkpoint cadence (and attaches a WAL to a
         # fresh non-restored node when durable_dir is set)

@@ -1,0 +1,238 @@
+"""Conflict-aware admission scheduling: key-runs → pre-striped batches.
+
+The 2-D dp×mp mesh (parallel/meshtarget2d.py, DESIGN.md §24) only pays
+off on key-disjoint super-batches: ``plan_stripes`` is strictly
+order-preserving, so under a zipf workload the hot keys keep filling
+one stripe early and CUTTING the super-batch — dispatch degenerates
+toward sequential and the dp throughput win is forfeited (the ROADMAP
+leftover this module closes).  But CRDT ops COMMUTE across distinct
+keys by construction (state-based joins, "Efficient State-based CRDTs
+by Delta-Mutation", arxiv 1410.2803): the admission layer is free to
+reorder ops across keys as long as each key's own arrival order is
+preserved.  This module is that freedom, made explicit:
+
+1. **Key-runs** (``key_runs``).  A union-find over the keys of one
+   drained batch partitions its ops into runs: two ops share a run iff
+   they are connected through shared keys (transitively — an op
+   touching keys {a, b} bridges a's run and b's run).  Within a run,
+   arrival order is kept verbatim, so per-key FIFO holds by
+   construction; ACROSS runs there is no ordering obligation at all.
+2. **Single-chunk least-loaded placement with carryover**
+   (``plan_emit``).  Runs are packed whole-run-to-one-stripe
+   (same-key ops COALESCE instead of bridging stripes),
+   longest-run-first onto the least-loaded stripe, into EXACTLY ONE
+   dp×cap chunk — so ``plan_stripes`` sees conflict-free,
+   capacity-respecting input and stops cutting entirely.  A run
+   longer than its stripe's remaining room ships its head now and
+   DEFERS its tail to the next super-batch (the batcher re-queues the
+   tail ahead of all newer arrivals, so per-key FIFO survives the
+   deferral).  Only tail rows of a run hotter than a whole stripe's
+   budget can ever defer: placed rows always total less than the
+   dp×cap chunk capacity while any run remains, so a run's HEAD —
+   in particular every cold singleton op — is guaranteed a slot in
+   its own super-batch.
+3. **Advisory hints, mandatory safety.**  The per-row stripe
+   assignment rides to ``plan_stripes(..., assign=...)`` as a HINT:
+   the planner still enforces key-disjointness and stripe capacity
+   itself (ownership beats the hint; a full stripe still cuts), so a
+   stale or adversarial hint can cost performance, never correctness.
+
+Ordering contract (DESIGN.md §25): the scheduler's emitted order IS
+the durable order.  The batcher packs rows in emitted order, the mesh
+target assigns counter prefixes and composes WAL records in that same
+order, and replay follows the records — so the served state is
+bitwise-identical to a sequential worker fed the emitted op log
+(pinned in tests/test_scheduler.py).  Starvation bound: an op whose
+run fits its stripe ships in the super-batch it was drained into —
+cold keys ALWAYS do (see above) — and a hot run's deferred tail rides
+at the head of the immediately-next super-batch, ahead of every newer
+arrival; under sustained overload of one key the tail drains at one
+stripe capacity per batch and the admission deadline sheds the rest
+typed, so no op ever waits silently (``sched.reorder_distance``
+observes the realized within-batch displacement).
+
+Observability (obs.Recorder; the DESIGN.md §16 catalog):
+counters ``sched.keyruns`` (runs per batch, accumulated),
+``sched.coalesced_rows`` (rows that joined an existing run — each one
+a would-be cross-stripe conflict, now coalesced) and
+``sched.deferred_rows`` (hot-run tail rows carried into the next
+super-batch); observation ``sched.reorder_distance`` (per-op
+|emitted − arrival| displacement); gauge ``sched.stripe_fill``
+(fraction of the emitted chunk's dp×cap capacity actually filled —
+1.0 means the dispatch goes out full).
+
+Thread model: one instance is owned by the single batcher thread
+(serve/batcher.py) and keeps no cross-batch state; there is nothing to
+lock.  The recorder locks itself.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["key_runs", "plan_emit", "ConflictScheduler"]
+
+
+def key_runs(key_lists: Sequence[Sequence[int]]) -> List[List[int]]:
+    """Partition op indices ``0..len(key_lists)-1`` into key-runs.
+
+    ``key_lists[i]`` is op i's touched-key set (an Add/Del selector's
+    element ids).  Two ops land in one run iff connected through
+    shared keys, transitively.  Runs come back ordered by their first
+    op's arrival index, each run's ops in arrival order — the per-key
+    FIFO invariant is a property of this output shape: any two ops
+    sharing a key share a run, and runs never reorder internally.  An
+    op with no keys (a degenerate empty selector) is its own singleton
+    run.
+    """
+    parent: Dict[int, int] = {}  # key -> union-find parent key
+
+    def find(k: int) -> int:
+        root = k
+        while parent[root] != root:
+            root = parent[root]
+        while parent[k] != root:  # path compression
+            parent[k], k = root, parent[k]
+        return root
+
+    op_root: List[int] = []  # op index -> representative key (or -1)
+    for keys in key_lists:
+        it = iter(keys)
+        first = next(it, None)
+        if first is None:
+            op_root.append(-1)
+            continue
+        first = int(first)
+        if first not in parent:
+            parent[first] = first
+        root = find(first)
+        for k in it:
+            k = int(k)
+            if k not in parent:
+                parent[k] = root
+            else:
+                parent[find(k)] = root
+        op_root.append(root)
+
+    runs: List[List[int]] = []
+    by_root: Dict[int, int] = {}  # final root -> index into runs
+    for i, root in enumerate(op_root):
+        if root < 0:
+            runs.append([i])
+            continue
+        root = find(root)
+        j = by_root.get(root)
+        if j is None:
+            by_root[root] = len(runs)
+            runs.append([i])
+        else:
+            runs[j].append(i)
+    return runs
+
+
+def plan_emit(key_lists: Sequence[Sequence[int]], dp: int, cap: int
+              ) -> Tuple[List[int], List[int], List[int]]:
+    """Single-chunk least-loaded placement of one batch's key-runs.
+
+    Returns ``(order, assign, deferred)``: ``order`` is the emitted
+    permutation of op indices (feed the packed rows in this order),
+    ``assign[j]`` the stripe hint for emitted row j, ``deferred`` the
+    op indices (arrival order) carried into the NEXT super-batch —
+    tail rows of runs hotter than one stripe's remaining room.  The
+    emission always fits one dp×cap chunk, so ``plan_stripes`` on
+    ``(order, assign)`` dispatches it in ONE conflict-free plan with
+    zero cuts.
+
+    Placement: runs longest-first (LPT — the balance heuristic), each
+    run onto the least-loaded stripe; what outgrows that stripe's room
+    defers whole (earlier rows emitted now, later rows next batch, so
+    per-key FIFO survives).  While any run remains unplaced the placed
+    rows total strictly less than dp×cap, so the least-loaded stripe
+    always has room ≥ 1: a run's head — every cold singleton op —
+    never defers.  Within the longest-first sweep, equal-length runs
+    keep arrival order (python's stable sort), which also makes the
+    whole emission deterministic — replay-identical given the same
+    batch.
+    """
+    if dp < 1 or cap < 1:
+        raise ValueError(f"need dp >= 1 and cap >= 1, got {dp}/{cap}")
+    return _place_runs(key_runs(key_lists), dp, cap)
+
+
+def _place_runs(runs: List[List[int]], dp: int, cap: int
+                ) -> Tuple[List[int], List[int], List[int]]:
+    loads: List[int] = [0] * dp
+    stripes: List[List[int]] = [[] for _ in range(dp)]
+    deferred: List[int] = []
+    for run in sorted(runs, key=len, reverse=True):
+        s = min(range(dp), key=loads.__getitem__)
+        room = cap - loads[s]
+        # room == 0 only when every stripe is full, which (runs being
+        # a partition of ≤ dp*cap ops in the batcher's use) can only
+        # happen once every op is placed — defensively, the whole run
+        # then defers rather than overflowing the chunk
+        take, rest = run[:room] if room > 0 else [], run[max(room, 0):]
+        stripes[s].extend(take)
+        loads[s] += len(take)
+        deferred.extend(rest)
+    order: List[int] = []
+    assign: List[int] = []
+    for s, rows in enumerate(stripes):
+        order.extend(rows)
+        assign.extend([s] * len(rows))
+    deferred.sort()  # arrival order: the carryover re-enters FIFO
+    return order, assign, deferred
+
+
+class ConflictScheduler:
+    """Per-batch reordering between ``AdmissionQueue`` and the target.
+
+    Owned by the batcher thread; stateless across batches (the
+    starvation bound in the module docstring is exactly this
+    statelessness).  ``dp`` is the target's ``ingest_stripes`` and
+    ``cap`` the per-stripe row budget the downstream planner will
+    enforce — mirror of ``Mesh2DApplyTarget._apply_batch_locked``'s
+    ``cap = ceil(width / dp)`` so the hint and the enforcement agree.
+    """
+
+    def __init__(self, dp: int, *, recorder=None):
+        if dp < 1:
+            raise ValueError(f"ingest stripes must be >= 1, got {dp}")
+        # race-ok: read-only configuration after __init__
+        self.dp = int(dp)
+        # race-ok: read-only configuration after __init__ (the
+        # recorder locks itself)
+        self.recorder = recorder
+
+    def schedule(self, batch: Sequence, width: int
+                 ) -> Tuple[List, np.ndarray, List]:
+        """Reorder one drained batch of ``OpRequest``-shaped items
+        (anything exposing ``.elements``) and return ``(emitted,
+        assign, deferred)``: the reordered list, an int32 stripe hint
+        per emitted item ready for ``ingest_batch(...,
+        stripe_hint=...)``, and the hot-run tail items the batcher
+        must carry — AT THE FRONT — into its next drained batch.
+        ``width`` is the batcher's packed row budget (== the target
+        batch axis), from which the per-stripe capacity derives."""
+        cap = max(1, -(-int(width) // self.dp))
+        runs = key_runs([r.elements for r in batch])
+        order, assign, deferred_ix = _place_runs(runs, self.dp, cap)
+        emitted = [batch[i] for i in order]
+        hint = np.asarray(assign, np.int32)
+        if self.recorder is not None:
+            coalesced = len(batch) - len(runs)
+            self.recorder.count("sched.keyruns", len(runs))
+            if coalesced:
+                self.recorder.count("sched.coalesced_rows", coalesced)
+            if deferred_ix:
+                self.recorder.count("sched.deferred_rows",
+                                    len(deferred_ix))
+            for j, i in enumerate(order):
+                self.recorder.observe("sched.reorder_distance",
+                                      abs(j - i))
+            self.recorder.set_gauge(
+                "sched.stripe_fill",
+                len(order) / float(self.dp * cap))
+        return emitted, hint, [batch[i] for i in deferred_ix]

@@ -812,6 +812,10 @@ def test_gate_fast(tmp_path):
     # degrade-window latch cross-thread
     assert {"ReplicationPublisher", "ShardStandby",
             "DegradeWindow"} <= covered, covered
+    # ... and the conflict-aware admission scheduler (the hot-key
+    # ISSUE): owned by the batcher loop thread, race-ok-annotated
+    # read-only config — the sweep keeps those annotations honest
+    assert "ConflictScheduler" in covered, covered
     # the wire-contract suite (the protocol-contract ISSUE): W001-W004
     # + M001 must have swept the dialect modules, every registered
     # dispatcher, the full codec registry, and the metric-name surface

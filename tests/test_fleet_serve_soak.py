@@ -156,6 +156,64 @@ def test_fleet_serve_soak_mesh_quick_mode(tmp_path):
 
 
 @pytest.mark.slow
+def test_fleet_serve_soak_zipf_quick_mode(tmp_path):
+    """The conflict-aware admission scheduling soak (--zipf --quick,
+    DESIGN.md §25): scheduled dp-ladder legs under zipf hot-key skew
+    through real ``serve --mesh-devices --sched on`` workers, an
+    unscheduled (--sched off) baseline at the widest dp, and the
+    SIGKILL replay-parity leg.  Adjudicates the tentpole acceptance:
+    cuts-per-super-batch at dp=4/s=1.2 reduced ≥5× vs unscheduled,
+    rows-per-dispatch ≥1.5× the dp=1 leg's, durable log replays
+    bitwise-identically through the plain sequential Node and the 2-D
+    mesh class, zero acked-op loss."""
+    import fleet_serve_soak
+
+    out = str(tmp_path / "MESH_CURVE.json")
+    rc = fleet_serve_soak.main(["--zipf", "--quick", "--out", out])
+    assert rc == 0, "zipf soak failed (cuts not reduced, rpd not " \
+                    "scaled, replay mismatch, or acked-op loss)"
+    with open(out) as f:
+        artifact = json.load(f)
+
+    curve = artifact["zipf_curve"]
+    # 2 exponents x the quick dp ladder, every leg scheduler-on and
+    # self-reporting it in the worker banner
+    assert [(leg["zipf_s"], leg["mesh_devices"]) for leg in curve] == \
+        [(0.99, "1x2"), (0.99, "4x2"), (1.2, "1x2"), (1.2, "4x2")]
+    for leg in curve:
+        assert leg["unresolved"] == 0, leg
+        assert leg["goodput"] > 0, leg
+        assert leg["worker_banner_mesh"] == leg["mesh_devices"]
+        assert leg["worker_banner_sched"] == "on"
+        assert leg["workload"].startswith("zipf("), leg["workload"]
+        # the scheduler ran: key-runs were found and counted
+        assert leg["server_mesh"]["sched"]["sched.keyruns"] > 0, leg
+
+    baseline = artifact["zipf_baseline"]
+    assert baseline["worker_banner_sched"] == "off"
+    assert "sched.keyruns" not in baseline["server_mesh"]["sched"]
+    # the tentpole ratios (each worker's own counters)
+    deep = next(leg for leg in curve
+                if leg["zipf_s"] == 1.2 and leg["mesh_devices"] == "4x2")
+    dp1 = next(leg for leg in curve
+               if leg["zipf_s"] == 1.2 and leg["mesh_devices"] == "1x2")
+    base_cps = baseline["server_mesh"]["cuts_per_super_batch"]
+    sched_cps = deep["server_mesh"]["cuts_per_super_batch"]
+    assert base_cps > 0, baseline["server_mesh"]
+    assert base_cps >= 5 * sched_cps, (base_cps, sched_cps)
+    assert deep["server_mesh"]["rows_per_dispatch"] > \
+        1.5 * dp1["server_mesh"]["rows_per_dispatch"]
+
+    replay = artifact["zipf_replay"]
+    assert replay["bitwise_equal"], replay["mismatched_fields"]
+    assert replay["members_agree"], replay
+    assert replay["acked_adds"] > 0
+    assert replay["lost_acked_ops"] == []
+    assert replay["phantom_members"] == []
+    assert replay["worker_banner_sched"] == "on"
+
+
+@pytest.mark.slow
 def test_fleet_serve_soak_router_ha_quick_mode(tmp_path):
     """The router-HA soak (--router-ha --quick, DESIGN.md §22): a
     SIGKILLed primary router fails over to its warm standby inside the

@@ -1,0 +1,361 @@
+"""Conflict-aware admission scheduler (serve/scheduler.py, DESIGN.md
+§25): key-runs, single-chunk emission with hot-tail carryover, and the
+ordering contract.
+
+The pinned surface is the §25 triple: (1) per-key FIFO survives every
+reordering AND every deferral — ops sharing a key never swap, across
+batches included; (2) the emitted order IS the durable order — a 2-D
+mesh target fed the scheduler's emission with its stripe hint lands
+BITWISE identical to a plain sequential node fed the same emitted log;
+(3) the starvation bound — a cold op ships in the super-batch it was
+drained into, a hot run's deferred tail re-enters at the FRONT of the
+next one.  Hints are advisory: an adversarial stripe assignment may
+cost cuts, never correctness.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from go_crdt_playground_tpu.net.peer import Node
+from go_crdt_playground_tpu.obs import Recorder
+from go_crdt_playground_tpu.parallel.meshtarget2d import (
+    Mesh2DApplyTarget, plan_stripes)
+from go_crdt_playground_tpu.serve import protocol
+from go_crdt_playground_tpu.serve.admission import AdmissionQueue, OpRequest
+from go_crdt_playground_tpu.serve.batcher import MicroBatcher
+from go_crdt_playground_tpu.serve.scheduler import (ConflictScheduler,
+                                                    key_runs, plan_emit)
+
+
+class _Op:
+    """The minimal ``.elements``-bearing shape schedule() contracts on."""
+
+    __slots__ = ("req_id", "elements")
+
+    def __init__(self, req_id, elements):
+        self.req_id = req_id
+        self.elements = list(elements)
+
+
+class _Session:
+    """Ack sink for batcher-level tests: records every reply in order."""
+
+    def __init__(self):
+        self.sent = []
+
+    def send(self, kind, body):
+        self.sent.append((kind, bytes(body)))
+        return True
+
+
+def _assert_states_equal(a, b, context=""):
+    for name in a._fields:
+        xa, xb = np.asarray(getattr(a, name)), np.asarray(getattr(b, name))
+        assert np.array_equal(xa, xb), (context, name)
+
+
+# ---------------------------------------------------------------------------
+# key_runs
+# ---------------------------------------------------------------------------
+
+
+def test_key_runs_partitions_transitively():
+    # {0,1} bridges key a=5 and b=9 through op 2's {5, 9}; op 3 is its
+    # own cold run; op 4 rejoins the bridged run through key 9
+    runs = key_runs([[5], [9], [5, 9], [77], [9]])
+    assert runs == [[0, 1, 2, 4], [3]]
+
+
+def test_key_runs_keeps_arrival_order_within_run():
+    runs = key_runs([[1], [2], [1], [1], [2]])
+    assert runs == [[0, 2, 3], [1, 4]]
+
+
+def test_key_runs_empty_selector_is_singleton():
+    assert key_runs([[], [3], []]) == [[0], [1], [2]]
+
+
+# ---------------------------------------------------------------------------
+# plan_emit: single chunk + carryover
+# ---------------------------------------------------------------------------
+
+
+def test_plan_emit_rejects_bad_shape():
+    with pytest.raises(ValueError):
+        plan_emit([[1]], 0, 4)
+    with pytest.raises(ValueError):
+        plan_emit([[1]], 2, 0)
+
+
+def test_plan_emit_hot_tail_defers_cold_head_ships():
+    # dp=2, cap=2: a 5-op hot run on key 0 plus one cold op on key 9.
+    # The hot run takes one whole stripe (2 rows), the cold op the
+    # other; the hot TAIL (3 rows) defers — the cold op must NOT.
+    keys = [[0], [0], [0], [9], [0], [0]]
+    order, assign, deferred = plan_emit(keys, 2, 2)
+    assert len(order) == len(assign) == 3
+    assert sorted(order + deferred) == list(range(6))
+    assert 3 in order  # the cold op shipped this super-batch
+    assert deferred == sorted(deferred)  # carryover re-enters FIFO
+    # hot rows emitted are the run's HEAD, in arrival order
+    hot_emitted = [i for i in order if i != 3]
+    assert hot_emitted == [0, 1]
+    assert deferred == [2, 4, 5]
+    # one stripe per run: the hot rows share one hint, the cold op the
+    # other
+    hints = {keys[i][0]: assign[j] for j, i in enumerate(order)}
+    assert hints[0] != hints[9]
+
+
+def test_plan_emit_single_chunk_never_overflows():
+    rng = np.random.default_rng(5)
+    for trial in range(50):
+        dp = int(rng.integers(1, 5))
+        cap = int(rng.integers(1, 6))
+        n = int(rng.integers(1, dp * cap + 1))
+        key_lists = [[int(k) for k in rng.integers(0, 6, rng.integers(1, 3))]
+                     for _ in range(n)]
+        order, assign, deferred = plan_emit(key_lists, dp, cap)
+        assert sorted(order + deferred) == list(range(n)), trial
+        assert len(assign) == len(order)
+        loads = np.bincount(assign, minlength=dp) if assign else \
+            np.zeros(dp, int)
+        assert loads.max(initial=0) <= cap, trial
+        # per-key FIFO across emission + deferral: ops sharing a run
+        # appear in arrival order in (emitted ++ deferred)
+        seq = order + deferred
+        pos = {i: j for j, i in enumerate(seq)}
+        for run in key_runs(key_lists):
+            assert [pos[i] for i in run] == sorted(pos[i] for i in run), trial
+        # a run lands on ONE stripe (the coalescing guarantee)
+        stripe_of = {i: assign[j] for j, i in enumerate(order)}
+        for run in key_runs(key_lists):
+            stripes = {stripe_of[i] for i in run if i in stripe_of}
+            assert len(stripes) <= 1, trial
+
+
+def test_plan_emit_cold_ops_never_defer():
+    # while any run remains unplaced, placed < dp*cap, so every run's
+    # head gets a slot: with all-singleton input NOTHING defers
+    rng = np.random.default_rng(6)
+    for _ in range(20):
+        dp = int(rng.integers(1, 5))
+        cap = int(rng.integers(1, 6))
+        n = int(rng.integers(1, dp * cap + 1))
+        key_lists = [[int(i)] for i in rng.choice(10_000, n, replace=False)]
+        order, _, deferred = plan_emit(key_lists, dp, cap)
+        assert deferred == []
+        assert sorted(order) == list(range(n))
+
+
+# ---------------------------------------------------------------------------
+# ConflictScheduler: streaming FIFO + observability
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_stream_fifo_with_key_audit():
+    """The batcher-shaped stream: each round drains fresh ops, prepends
+    the last round's deferral, schedules.  Across the WHOLE stream each
+    key's ops must emit in arrival order, and every op ships once."""
+    rng = np.random.default_rng(8)
+    dp, width = 4, 16
+    sched = ConflictScheduler(dp)
+    keys_of = {}
+    emitted_ids, carry, next_id = [], [], 0
+    for _ in range(30):
+        fresh = []
+        for _ in range(width - len(carry)):
+            ks = [int(k) for k in rng.choice(8, rng.integers(1, 3),
+                                             replace=False)]
+            keys_of[next_id] = ks
+            fresh.append(_Op(next_id, ks))
+            next_id += 1
+        emitted, _, carry = sched.schedule(carry + fresh, width)
+        emitted_ids.extend(r.req_id for r in emitted)
+    while carry:
+        emitted, _, carry = sched.schedule(carry, width)
+        emitted_ids.extend(r.req_id for r in emitted)
+    assert sorted(emitted_ids) == list(range(next_id))
+    pos = {i: j for j, i in enumerate(emitted_ids)}
+    per_key = {}
+    for i in range(next_id):
+        for k in keys_of[i]:
+            per_key.setdefault(k, []).append(pos[i])
+    for k, positions in per_key.items():
+        assert positions == sorted(positions), f"key {k} reordered"
+
+
+def test_scheduler_metrics_flow():
+    rec = Recorder()
+    sched = ConflictScheduler(2, recorder=rec)
+    # 5 ops on key 0 (hot: cap=2 → 3 defer) + 1 cold: 2 runs, 4
+    # coalesced rows, stripe_fill = 3/4
+    batch = [_Op(i, [0]) for i in range(5)] + [_Op(5, [9])]
+    emitted, hint, deferred = sched.schedule(batch, 4)
+    assert rec.counter("sched.keyruns") == 2
+    assert rec.counter("sched.coalesced_rows") == 4
+    assert rec.counter("sched.deferred_rows") == 3
+    assert rec.gauge("sched.stripe_fill") == pytest.approx(3 / 4)
+    snap = rec.snapshot()
+    assert snap["observations"]["sched.reorder_distance"]["n"] == 3
+    assert [r.req_id for r in deferred] == [2, 3, 4]
+
+
+def test_scheduler_rejects_bad_dp():
+    with pytest.raises(ValueError):
+        ConflictScheduler(0)
+
+
+# ---------------------------------------------------------------------------
+# batcher carryover: deferral acks next batch, at the front
+# ---------------------------------------------------------------------------
+
+
+class _RecordingTarget:
+    """ApplyTarget stub recording packed batches (no jax)."""
+
+    def __init__(self, num_elements, ingest_stripes):
+        self.num_elements = num_elements
+        self.ingest_stripes = ingest_stripes
+        self.calls = []
+
+    def ingest_batch(self, add_rows, del_rows, live, stripe_hint=None):
+        self.calls.append((add_rows.copy(), live.copy(),
+                           None if stripe_hint is None
+                           else stripe_hint.copy()))
+
+
+def test_batcher_carry_acks_deferred_next_batch_first():
+    dp, mb, E = 2, 2, 32
+    target = _RecordingTarget(E, dp)
+    q = AdmissionQueue(64)
+    sched = ConflictScheduler(dp)
+    b = MicroBatcher(target, q, max_batch=mb, scheduler=sched)
+    sess = _Session()
+    # width=4, cap=2: four hot ops on key 3 → 2 emit, 2 carry
+    hot = [OpRequest(i, protocol.OP_ADD, [3], None, sess, 0.0)
+           for i in range(4)]
+    b._apply(list(hot))
+    assert len(target.calls) == 1
+    acked = [protocol.decode_ack(body) for _, body in sess.sent]
+    assert acked == [0, 1]  # the hot head, in arrival order
+    assert [r.req_id for r in b._carry] == [2, 3]
+    # next round: a fresh hot op arrives AFTER the carried tail — the
+    # tail must precede it (per-key FIFO across the deferral) and the
+    # cold op still ships alongside
+    late = [OpRequest(4, protocol.OP_ADD, [3], None, sess, 0.0),
+            OpRequest(5, protocol.OP_ADD, [7], None, sess, 0.0)]
+    b._apply(late)
+    acked = [protocol.decode_ack(body) for _, body in sess.sent]
+    assert acked[:2] == [0, 1]
+    # the carried tail [2, 3] rejoined its run AHEAD of the newer hot
+    # op 4, which (run of 3, cap 2) defers in turn; the cold op never
+    # starves
+    assert 2 in acked and 3 in acked and 5 in acked and 4 not in acked
+    assert [r.req_id for r in b._carry] == [4]
+    # drain flushes the last tail even with an empty queue
+    q.close()
+    b._flush_remaining()
+    acked = [protocol.decode_ack(body) for _, body in sess.sent]
+    assert sorted(acked) == list(range(6))
+    assert b._carry == []
+
+
+def test_batcher_hint_rides_to_target():
+    dp, mb, E = 2, 2, 32
+    target = _RecordingTarget(E, dp)
+    sched = ConflictScheduler(dp)
+    b = MicroBatcher(target, AdmissionQueue(64), max_batch=mb,
+                     scheduler=sched)
+    sess = _Session()
+    b._apply([OpRequest(i, protocol.OP_ADD, [k], None, sess, 0.0)
+              for i, k in enumerate([1, 2, 1])])
+    (add, live, hint), = target.calls
+    assert add.shape == (4, E) and hint.shape == (4,)
+    assert live.sum() == 3 and (hint[live] >= 0).all()
+    assert (hint[~live] == -1).all()
+    # the key-1 run coalesced onto ONE stripe
+    rows_k1 = np.where(add[:, 1])[0]
+    assert len(set(hint[rows_k1].tolist())) == 1
+
+
+# ---------------------------------------------------------------------------
+# the §25 durable-order contract: emitted order ⇒ bitwise mesh parity
+# ---------------------------------------------------------------------------
+
+
+E2, A2 = 256, 4
+
+
+def _zipf_batches(rng, rounds, width, s=1.2):
+    p = np.arange(1, E2 + 1, dtype=np.float64) ** -s
+    p /= p.sum()
+    for _ in range(rounds):
+        n = int(rng.integers(1, width + 1))
+        yield [[int(k)] for k in rng.choice(E2, size=n, p=p)]
+
+
+@pytest.mark.parametrize("shape", ["2x2", "4x2"])
+def test_mesh2d_scheduled_stream_bitwise_parity(shape):
+    """The tentpole pin: a dp×mp mesh fed the scheduler's emission +
+    hint, batch after batch WITH carryover, lands bitwise identical to
+    a plain sequential node fed the same emitted log — and the hinted
+    emission plans with ZERO cuts (the scheduler's whole point)."""
+    dp, mp = (int(x) for x in shape.split("x"))
+    if jax.device_count() < dp * mp:
+        pytest.skip(f"needs {dp * mp} devices")
+    rng = np.random.default_rng(31)
+    mb = 2
+    width = dp * mb
+    cap = mb
+    sched = ConflictScheduler(dp)
+    plain = Node(0, E2, A2)
+    mesh = Mesh2DApplyTarget(0, E2, A2, mesh_shape=shape)
+    next_id, carry = 0, []
+    total_cuts = 0
+    for key_lists in _zipf_batches(rng, 8, width):
+        fresh = [_Op(next_id + i, ks) for i, ks in enumerate(key_lists)]
+        fresh = fresh[:max(0, width - len(carry))]
+        next_id += len(fresh)
+        emitted, assign, carry = sched.schedule(carry + fresh, width)
+        if not emitted:
+            continue
+        add = np.zeros((width, E2), bool)
+        live = np.zeros(width, bool)
+        hint = np.full(width, -1, np.int32)
+        for j, r in enumerate(emitted):
+            add[j, r.elements] = True
+            live[j] = True
+            hint[j] = assign[j]
+        dl = np.zeros((width, E2), bool)
+        _, cuts = plan_stripes(add, dl, live, dp, cap, assign=hint)
+        total_cuts += cuts
+        plain.ingest_batch(add, dl, live)
+        mesh.ingest_batch(add, dl, live, stripe_hint=hint)
+    assert total_cuts == 0  # pre-striped emission: plan_stripes stops cutting
+    _assert_states_equal(plain.state_slice(), mesh.state_slice(),
+                         f"shape={shape}")
+
+
+def test_mesh2d_adversarial_hint_is_safe():
+    """A hostile/stale hint (every row pinned to stripe 0, or random
+    junk) may cost cuts but must not change the state: ownership and
+    capacity are enforced by plan_stripes itself."""
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    rng = np.random.default_rng(32)
+    plain = Node(0, E2, A2)
+    mesh = Mesh2DApplyTarget(0, E2, A2, mesh_shape="2x2")
+    B = 8
+    for trial in range(3):
+        add = rng.random((B, E2)) < 0.02
+        dl = rng.random((B, E2)) < 0.01
+        live = rng.random(B) < 0.9
+        hint = np.asarray([0] * B if trial == 0
+                          else rng.integers(0, 2, B), np.int32)
+        plain.ingest_batch(add, dl, live)
+        mesh.ingest_batch(add, dl, live, stripe_hint=hint)
+    _assert_states_equal(plain.state_slice(), mesh.state_slice(),
+                         "adversarial hint")

@@ -248,11 +248,16 @@ def test_client_fails_fast_after_reader_death(frontend):
 
 def _gate_batcher(fe):
     """Block the batcher inside its next apply until the gate releases —
-    the deterministic way to make the admission queue back up."""
+    the deterministic way to make the admission queue back up.
+    ``gate.entered`` is set when the batcher is actually blocked inside
+    the gated apply (holding its drained ops), so tests can hand-shake
+    instead of guessing how many ops the first drain grabbed."""
     gate = threading.Event()
+    gate.entered = threading.Event()
     inner = fe.node.ingest_batch
 
     def gated(*args, **kwargs):
+        gate.entered.set()
         gate.wait(10.0)
         return inner(*args, **kwargs)
 
@@ -268,8 +273,14 @@ def test_overload_sheds_with_typed_reply(tmp_path):
     try:
         with ServeClient(_addr(fe)) as c:
             # one op occupies the (gated) batcher, two fill the queue;
-            # the fourth MUST shed with the typed Overloaded reply
-            ops = [c.submit_async(protocol.OP_ADD, [i]) for i in range(3)]
+            # the fourth MUST shed with the typed Overloaded reply.
+            # Hand-shake the first op into the batcher before the next
+            # two: submitted back-to-back they can outrun the batcher's
+            # wake-up, fill the depth-2 queue, and shed op 3 instead
+            # of op 4 (the depth poll below then spins forever)
+            ops = [c.submit_async(protocol.OP_ADD, [0])]
+            assert gate.entered.wait(5.0)
+            ops += [c.submit_async(protocol.OP_ADD, [i]) for i in (1, 2)]
             while fe.queue.depth() < 2:
                 time.sleep(0.005)
             with pytest.raises(protocol.Overloaded):
@@ -293,8 +304,13 @@ def test_deadline_propagation_sheds_expired(tmp_path):
     try:
         with ServeClient(_addr(fe)) as c:
             hold = c.submit_async(protocol.OP_ADD, [1])  # gates the batcher
-            while fe.queue.depth() > 0:  # batcher took hold -> blocked
-                time.sleep(0.005)        # inside the gated apply
+            # batcher took hold -> blocked inside the gated apply (the
+            # depth poll alone races: it reads 0 before hold is even
+            # admitted, and a late batcher wake-up could then drain
+            # hold AND doomed in one batch before the deadline passes)
+            assert gate.entered.wait(5.0)
+            while fe.queue.depth() > 0:
+                time.sleep(0.005)
             doomed = c.submit_async(protocol.OP_ADD, [2], deadline_s=0.01)
             time.sleep(0.05)  # deadline passes while queued
             gate.set()
@@ -316,7 +332,14 @@ def test_graceful_drain_acks_admitted_ops(tmp_path):
     fe.serve()
     addr = _addr(fe)
     with ServeClient(addr) as c:
-        ops = [c.submit_async(protocol.OP_ADD, [i]) for i in range(6)]
+        # hand-shake the first op into the gated batcher BEFORE the
+        # rest are submitted: without it the first drain may grab 2+
+        # ops (reader admits faster than the batcher wakes on a busy
+        # box) and the queue can never back up to 5 — the poll below
+        # would spin forever
+        ops = [c.submit_async(protocol.OP_ADD, [0])]
+        assert gate.entered.wait(5.0)
+        ops += [c.submit_async(protocol.OP_ADD, [i]) for i in range(1, 6)]
         while fe.queue.depth() < 5:  # one op is held by the gated batcher
             time.sleep(0.005)
         # drain while ops are queued: a new op gets the typed Draining

@@ -50,6 +50,21 @@ single-node load generator runs against the fleet as-is.
   restart, zero acked-op loss, zero phantoms).  Results merge into
   MESH_CURVE.json alongside bench.py --mesh's kernel curve.
 
+* **zipf mode** (``--zipf``, DESIGN.md §25) — the conflict-aware
+  admission scheduler under hot-key skew: per zipf exponent
+  (s ∈ {0.99, 1.2}, ``tools/workloads.ZipfKeys``) a 2-D dp ladder of
+  scheduled workers plus an UNSCHEDULED (``--sched off``) baseline at
+  the widest dp, each leg carrying the worker's own
+  ``mesh.stripe.cuts`` / rows-per-dispatch census; a replay-parity
+  leg (SIGKILL a scheduled worker after ledgered concurrent zipf
+  traffic, then restore its durable store through BOTH the plain
+  sequential node — the "sequential worker fed the scheduler's
+  emitted op order", since WAL records follow dispatch order — and
+  the 2-D mesh class, diffed bitwise, zero acked-op loss).
+  Adjudicates: cuts-per-super-batch at the widest dp reduced ≥5× vs
+  the unscheduled baseline at s=1.2, and rows-per-dispatch ≥1.5× the
+  dp=1 leg's.  Results merge into MESH_CURVE.json.
+
 * **chaos leg** (default sweep) — a deterministic ``ChaosProxy``
   interposed on ONE router↔shard downstream link: torn frames, then
   an asymmetric partition, then heal.  The victim keyspace degrades
@@ -99,6 +114,7 @@ Usage:
     python tools/fleet_serve_soak.py --quick    # CI-sized (slow-marked
                                                 # pytest wraps this)
     python tools/fleet_serve_soak.py --mesh [--quick]   # mesh soak
+    python tools/fleet_serve_soak.py --zipf [--quick]   # hot-key sched soak
     python tools/fleet_serve_soak.py --autopilot [--quick]  # control loop
     python tools/fleet_serve_soak.py --out P    # default SHARD_CURVE.json
 """
@@ -687,44 +703,51 @@ def _mesh_device_count(spec) -> int:
     return parsed if isinstance(parsed, int) else parsed[0] * parsed[1]
 
 
-def _mesh_spec(devices, elements: int, seed: int,
+def _mesh_spec(devices, elements: int, seed: int, sched: str = None,
                **kw) -> FleetSpec:
     """A 1-shard fleet whose worker runs ``serve --mesh-devices N``
     (1-D) or ``--mesh-devices DPxMP`` (the 2-D replicated-ingest mesh,
-    DESIGN.md §24).  CPU workers need the forced host-device-count
-    flag in their OWN env (jax honors it only at process init); a
-    worker that comes up and prints its address PROVES the devices
-    existed — mesh construction refuses a mesh wider than the visible
-    device set."""
+    DESIGN.md §24).  ``sched`` forwards the worker's ``--sched``
+    flag (None = the CLI's "auto": the scheduler rides exactly when
+    dp > 1 — the zipf mode's ``"off"`` is the unscheduled baseline).
+    CPU workers need the forced host-device-count flag in their OWN
+    env (jax honors it only at process init); a worker that comes up
+    and prints its address PROVES the devices existed — mesh
+    construction refuses a mesh wider than the visible device set."""
     count = _mesh_device_count(devices)
     extra_env = ()
     if count > 1:
         extra_env = (("XLA_FLAGS",
                       "--xla_force_host_platform_device_count="
                       f"{count}"),)
+    extra_args = ("--mesh-devices", str(devices))
+    if sched is not None:
+        extra_args += ("--sched", sched)
     return FleetSpec(n_shards=1, elements=elements, seed=seed,
-                     extra_args=("--mesh-devices", str(devices)),
+                     extra_args=extra_args,
                      extra_env=extra_env, **kw)
 
 
-def _worker_mesh_banner(fleet: ShardFleet) -> str:
-    """The worker's self-reported mesh width, parsed from its serve
-    banner (the ``mesh=N`` field) — the artifact records what the
-    subprocess actually ran, not what we asked for."""
+def _worker_mesh_banner(fleet: ShardFleet, field: str = "mesh") -> str:
+    """The worker's self-reported mesh width (or any other banner
+    field, e.g. ``sched``), parsed from its serve banner — the
+    artifact records what the subprocess actually ran, not what we
+    asked for."""
     import re as _re
 
     proc = fleet.shards[0]
     with proc._line_cond:
         lines = list(proc._lines)
     for ln in lines:
-        m = _re.search(rb"mesh=(\w+)", ln)
+        m = _re.search(field.encode() + rb"=(\w+)", ln)
         if m:
             return m.group(1).decode()
     return ""
 
 
 def mesh_sweep_leg(root: str, devices, elements: int, rate: float,
-                   duration_s: float, seed: int,
+                   duration_s: float, seed: int, keys=None,
+                   sched: str = None, leg_dir: str = None,
                    **fleet_kw) -> Dict[str, object]:
     """One mesh spec's open-loop point: a real ``serve --mesh-devices
     <spec>`` worker behind a real router, unmodified ServeClient load.
@@ -733,14 +756,24 @@ def mesh_sweep_leg(root: str, devices, elements: int, rate: float,
     (regime documentation); the 2-D dp ladder DOES make a scaling
     claim even here — dp multiplies the rows per dispatch+fsync, which
     is dispatch-count amortization, not core parallelism.  The on-chip
-    capture rides tools/capture_all.sh."""
-    spec = _mesh_spec(devices, elements, seed, **fleet_kw)
-    fleet = ShardFleet(REPO, os.path.join(root, f"mesh-{devices}"), spec)
+    capture rides tools/capture_all.sh.
+
+    ``keys`` forwards a named key picker (tools/workloads.py — the
+    zipf mode's hot-key streams), ``sched`` the worker's ``--sched``
+    flag, ``leg_dir`` a distinct durable subdir for legs that share a
+    mesh spec (the zipf mode runs one spec at several exponents)."""
+    spec = _mesh_spec(devices, elements, seed, sched=sched, **fleet_kw)
+    fleet = ShardFleet(REPO, os.path.join(root, leg_dir or
+                                          f"mesh-{devices}"), spec)
     try:
         addr = fleet.start()
-        leg = serve_soak.open_loop_leg(addr, rate, duration_s, elements)
+        leg = serve_soak.open_loop_leg(addr, rate, duration_s, elements,
+                                       keys=keys)
         leg["mesh_devices"] = devices
         leg["worker_banner_mesh"] = _worker_mesh_banner(fleet)
+        if sched is not None:
+            leg["worker_banner_sched"] = _worker_mesh_banner(fleet,
+                                                             "sched")
         # the worker's own dispatch census: rows per durable group
         # commit is the dp mechanism (stripes × max_batch under
         # saturation) and — unlike cross-worker goodput ratios on a
@@ -752,11 +785,22 @@ def mesh_sweep_leg(root: str, devices, elements: int, rate: float,
             dispatches = counters.get("ingest.dispatches", 0)
             rows = counters.get("mesh.stripe.rows",
                                 counters.get("serve.ops.acked", 0))
+            cuts = counters.get("mesh.stripe.cuts", 0)
+            # cuts per SUPER-batch (one serve.batches per drained
+            # batch; a cut splits it into extra dispatches) — the
+            # zipf mode's scheduled-vs-unscheduled census
+            batches = counters.get("serve.batches", 0)
             leg["server_mesh"] = {
                 "dispatches": dispatches,
-                "stripe_cuts": counters.get("mesh.stripe.cuts", 0),
+                "stripe_cuts": cuts,
+                "cuts_per_super_batch": (round(cuts / batches, 3)
+                                         if batches else 0.0),
                 "rows_per_dispatch": (round(rows / dispatches, 2)
                                       if dispatches else 0.0),
+                "sched": {k: counters[k] for k in
+                          ("sched.keyruns", "sched.coalesced_rows",
+                           "sched.deferred_rows")
+                          if k in counters},
             }
         except Exception as e:  # noqa: BLE001 — census is evidence,
             # not control flow; a failed STATS pull is recorded
@@ -1086,6 +1130,252 @@ def run_mesh_mode(args) -> int:
         ok = ok and leg["lost_acked_ops"] == []
         ok = ok and leg["phantom_members"] == []
         ok = ok and leg["unfinished"] == []
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# zipf hot-key legs (conflict-aware admission scheduling, DESIGN.md §25)
+# — `--zipf` mode
+# ---------------------------------------------------------------------------
+
+
+def zipf_replay_leg(root: str, devices, elements: int, seed: int,
+                    s: float = 1.2, rate: float = 800.0,
+                    duration_s: float = 3.0,
+                    **fleet_kw) -> Dict[str, object]:
+    """The §25 durable-order pin at fleet scope, against REAL
+    scheduler reordering: a scheduled mesh worker takes CONCURRENT
+    zipf traffic (multi-connection, so drained batches really carry
+    coalescable hot-key runs), gets SIGKILLed with NO final checkpoint
+    — its durable log, written in the scheduler's emitted order, is
+    all that survives — and that log must replay to the same state by
+    BOTH classes:
+
+    - the harness restores a COPY of the durable dir via plain
+      ``Node.restore_durable`` — a sequential single-device worker
+      fed the emitted log, the ISSUE's reference executor;
+    - the restarted worker restores the original via its own
+      Mesh2DApplyTarget path (striped re-placement), serves the
+      membership read, and its graceful-drain checkpoint is restored
+      AGAIN in-harness and diffed bitwise against the sequential
+      replay.
+
+    Bitwise equality of every state field pins "dispatch order IS
+    durable order": counter prefixes, WAL record contents and replay
+    all agree with a sequential worker that never saw a stripe.  The
+    ledger adjudicates the §14 half: every acked add is a member
+    (zero acked-op loss across the SIGKILL), every member was
+    submitted (zero phantoms).  Deletes are disabled (``del_every=0``)
+    so the ledger's membership algebra stays exact under at-least-once
+    retries."""
+    import shutil as _shutil
+
+    import numpy as np
+
+    from go_crdt_playground_tpu.net.peer import Node
+
+    spec = _mesh_spec(devices, elements, seed, sched="on", **fleet_kw)
+    fleet = ShardFleet(REPO, os.path.join(root, "zipf-replay"), spec)
+    try:
+        addr = fleet.start()
+        keys = workloads.ZipfKeys(elements, s=s, seed=seed)
+        leg = serve_soak.open_loop_leg(addr, rate, duration_s, elements,
+                                       keys=keys, del_every=0,
+                                       ledgered=True)
+        banner_sched = _worker_mesh_banner(fleet, "sched")
+        # SIGKILL: no drain, no final checkpoint — recovery must come
+        # from checkpoint ⊔ WAL tail, i.e. replay the emitted order
+        fleet.kill_shard(0)
+
+        durable = os.path.join(root, "zipf-replay", "s0", "state")
+        # restore a COPY: Node.restore_durable leaves the WAL attached
+        # for further logging, and the restarted worker needs the
+        # original dir untouched
+        seq_copy = os.path.join(root, "zipf-replay", "seq-copy")
+        _shutil.copytree(durable, seq_copy)
+        # fallback_init: a short leg can SIGKILL before the first
+        # periodic checkpoint — the WAL then holds the ENTIRE emitted
+        # history and the sequential replay starts from zero (same
+        # shape the worker's own restore takes, serve CLI plumbing)
+        seq_node = Node.restore_durable(
+            seq_copy, fallback_init=lambda: Node(0, elements, 1))
+        try:
+            seq_state = seq_node.state_slice()
+            seq_members = set(seq_node.members().tolist())
+        finally:
+            seq_node.close()
+
+        # the mesh-class replay: the worker's own restore_durable
+        # (striped re-placement) — observable membership first, then
+        # the full state via its graceful-drain checkpoint
+        fleet.restart_shard(0)
+        with ServeClient(addr, timeout=30.0) as c:
+            members, _vv = c.members()
+        mesh_members = set(members)
+        fleet.close()  # graceful: final checkpoint of the mesh-restored
+        # state (no ops ran since restart, so it must equal the replay)
+        mesh_node = Node.restore_durable(
+            durable, fallback_init=lambda: Node(0, elements, 1))
+        try:
+            mesh_state = mesh_node.state_slice()
+        finally:
+            mesh_node.close()
+
+        mismatched = [
+            name for name in seq_state._fields
+            if not np.array_equal(np.asarray(getattr(seq_state, name)),
+                                  np.asarray(getattr(mesh_state, name)))]
+        acked = set(leg.get("acked_elements", []))
+        submitted = set(leg.get("submitted_elements", []))
+        return {
+            "mesh_devices": devices,
+            "workload": keys.name,
+            "worker_banner_sched": banner_sched,
+            "elements": elements,
+            "acked_adds": len(acked),
+            "traffic": {k: leg[k] for k in
+                        ("submitted", "acked", "goodput", "unresolved",
+                         "shed_overloaded", "p99_ms")},
+            "bitwise_equal": not mismatched,
+            "mismatched_fields": mismatched,
+            "members_agree": seq_members == mesh_members,
+            # MUST be []: an acked (fsync'd) add vanished across the
+            # SIGKILL — under scheduler reordering, the §14 contract
+            "lost_acked_ops": sorted(acked - seq_members),
+            # MUST be []: a member nobody submitted
+            "phantom_members": sorted(seq_members - submitted),
+        }
+    finally:
+        fleet.close()
+
+
+def run_zipf_mode(args) -> int:
+    """`--zipf`: the conflict-aware admission scheduler under hot-key
+    skew (DESIGN.md §25) — scheduled dp-ladder legs at zipf exponents
+    s∈{0.99, 1.2}, an UNSCHEDULED baseline (``--sched off``) at the
+    widest dp and the harshest exponent, and the SIGKILL replay-parity
+    leg.  Results merge into MESH_CURVE.json under ``zipf_*`` keys.
+
+    Adjudicated on per-worker counter ratios (weather-proof, the PR-15
+    lesson): at s=1.2 and the widest dp, cuts-per-super-batch reduced
+    ≥5× vs the unscheduled baseline, and rows-per-dispatch ≥1.5× the
+    dp=1 leg's — the scheduler keeps the dp× dispatch-amortization win
+    that uniform traffic gets for free."""
+    if args.quick:
+        elements = 144
+        dp_ladder = ["1x2", "4x2"]
+        duration_s = 3.0
+    else:
+        elements = 288
+        dp_ladder = ["1x2", "2x2", "4x2"]
+        duration_s = 6.0
+    exponents = [0.99, 1.2]
+    rate = 1600.0
+    deep2d = dp_ladder[-1]
+    # batch-bottlenecked like the --mesh dp ladder, but at max_batch=8:
+    # wide super-batches are where arrival-order stripe packing
+    # degenerates under skew (DESIGN.md §25) — the effect under test
+    ladder_kw = dict(max_batch=8, flush_ms=10.0)
+
+    t0 = time.time()
+    root = tempfile.mkdtemp(prefix="zipf-serve-soak-")
+    zipf_curve: List[Dict] = []
+    try:
+        for s in exponents:
+            for spec in dp_ladder:
+                keys = workloads.ZipfKeys(elements, s=s, seed=args.seed)
+                leg = mesh_sweep_leg(
+                    root, spec, elements, rate, duration_s, args.seed,
+                    keys=keys, sched="on",
+                    leg_dir=f"zipf-{spec}-s{s:g}-on", **ladder_kw)
+                leg["zipf_s"] = s
+                leg["sched"] = "on"
+                zipf_curve.append(leg)
+                print(json.dumps(leg), flush=True)
+        # the unscheduled baseline: same worker, same traffic, same
+        # width — only the scheduler off.  FIFO arrival order hits
+        # plan_stripes directly, so hot-key runs fill one stripe and
+        # cut the super-batch (the regression this PR removes)
+        baseline_keys = workloads.ZipfKeys(elements, s=exponents[-1],
+                                           seed=args.seed)
+        baseline = mesh_sweep_leg(
+            root, deep2d, elements, rate, duration_s, args.seed,
+            keys=baseline_keys, sched="off",
+            leg_dir=f"zipf-{deep2d}-s{exponents[-1]:g}-off", **ladder_kw)
+        baseline["zipf_s"] = exponents[-1]
+        baseline["sched"] = "off"
+        print(json.dumps(baseline), flush=True)
+        replay = zipf_replay_leg(root, deep2d, elements, args.seed + 3,
+                                 s=exponents[-1], **ladder_kw)
+        print(json.dumps({"zipf_replay": replay}), flush=True)
+    finally:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+    out = args.out or os.path.join(REPO, "MESH_CURVE.json")
+    prior: Dict = {}
+    if os.path.exists(out):
+        try:
+            with open(out) as f:
+                prior = json.load(f)
+        except ValueError:
+            prior = {}
+        if not isinstance(prior, dict):
+            prior = {}
+    artifact = dict(prior)
+    artifact.update({
+        "zipf_metric": (
+            "conflict-aware admission scheduling under zipf hot-key "
+            "skew (DESIGN.md §25): per-worker cuts-per-super-batch and "
+            "rows-per-dispatch across a scheduled dp ladder at "
+            "s∈{0.99,1.2}, vs an unscheduled (--sched off) baseline at "
+            "the widest dp, plus SIGKILL replay parity — the durable "
+            "log written in emitted order replays bitwise-identically "
+            "through a plain sequential Node and the 2-D mesh class"),
+        "zipf_fleet": {"elements": elements, "offered_rate": rate,
+                       "duration_s": duration_s, "seed": args.seed,
+                       "exponents": exponents, "dp_ladder": dp_ladder,
+                       "quick": bool(args.quick), **ladder_kw},
+        "zipf_curve": zipf_curve,
+        "zipf_baseline": baseline,
+        "zipf_replay": replay,
+        "zipf_elapsed_s": round(time.time() - t0, 1),
+    })
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"wrote {out}")
+
+    ok = all(leg["unresolved"] == 0 and leg["goodput"] > 0
+             and leg["worker_banner_mesh"] == str(leg["mesh_devices"])
+             and leg["worker_banner_sched"] == leg["sched"]
+             for leg in zipf_curve + [baseline])
+    # the tentpole's acceptance ratios, on ONE worker's own counters:
+    harsh = [leg for leg in zipf_curve
+             if leg["zipf_s"] == exponents[-1]]
+    deep_leg = next(leg for leg in harsh
+                    if leg["mesh_devices"] == deep2d)
+    dp1_leg = next(leg for leg in harsh
+                   if leg["mesh_devices"] == dp_ladder[0])
+    sched_cps = deep_leg.get("server_mesh", {}).get(
+        "cuts_per_super_batch")
+    base_cps = baseline.get("server_mesh", {}).get(
+        "cuts_per_super_batch")
+    # ≥5× cuts reduction: the baseline must actually cut (the effect
+    # exists to remove) and the scheduled worker must cut ≤ 1/5 of it
+    ok = ok and sched_cps is not None and base_cps is not None
+    ok = ok and base_cps > 0 and base_cps >= 5 * sched_cps
+    rpd_deep = deep_leg.get("server_mesh", {}).get(
+        "rows_per_dispatch", 0.0)
+    rpd_dp1 = dp1_leg.get("server_mesh", {}).get(
+        "rows_per_dispatch", 0.0)
+    ok = ok and rpd_dp1 > 0 and rpd_deep > 1.5 * rpd_dp1
+    ok = ok and replay["bitwise_equal"] and replay["members_agree"]
+    ok = ok and replay["acked_adds"] > 0
+    ok = ok and replay["lost_acked_ops"] == []
+    ok = ok and replay["phantom_members"] == []
+    ok = ok and replay["traffic"]["unresolved"] == 0
     return 0 if ok else 1
 
 
@@ -2559,6 +2849,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "goodput/p99 vs mesh device count + bitwise "
                          "parity + crash leg, merged into "
                          "MESH_CURVE.json (DESIGN.md §20)")
+    ap.add_argument("--zipf", action="store_true",
+                    help="conflict-aware admission scheduling soak "
+                         "instead of the shard sweep: scheduled dp "
+                         "ladder under zipf hot-key skew (s∈{0.99,1.2}) "
+                         "vs an unscheduled baseline, cuts-per-super-"
+                         "batch census, SIGKILL replay parity — merged "
+                         "into MESH_CURVE.json (DESIGN.md §25)")
     ap.add_argument("--autopilot", action="store_true",
                     help="fleet-autopilot soak instead of the shard "
                          "sweep: a real `autopilot` CLI subprocess "
@@ -2591,6 +2888,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.mesh:
         return run_mesh_mode(args)
+    if args.zipf:
+        return run_zipf_mode(args)
     if args.autopilot:
         return run_autopilot_mode(args)
     if args.router_ha:
