@@ -1,8 +1,9 @@
-"""The analysis gate CLI (DESIGN.md §15).
+"""The analysis gate CLI (DESIGN.md §15, §26).
 
     python -m go_crdt_playground_tpu.analysis            # full gate
     python -m go_crdt_playground_tpu.analysis --fast     # tier-1 budget
     python -m go_crdt_playground_tpu.analysis --out P    # report path
+    python -m go_crdt_playground_tpu.analysis --json     # machine summary
 
 Runs every registered pass and writes ``ANALYSIS_REPORT.json``:
 
@@ -21,14 +22,27 @@ Runs every registered pass and writes ``ANALYSIS_REPORT.json``:
    (``protocol_contract``), W003 codec symmetry with the seeded
    roundtrip/truncation/garble harness (``codec_symmetry``), and the
    M001 metrics contract (``metrics_contract``);
-6. report freshness: the COMMITTED ``ANALYSIS_REPORT.json``'s pass
+6. the protocol verification ladder (§26): E001 persist-before-
+   announce ordering over the registered promotion paths
+   (``epoch_order``), E002 fence coverage of every write-verb
+   dispatcher arm (``fence_coverage``), D002 blocking device
+   transfers under held locks (``transfer_lock``), and the
+   ``protomodel`` explorer — exhaustive interleaving+crash
+   enumeration of the router-HA / shard-replication / handoff models
+   with E003 freshness pins against the mirrored source;
+7. report freshness: the COMMITTED ``ANALYSIS_REPORT.json``'s pass
    list must match the registered passes — a new pass cannot land
    while the committed artifact silently claims full coverage.
+
+All source-reading passes share one parse cache (``loader.py``); its
+hit counts and the gate wall time land in the report's ``meta`` block
+(tier-1 asserts ``--fast`` stays under ``FAST_BUDGET_S``).
 
 Exit status: 0 iff no ERROR finding.  ``--fast`` trims the lattice
 seeds, the codec sample counts, and the lockset exercise, not the
 pass list — every pass runs in every mode (tier-1 wires ``--fast`` in
-as a non-slow test).
+as a non-slow test).  ``--json`` prints one machine-readable summary
+line instead of per-finding lines; the exit contract is identical.
 """
 
 from __future__ import annotations
@@ -131,6 +145,11 @@ ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
                 "_storage": "DegradeWindow",
                 "scheduler": "ConflictScheduler"}
 
+# the D002 sweep: every lock-swept runtime file plus the framing
+# module (its WAL-record encoder runs under the node lock by call,
+# not by lexical with-block — the fixpoint finds it)
+TRANSFER_TARGETS = LOCK_TARGETS + ["net/framing.py"]
+
 # the full pass list (report keys): the report-freshness lint pins the
 # COMMITTED artifact's pass list to this — landing a new pass without
 # regenerating ANALYSIS_REPORT.json fails the gate instead of letting
@@ -138,7 +157,14 @@ ATTR_CLASSES = {"wal": "DeltaWal", "node": "Node",
 REGISTERED_PASSES = ("lockdiscipline", "locksets", "durability",
                      "purity", "lattice_laws", "protocol_contract",
                      "codec_symmetry", "metrics_contract",
-                     "report_freshness", "thread_shadow")
+                     "report_freshness", "thread_shadow",
+                     "epoch_order", "fence_coverage", "transfer_lock",
+                     "protomodel")
+
+# the --fast wall-time envelope (meta.fast_budget_s): generous against
+# the measured ~7s so CI jitter never flakes, tight enough that a
+# pass going quadratic (or a model scope exploding) fails tier-1
+FAST_BUDGET_S = 60.0
 
 
 def _paths(rel: List[str], root: str) -> List[str]:
@@ -269,19 +295,30 @@ def build_report(fast: bool, root: str = PKG_ROOT,
                  skip_runtime: bool = False,
                  committed_report: Optional[str] = None,
                  out_path: Optional[str] = None):
+    import time
+
     from go_crdt_playground_tpu.analysis import (codec_symmetry,
-                                                 durability, lattice_laws,
+                                                 durability, epoch_order,
+                                                 fence_coverage,
+                                                 lattice_laws,
                                                  lockdiscipline,
                                                  metrics_contract,
-                                                 protocol_contract, purity,
-                                                 thread_shadow)
+                                                 protocol_contract,
+                                                 protomodel, purity,
+                                                 thread_shadow,
+                                                 transfer_lock)
+    from go_crdt_playground_tpu.analysis.loader import SourceLoader
     from go_crdt_playground_tpu.analysis.report import Report
 
+    t0 = time.monotonic()
     report = Report()
+    # ONE parse per file per gate run: every source-reading pass below
+    # shares this cache (meta.parse_cache records the dedup)
+    loader = SourceLoader()
 
     findings, stats = lockdiscipline.analyze_files(
         _paths(LOCK_TARGETS + LOCK_ORDER_EXTRA, root),
-        attr_classes=ATTR_CLASSES)
+        attr_classes=ATTR_CLASSES, loader=loader)
     # the extra files join the lock-order graph only; their guarded-by
     # coverage is (deliberately) not yet swept, so restrict L001/L003 to
     # the ISSUE-targeted runtime files
@@ -292,11 +329,13 @@ def build_report(fast: bool, root: str = PKG_ROOT,
     report.extend(findings)
     report.add_stats("lockdiscipline", **stats)
 
-    f2, s2 = durability.analyze_files(_paths(DURABILITY_TARGETS, root))
+    f2, s2 = durability.analyze_files(_paths(DURABILITY_TARGETS, root),
+                                      loader=loader)
     report.extend(f2)
     report.add_stats("durability", **s2)
 
-    f3, s3 = purity.analyze_files(_paths(PURITY_TARGETS, root))
+    f3, s3 = purity.analyze_files(_paths(PURITY_TARGETS, root),
+                                  loader=loader)
     report.extend(f3)
     report.add_stats("purity", **s3)
 
@@ -307,23 +346,42 @@ def build_report(fast: bool, root: str = PKG_ROOT,
     report.add_stats("lattice_laws", **s4)
 
     # the wire-contract suite (DESIGN.md §15 W001-W004 + M001)
-    f5, s5 = protocol_contract.analyze(root)
+    f5, s5 = protocol_contract.analyze(root, loader=loader)
     report.extend(f5)
     report.add_stats("protocol_contract", **s5)
 
-    f6, s6 = codec_symmetry.analyze(root, fast=fast)
+    f6, s6 = codec_symmetry.analyze(root, fast=fast, loader=loader)
     report.extend(f6)
     report.add_stats("codec_symmetry", **s6)
 
-    f7, s7 = metrics_contract.analyze(root)
+    f7, s7 = metrics_contract.analyze(root, loader=loader)
     report.extend(f7)
     report.add_stats("metrics_contract", **s7)
 
     # T001 Thread-subclass attribute shadowing (the PR-12
     # _stop-breaks-join() bug class, now gate-time)
-    f8, s8 = thread_shadow.analyze(root)
+    f8, s8 = thread_shadow.analyze(root, loader=loader)
     report.extend(f8)
     report.add_stats("thread_shadow", **s8)
+
+    # the protocol verification ladder (DESIGN.md §26): ordering lint,
+    # fence coverage, transfer-under-lock, and the model checker
+    f9, s9 = epoch_order.analyze(root, loader=loader)
+    report.extend(f9)
+    report.add_stats("epoch_order", **s9)
+
+    f10, s10 = fence_coverage.analyze(root, loader=loader)
+    report.extend(f10)
+    report.add_stats("fence_coverage", **s10)
+
+    f11, s11 = transfer_lock.analyze(root, TRANSFER_TARGETS,
+                                     loader=loader)
+    report.extend(f11)
+    report.add_stats("transfer_lock", **s11)
+
+    f12, s12 = protomodel.analyze(root, loader=loader)
+    report.extend(f12)
+    report.add_stats("protomodel", **s12)
 
     if committed_report is None:
         committed_report = os.path.join(os.path.dirname(root),
@@ -334,6 +392,15 @@ def build_report(fast: bool, root: str = PKG_ROOT,
         report.add_stats("locksets", mode="skipped")
     else:
         run_lockset_exercise(report, rounds=60 if fast else 200)
+
+    # meta is top-level report metadata, deliberately NOT a pass: the
+    # F001 pass-list comparison and the census tests key on "passes"
+    report.meta.update({
+        "wall_time_s": round(time.monotonic() - t0, 3),
+        "fast": bool(fast),
+        "fast_budget_s": FAST_BUDGET_S,
+        "parse_cache": loader.stats(),
+    })
     return report
 
 
@@ -357,6 +424,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="committed ANALYSIS_REPORT.json the freshness "
                          "lint checks (default: <repo>/"
                          "ANALYSIS_REPORT.json next to the package)")
+    ap.add_argument("--json", action="store_true",
+                    help="print one machine-readable JSON summary line "
+                         "instead of per-finding lines (same exit "
+                         "contract: 0 iff no ERROR finding)")
     args = ap.parse_args(argv)
 
     report = build_report(args.fast, root=args.root,
@@ -364,9 +435,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                           committed_report=args.committed_report,
                           out_path=args.out)
     report.write_json(args.out)
+    n_err = len(report.errors())
+    if args.json:
+        import json
+
+        summary = {
+            "ok": report.ok(),
+            "findings": len(report.findings),
+            "errors": n_err,
+            "passes": sorted(report.stats),
+            "wall_time_s": report.meta.get("wall_time_s"),
+            "model_states": report.stats.get(
+                "protomodel", {}).get("total_states"),
+            "out": args.out,
+        }
+        print(json.dumps(summary, sort_keys=True))
+        return 0 if report.ok() else 1
     for f in report.findings:
         print(f.render())
-    n_err = len(report.errors())
     print(f"wrote {args.out}: {len(report.findings)} findings, "
           f"{n_err} errors, passes: "
           + ", ".join(sorted(report.stats)))

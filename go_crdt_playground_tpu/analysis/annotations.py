@@ -38,6 +38,23 @@ comment's line):
                                 is required either way — an unexplained
                                 hole in dispatch coverage is exactly the
                                 drift the pass exists to catch.
+    # fence-ok: <reason>        handler annotation (E002, analysis/
+                                fence_coverage.py), on the handler's
+                                ``def`` line: this write-verb handler
+                                deliberately serves without consulting
+                                the fence predicate — legitimate only
+                                for the epoch-adjudication verbs that
+                                ARE the fence mechanism (RING_SYNC /
+                                WAL_SYNC persist-then-adopt).  The
+                                reason is on record; an unexplained
+                                unfenced write verb fails the gate.
+    # transfer-ok: <reason>     statement annotation (D002, analysis/
+                                transfer_lock.py): this blocking
+                                device→host transfer (``jax.device_get``
+                                / ``block_until_ready``) is sanctioned
+                                under (or reachable from) a held lock —
+                                the reason states why it is one bounded
+                                pull, not the PR-8 per-field sweep.
 
 ``<lock>`` names an attribute of the same object (``_lock``,
 ``_conn_slots``).  Parsing is tokenize-based so annotations survive any
@@ -59,7 +76,7 @@ from typing import Dict, List, Optional
 
 _ANNOT_RE = re.compile(
     r"#\s*(guarded-by|requires-lock|race-ok|durable-on-return"
-    r"|protocol-ignore)\s*"
+    r"|protocol-ignore|fence-ok|transfer-ok)\s*"
     r"(?::\s*(?P<arg>\S[^#]*?))?\s*$")
 
 KIND_GUARDED_BY = "guarded-by"
@@ -67,9 +84,11 @@ KIND_REQUIRES_LOCK = "requires-lock"
 KIND_RACE_OK = "race-ok"
 KIND_DURABLE_ON_RETURN = "durable-on-return"
 KIND_PROTOCOL_IGNORE = "protocol-ignore"
+KIND_FENCE_OK = "fence-ok"
+KIND_TRANSFER_OK = "transfer-ok"
 
 _ARG_REQUIRED = {KIND_GUARDED_BY, KIND_REQUIRES_LOCK, KIND_RACE_OK,
-                 KIND_PROTOCOL_IGNORE}
+                 KIND_PROTOCOL_IGNORE, KIND_FENCE_OK, KIND_TRANSFER_OK}
 
 
 @dataclass
@@ -153,7 +172,7 @@ def parse_annotations(source: str, path: str = "<string>") -> AnnotationSet:
             # invariant the author tried to state.  Prose merely
             # mentioning a keyword mid-comment is left alone.
             if re.match(r"#\s*(guarded-by|requires-lock|race-ok"
-                        r"|protocol-ignore)\b",
+                        r"|protocol-ignore|fence-ok|transfer-ok)\b",
                         text):
                 out.malformed.append(
                     f"{path}:{line}: malformed annotation {text.strip()!r}"

@@ -582,19 +582,20 @@ def check_codec(spec: CodecSpec, rng: np.random.Generator, *,
     return findings
 
 
-def check_coverage(root: str, specs: List[CodecSpec]
-                   ) -> Tuple[List[Finding], Dict]:
+def check_coverage(root: str, specs: List[CodecSpec],
+                   loader=None) -> Tuple[List[Finding], Dict]:
     """Every public encode_*/decode_* in the wire modules must be
     covered by some spec."""
     import ast as _ast
 
+    from go_crdt_playground_tpu.analysis.loader import ensure_loader
+    loader = ensure_loader(loader)
     covered = {name for s in specs for name in s.covers}
     findings: List[Finding] = []
     per_module: Dict[str, List[str]] = {}
     for rel in WIRE_MODULES:
         path = os.path.join(root, rel)
-        with open(path) as f:
-            tree = _ast.parse(f.read())
+        tree = loader.load(path).tree
         names = [n.name for n in tree.body
                  if isinstance(n, (_ast.FunctionDef,
                                    _ast.AsyncFunctionDef))
@@ -615,10 +616,10 @@ def check_coverage(root: str, specs: List[CodecSpec]
                                              for v in per_module.values())}
 
 
-def analyze(root: str, *, fast: bool = False, seed: int = 7
-            ) -> Tuple[List[Finding], Dict]:
+def analyze(root: str, *, fast: bool = False, seed: int = 7,
+            loader=None) -> Tuple[List[Finding], Dict]:
     specs = build_codecs()
-    findings, stats = check_coverage(root, specs)
+    findings, stats = check_coverage(root, specs, loader=loader)
     n_samples = 2 if fast else 5
     n_garbles = 8 if fast else 24
     rng = np.random.default_rng(seed)

@@ -30,8 +30,9 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from go_crdt_playground_tpu.analysis.annotations import (
-    KIND_DURABLE_ON_RETURN, parse_annotations)
+from go_crdt_playground_tpu.analysis.annotations import \
+    KIND_DURABLE_ON_RETURN
+from go_crdt_playground_tpu.analysis.loader import SourceLoader, ensure_loader
 from go_crdt_playground_tpu.analysis.report import (FSYNC_MISSING,
                                                     SEVERITY_ERROR, Finding)
 
@@ -108,13 +109,11 @@ def _local_fsyncers(tree: ast.Module) -> Set[str]:
     return known
 
 
-def analyze_file(path: str, source: Optional[str] = None
+def analyze_file(path: str, source: Optional[str] = None,
+                 loader: Optional[SourceLoader] = None
                  ) -> Tuple[List[Finding], Dict]:
-    if source is None:
-        with open(path) as f:
-            source = f.read()
-    tree = ast.parse(source, filename=path)
-    annots = parse_annotations(source, path)
+    pf = ensure_loader(loader).load(path, source)
+    tree, annots = pf.tree, pf.annotations
     known = _local_fsyncers(tree)
     findings: List[Finding] = []
     n_fns = n_targets = 0
@@ -158,12 +157,15 @@ def analyze_file(path: str, source: Optional[str] = None
     return findings, stats
 
 
-def analyze_files(paths: List[str]) -> Tuple[List[Finding], Dict]:
+def analyze_files(paths: List[str],
+                  loader: Optional[SourceLoader] = None
+                  ) -> Tuple[List[Finding], Dict]:
+    loader = ensure_loader(loader)
     findings: List[Finding] = []
     stats: Dict = {"files": len(paths), "functions": 0,
                    "checked_points": 0}
     for p in paths:
-        f, s = analyze_file(p)
+        f, s = analyze_file(p, loader=loader)
         findings.extend(f)
         stats["functions"] += s["functions"]
         stats["checked_points"] += s["checked_points"]

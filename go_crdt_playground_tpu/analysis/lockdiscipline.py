@@ -36,8 +36,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from go_crdt_playground_tpu.analysis.annotations import (
-    KIND_GUARDED_BY, KIND_RACE_OK, KIND_REQUIRES_LOCK, AnnotationSet,
-    parse_annotations)
+    KIND_GUARDED_BY, KIND_RACE_OK, KIND_REQUIRES_LOCK, AnnotationSet)
+from go_crdt_playground_tpu.analysis.loader import SourceLoader, ensure_loader
 from go_crdt_playground_tpu.analysis.report import (LOCK_ORDER_CYCLE,
                                                     SEVERITY_ERROR,
                                                     UNANNOTATED_SHARED,
@@ -313,10 +313,12 @@ class _MethodLinter(ast.NodeVisitor):
 class LockLint:
     """Whole-run state: class models, lock-order graph, findings."""
 
-    def __init__(self, attr_classes: Optional[Dict[str, str]] = None):
+    def __init__(self, attr_classes: Optional[Dict[str, str]] = None,
+                 loader: Optional[SourceLoader] = None):
         # hints mapping attribute names to the class of the object they
         # hold, for cross-class acquisition edges (self.wal.seal())
         self.attr_classes = attr_classes or {}
+        self.loader = ensure_loader(loader)
         self.models: Dict[str, ClassModel] = {}
         # field name -> {owner class: lock}: same-named guarded fields
         # in different classes must not clobber each other's contract
@@ -372,11 +374,8 @@ class LockLint:
     # -- driving -----------------------------------------------------------
 
     def load_file(self, path: str, source: Optional[str] = None) -> None:
-        if source is None:
-            with open(path) as f:
-                source = f.read()
-        tree = ast.parse(source, filename=path)
-        annots = parse_annotations(source, path)
+        pf = self.loader.load(path, source)
+        tree, annots = pf.tree, pf.annotations
         for msg in annots.malformed:
             self.findings.append(Finding(
                 analyzer="lockdiscipline", code=UNGUARDED_ACCESS,
@@ -469,9 +468,10 @@ class LockLint:
 
 
 def analyze_files(paths: List[str],
-                  attr_classes: Optional[Dict[str, str]] = None
+                  attr_classes: Optional[Dict[str, str]] = None,
+                  loader: Optional[SourceLoader] = None
                   ) -> Tuple[List[Finding], Dict]:
-    lint = LockLint(attr_classes=attr_classes)
+    lint = LockLint(attr_classes=attr_classes, loader=loader)
     for p in paths:
         lint.load_file(p)
     findings = lint.run()

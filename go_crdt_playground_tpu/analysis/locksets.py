@@ -40,13 +40,12 @@ from __future__ import annotations
 
 import ast
 import inspect
-import textwrap
 import threading
 import weakref
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from go_crdt_playground_tpu.analysis.annotations import (KIND_RACE_OK,
-                                                         parse_annotations)
+from go_crdt_playground_tpu.analysis.annotations import KIND_RACE_OK
+from go_crdt_playground_tpu.analysis.loader import SourceLoader, ensure_loader
 from go_crdt_playground_tpu.analysis.report import (RACE_EMPTY_LOCKSET,
                                                     SEVERITY_ERROR, Finding)
 from go_crdt_playground_tpu.utils.guards import SHIM_GUARD
@@ -121,46 +120,53 @@ class _FieldState:
 _RACE_OK_CACHE: Dict[type, Set[str]] = {}
 
 
-def _race_ok_fields(cls: type) -> Set[str]:
+def _race_ok_fields(cls: type,
+                    loader: Optional[SourceLoader] = None) -> Set[str]:
     """``# race-ok:``-annotated fields of ``cls`` (and bases), read from
     source via the shared annotation grammar; unreadable source (REPL,
     frozen) degrades to no exclusions.  Cached per class — a soak
     instruments dozens of same-class objects and the source never
-    changes under it."""
+    changes under it.  The file parse rides the gate's shared loader
+    (one parse per file per run, not per instrumented class)."""
     cached = _RACE_OK_CACHE.get(cls)
     if cached is not None:
         return set(cached)
+    loader = ensure_loader(loader)
     out: Set[str] = set()
     for klass in cls.__mro__:
         if klass is object:
             continue
         try:
-            src = inspect.getsource(klass)
-        except (OSError, TypeError):
+            path = inspect.getfile(klass)
+            pf = loader.load(path)
+        except (OSError, TypeError, SyntaxError):
             continue
-        src = textwrap.dedent(src)
-        annots = parse_annotations(src, getattr(klass, "__name__", "?"))
-        try:
-            tree = ast.parse(src)
-        except SyntaxError:
-            continue
-        for node in ast.walk(tree):
-            # both plain and TYPE-ANNOTATED assignments carry contracts
-            # (``self.x: Optional[T] = None  # race-ok: ...`` is an
-            # ast.AnnAssign, not an ast.Assign)
-            if isinstance(node, ast.Assign):
-                targets = node.targets
-            elif isinstance(node, ast.AnnAssign):
-                targets = [node.target]
-            else:
+        # every ClassDef matching the runtime name (nested classes in
+        # test files included) — a same-named sibling merely widens the
+        # exclusion set, the conservative direction for a detector
+        name = getattr(klass, "__name__", None)
+        for cnode in ast.walk(pf.tree):
+            if not (isinstance(cnode, ast.ClassDef)
+                    and cnode.name == name):
                 continue
-            end = getattr(node, "end_lineno", node.lineno)
-            a = annots.on_lines(node.lineno, end, KIND_RACE_OK)
-            if a is None:
-                continue
-            for tgt in targets:
-                if isinstance(tgt, ast.Attribute):
-                    out.add(tgt.attr)
+            for node in ast.walk(cnode):
+                # both plain and TYPE-ANNOTATED assignments carry
+                # contracts (``self.x: Optional[T] = None
+                # # race-ok: ...`` is an ast.AnnAssign, not Assign)
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign):
+                    targets = [node.target]
+                else:
+                    continue
+                end = getattr(node, "end_lineno", node.lineno)
+                a = pf.annotations.on_lines(node.lineno, end,
+                                            KIND_RACE_OK)
+                if a is None:
+                    continue
+                for tgt in targets:
+                    if isinstance(tgt, ast.Attribute):
+                        out.add(tgt.attr)
     _RACE_OK_CACHE[cls] = set(out)
     return out
 
@@ -170,7 +176,8 @@ class RaceDetector:
     and the findings.  Thread-safe; meant to be shared by a whole fleet
     (one detector per soak process)."""
 
-    def __init__(self) -> None:
+    def __init__(self, loader: Optional[SourceLoader] = None) -> None:
+        self._loader = ensure_loader(loader)
         self._tls = threading.local()
         self._next_tid = iter(range(1, 1 << 62))
         self._mu = threading.Lock()
@@ -213,7 +220,7 @@ class RaceDetector:
         SHIM_GUARD.install(("race-detector", id(obj)),
                            owner=type(obj).__name__)
         cls = type(obj)
-        excl = set(_ALWAYS_IGNORE) | _race_ok_fields(cls) \
+        excl = set(_ALWAYS_IGNORE) | _race_ok_fields(cls, self._loader) \
             | set(extra_exclude)
         lock_names = []
         for name, value in list(obj.__dict__.items()):

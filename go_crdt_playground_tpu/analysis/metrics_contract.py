@@ -33,8 +33,9 @@ import fnmatch
 import glob
 import os
 import re
-from typing import Dict, Iterable, List, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
+from go_crdt_playground_tpu.analysis.loader import SourceLoader, ensure_loader
 from go_crdt_playground_tpu.analysis.report import (METRICS_CONTRACT,
                                                     SEVERITY_ERROR,
                                                     SEVERITY_WARNING,
@@ -76,7 +77,10 @@ def _patterns_of(node: ast.AST) -> List[str]:
             if "*" in p or not any(p in j for j in joined)]
 
 
-def emitted_patterns(paths: Iterable[str]) -> Dict[str, List[str]]:
+def emitted_patterns(paths: Iterable[str],
+                     loader: Optional[SourceLoader] = None
+                     ) -> Dict[str, List[str]]:
+    loader = ensure_loader(loader)
     """pattern -> [path:line, ...] of every Recorder emission site.
 
     Two collection scopes: the direct argument of an emission call,
@@ -92,8 +96,7 @@ def emitted_patterns(paths: Iterable[str]) -> Dict[str, List[str]]:
                 out.setdefault(p, []).append(f"{path}:{lineno}")
 
     for path in paths:
-        with open(path) as f:
-            tree = ast.parse(f.read())
+        tree = loader.load(path).tree
         for node in ast.walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 calls_count_many = any(
@@ -117,13 +120,15 @@ def emitted_patterns(paths: Iterable[str]) -> Dict[str, List[str]]:
     return out
 
 
-def referenced_names(paths: Iterable[str]) -> Dict[str, List[str]]:
+def referenced_names(paths: Iterable[str],
+                     loader: Optional[SourceLoader] = None
+                     ) -> Dict[str, List[str]]:
+    loader = ensure_loader(loader)
     """name -> [path:line, ...] of every metric-shaped string literal
     in the adjudication tools."""
     out: Dict[str, List[str]] = {}
     for path in paths:
-        with open(path) as f:
-            tree = ast.parse(f.read())
+        tree = loader.load(path).tree
         for node in ast.walk(tree):
             if (isinstance(node, ast.Constant)
                     and isinstance(node.value, str)
@@ -167,9 +172,11 @@ def _covers(pattern: str, name: str) -> bool:
 
 
 def check(package_files: Iterable[str], tool_files: Iterable[str],
-          doc_files: Iterable[str]) -> Tuple[List[Finding], Dict]:
-    emitted = emitted_patterns(package_files)
-    referenced = referenced_names(tool_files)
+          doc_files: Iterable[str],
+          loader: Optional[SourceLoader] = None
+          ) -> Tuple[List[Finding], Dict]:
+    emitted = emitted_patterns(package_files, loader=loader)
+    referenced = referenced_names(tool_files, loader=loader)
     documented = documented_patterns(doc_files)
     findings: List[Finding] = []
     for name, sites in sorted(referenced.items()):
@@ -205,7 +212,8 @@ def check(package_files: Iterable[str], tool_files: Iterable[str],
     }
 
 
-def analyze(root: str) -> Tuple[List[Finding], Dict]:
+def analyze(root: str, loader: Optional[SourceLoader] = None
+            ) -> Tuple[List[Finding], Dict]:
     """Default scopes: the package for emissions, ``tools/*_soak.py``
     for adjudication references, DESIGN.md for the catalog."""
     pkg_files = []
@@ -220,4 +228,5 @@ def analyze(root: str) -> Tuple[List[Finding], Dict]:
                                                "*_soak.py")))
     doc_files = [p for p in (os.path.join(repo, "DESIGN.md"),)
                  if os.path.exists(p)]
-    return check(sorted(pkg_files), tool_files, doc_files)
+    return check(sorted(pkg_files), tool_files, doc_files,
+                 loader=loader)

@@ -40,8 +40,9 @@ import ast
 import os
 from typing import Dict, Iterable, List, NamedTuple, Optional, Tuple
 
-from go_crdt_playground_tpu.analysis.annotations import (
-    KIND_PROTOCOL_IGNORE, parse_annotations)
+from go_crdt_playground_tpu.analysis.annotations import \
+    KIND_PROTOCOL_IGNORE
+from go_crdt_playground_tpu.analysis.loader import SourceLoader, ensure_loader
 from go_crdt_playground_tpu.analysis.report import (DISPATCH_HOLE,
                                                     FRAME_CAP_MISSING,
                                                     REJECT_UNDISCIPLINED,
@@ -99,17 +100,17 @@ class _DialectInfo(NamedTuple):
     malformed: List[str]
 
 
-def _load_dialect(path: str) -> _DialectInfo:
-    with open(path) as f:
-        source = f.read()
-    tree = ast.parse(source)
+def _load_dialect(path: str, loader: Optional[SourceLoader] = None
+                  ) -> _DialectInfo:
+    pf = ensure_loader(loader).load(path)
+    tree = pf.tree
     consts: Dict[str, int] = {}
     for node in tree.body:
         if isinstance(node, ast.Assign):
             for t in node.targets:
                 if isinstance(t, ast.Name) and t.id.startswith("MSG_"):
                     consts[t.id] = node.lineno
-    anns = parse_annotations(source, path)
+    anns = pf.annotations
     ignored: Dict[str, Tuple[str, str]] = {}
     malformed = list(anns.malformed)
     for ann in anns.every:
@@ -172,22 +173,24 @@ def _references_symbol(fn: ast.AST, symbol: str) -> bool:
 
 
 def check_dispatchers(root: str,
-                      dispatchers: Iterable[DispatcherSpec] = DISPATCHERS
+                      dispatchers: Iterable[DispatcherSpec] = DISPATCHERS,
+                      loader: Optional[SourceLoader] = None
                       ) -> Tuple[List[Finding], Dict]:
+    loader = ensure_loader(loader)
     findings: List[Finding] = []
     stats: Dict = {"dispatchers": {}}
     dialect_cache: Dict[str, _DialectInfo] = {}
 
     def dialect(rel: str) -> _DialectInfo:
         if rel not in dialect_cache:
-            dialect_cache[rel] = _load_dialect(os.path.join(root, rel))
+            dialect_cache[rel] = _load_dialect(os.path.join(root, rel),
+                                               loader)
         return dialect_cache[rel]
 
     for spec in dispatchers:
         path = os.path.join(root, spec.path)
-        with open(path) as f:
-            source = f.read()
-        tree = ast.parse(source)
+        pf = loader.load(path)
+        tree = pf.tree
         fn = _find_function(tree, spec.qualname)
         if fn is None:
             findings.append(Finding(
@@ -200,7 +203,7 @@ def check_dispatchers(root: str,
         handled = _compared_msg_names(fn)
         # dispatcher-scoped ignores: protocol-ignore annotations whose
         # line falls inside the function span, first token = MSG_*
-        anns = parse_annotations(source, path)
+        anns = pf.annotations
         local_ignored: Dict[str, str] = {}
         constants: Dict[str, int] = {}
         ignored_global: Dict[str, Tuple[str, str]] = {}
@@ -348,7 +351,8 @@ def check_reject_registry() -> Tuple[List[Finding], Dict]:
                       "exception_classes": n_subclasses}
 
 
-def check_reject_call_sites(paths: Iterable[str]
+def check_reject_call_sites(paths: Iterable[str],
+                            loader: Optional[SourceLoader] = None
                             ) -> Tuple[List[Finding], Dict]:
     """Static half: every ``encode_reject`` call site passes a NAMED
     registered code (bare numeric literals drift silently when codes
@@ -359,11 +363,11 @@ def check_reject_call_sites(paths: Iterable[str]
     registered = {name for name in dir(protocol)
                   if name.startswith("REJECT_")
                   and isinstance(getattr(protocol, name), int)}
+    loader = ensure_loader(loader)
     findings: List[Finding] = []
     n_sites = 0
     for path in paths:
-        with open(path) as f:
-            tree = ast.parse(f.read())
+        tree = loader.load(path).tree
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -438,12 +442,14 @@ def _framing_recv_aliases(tree: ast.Module) -> Tuple[set, set]:
     return mod_aliases, direct
 
 
-def check_frame_caps(paths: Iterable[str]) -> Tuple[List[Finding], Dict]:
+def check_frame_caps(paths: Iterable[str],
+                     loader: Optional[SourceLoader] = None
+                     ) -> Tuple[List[Finding], Dict]:
+    loader = ensure_loader(loader)
     findings: List[Finding] = []
     n_sites = 0
     for path in paths:
-        with open(path) as f:
-            tree = ast.parse(f.read())
+        tree = loader.load(path).tree
         mod_aliases, direct = _framing_recv_aliases(tree)
         if not mod_aliases and not direct:
             continue
@@ -485,9 +491,11 @@ def check_frame_caps(paths: Iterable[str]) -> Tuple[List[Finding], Dict]:
 # ---------------------------------------------------------------------------
 
 
-def analyze(root: str) -> Tuple[List[Finding], Dict]:
+def analyze(root: str, loader: Optional[SourceLoader] = None
+            ) -> Tuple[List[Finding], Dict]:
     """Run all three passes over the installed package at ``root``."""
-    findings, stats = check_dispatchers(root)
+    loader = ensure_loader(loader)
+    findings, stats = check_dispatchers(root, loader=loader)
     py_files = []
     for dirpath, _dirnames, filenames in os.walk(root):
         if "__pycache__" in dirpath:
@@ -498,9 +506,9 @@ def analyze(root: str) -> Tuple[List[Finding], Dict]:
     py_files.sort()
     f2, s2 = check_reject_registry()
     findings.extend(f2)
-    f3, s3 = check_reject_call_sites(py_files)
+    f3, s3 = check_reject_call_sites(py_files, loader=loader)
     findings.extend(f3)
-    f4, s4 = check_frame_caps(py_files)
+    f4, s4 = check_frame_caps(py_files, loader=loader)
     findings.extend(f4)
     stats.update(s2)
     stats.update(s3)

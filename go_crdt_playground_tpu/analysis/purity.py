@@ -30,6 +30,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
+from go_crdt_playground_tpu.analysis.loader import SourceLoader, ensure_loader
 from go_crdt_playground_tpu.analysis.report import (PURITY_VIOLATION,
                                                     SEVERITY_ERROR, Finding)
 
@@ -168,12 +169,10 @@ def _check_function(fn: ast.FunctionDef, qual: str, path: str
     return findings
 
 
-def analyze_file(path: str, source: Optional[str] = None
+def analyze_file(path: str, source: Optional[str] = None,
+                 loader: Optional[SourceLoader] = None
                  ) -> Tuple[List[Finding], Dict]:
-    if source is None:
-        with open(path) as f:
-            source = f.read()
-    tree = ast.parse(source, filename=path)
+    tree = ensure_loader(loader).load(path, source).tree
     fns = _module_functions(tree)
     roots = _jit_roots(tree, fns)
     # reachability over the same-module call graph
@@ -195,12 +194,15 @@ def analyze_file(path: str, source: Optional[str] = None
     return findings, stats
 
 
-def analyze_files(paths: List[str]) -> Tuple[List[Finding], Dict]:
+def analyze_files(paths: List[str],
+                  loader: Optional[SourceLoader] = None
+                  ) -> Tuple[List[Finding], Dict]:
+    loader = ensure_loader(loader)
     findings: List[Finding] = []
     stats: Dict = {"files": len(paths), "jit_roots": 0,
                    "reachable_checked": 0}
     for p in paths:
-        f, s = analyze_file(p)
+        f, s = analyze_file(p, loader=loader)
         findings.extend(f)
         stats["jit_roots"] += len(s["jit_roots"])
         stats["reachable_checked"] += s["reachable_checked"]

@@ -36,6 +36,14 @@ FRAME_CAP_MISSING = "W004"      # recv_frame call site without max_body
 METRICS_CONTRACT = "M001"       # metric name referenced/emitted drift
 REPORT_STALE = "F001"           # committed report's pass list is stale
 THREAD_SHADOW = "T001"          # Thread subclass shadows a Thread internal
+# protocol-verification ladder (analysis/protomodel.py,
+# analysis/epoch_order.py, analysis/fence_coverage.py,
+# analysis/transfer_lock.py — DESIGN.md §26):
+EPOCH_ORDER = "E001"            # persist does not dominate announce/bind
+FENCE_UNCOVERED = "E002"        # write-verb arm consults no fence predicate
+MODEL_STALE = "E003"            # protocol model drifted from its source
+MODEL_VIOLATION = "E004"        # explorer found an invariant-violating run
+TRANSFER_UNDER_LOCK = "D002"    # blocking device transfer while lock held
 
 
 @dataclass
@@ -66,6 +74,10 @@ class Report:
 
     findings: List[Finding] = field(default_factory=list)
     stats: Dict[str, Dict] = field(default_factory=dict)
+    # run-level metadata (wall time, parse-cache hit rates, budgets) —
+    # serialized top-level, NOT as a pass: the report-freshness lint
+    # compares pass lists, and meta must never read as coverage
+    meta: Dict = field(default_factory=dict)
 
     def extend(self, findings: List[Finding]) -> None:
         self.findings.extend(findings)
@@ -87,6 +99,7 @@ class Report:
             "ok": self.ok(),
             "n_findings": len(self.findings),
             "n_errors": len(self.errors()),
+            "meta": dict(self.meta),
             "passes": {
                 name: {
                     "stats": self.stats.get(name, {}),

@@ -35,8 +35,9 @@ from __future__ import annotations
 import ast
 import os
 import threading
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
+from go_crdt_playground_tpu.analysis.loader import SourceLoader, ensure_loader
 from go_crdt_playground_tpu.analysis.report import (SEVERITY_ERROR,
                                                     THREAD_SHADOW, Finding)
 
@@ -71,17 +72,17 @@ def _is_thread_base(base: ast.expr) -> bool:
     return False
 
 
-def check_file(path: str,
-               internals: frozenset) -> Tuple[List[Finding], int]:
+def check_file(path: str, internals: frozenset,
+               loader: Optional[SourceLoader] = None
+               ) -> Tuple[List[Finding], int]:
     """Returns (findings, thread_subclass_count) from ONE parse."""
-    with open(path) as f:
-        try:
-            tree = ast.parse(f.read(), filename=path)
-        except SyntaxError as e:
-            return [Finding(
-                analyzer="thread_shadow", code=THREAD_SHADOW,
-                severity=SEVERITY_ERROR, path=path, line=e.lineno,
-                message=f"unparseable file: {e.msg}")], 0
+    try:
+        tree = ensure_loader(loader).load(path).tree
+    except SyntaxError as e:
+        return [Finding(
+            analyzer="thread_shadow", code=THREAD_SHADOW,
+            severity=SEVERITY_ERROR, path=path, line=e.lineno,
+            message=f"unparseable file: {e.msg}")], 0
     findings: List[Finding] = []
     n_subclasses = 0
     for node in ast.walk(tree):
@@ -138,8 +139,10 @@ def check_file(path: str,
 
 
 def analyze(root: str,
-            extra_dirs: Tuple[str, ...] = ("tools", "tests")
+            extra_dirs: Tuple[str, ...] = ("tools", "tests"),
+            loader: Optional[SourceLoader] = None
             ) -> Tuple[List[Finding], Dict]:
+    loader = ensure_loader(loader)
     """Sweep the package at ``root`` plus the repo's ``tools/`` and
     ``tests/`` siblings (explicit args so tests can plant violations
     in a tmp tree)."""
@@ -160,7 +163,7 @@ def analyze(root: str,
     findings: List[Finding] = []
     n_subclasses = 0
     for path in paths:
-        file_findings, n = check_file(path, internals)
+        file_findings, n = check_file(path, internals, loader=loader)
         findings.extend(file_findings)
         n_subclasses += n
     return findings, {"files_scanned": len(paths),

@@ -298,6 +298,9 @@ def encode_delta_wal_record(pre_vv: np.ndarray, src_actor: int, payload,
             # one pull for the whole fixed-K pytree — device_get starts
             # every leaf's transfer before blocking, vs a sequential
             # device round-trip per field under the node lock
+            # transfer-ok: the one sanctioned bounded pull of the WAL
+            # encode path (called under the node lock via
+            # _append_delta_record)
             compact = jax.device_get(compact)
         if compact is not None and not bool(compact.overflow):
             chv = compact.ch_valid

@@ -424,6 +424,8 @@ class MeshApplyTarget(Node):
             # ONE device→host pull for the whole δ pytree; the record
             # encoder's host-side break-even ladder (compact vs dense)
             # then runs on numpy
+            # transfer-ok: one bounded fixed-K pull per ingest chunk —
+            # replacing the per-field sweep is the PR-8 fix itself
             payload = jax.device_get(payload)
             self._append_delta_record(pre_vv, payload, None)
         else:
@@ -500,6 +502,9 @@ class MeshApplyTarget(Node):
                                     state.del_dot_actor,
                                     state.del_dot_counter, state.vv,
                                     state.processed)
+        # transfer-ok: deliberately OUTSIDE the lock block above (only
+        # the state ref is read under it); one G-word summary pull —
+        # callers in the digest-sync exchange may still hold theirs
         digests, vv, processed = jax.device_get(
             (digests, vv, processed))
         return (np.asarray(vv), np.asarray(processed),
@@ -544,6 +549,8 @@ class MeshApplyTarget(Node):
         with self._lock:
             me = jax.tree.map(lambda x: x[0], self._state)
             if idx.size:
+                # transfer-ok: one K-lane gather pull per handoff (a
+                # rare admin op), vs the dense E-lane sweep it replaces
                 lanes = jax.device_get(
                     _gather_slice_lanes(me, jnp.asarray(idx)))
             else:

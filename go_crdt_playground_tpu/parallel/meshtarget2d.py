@@ -383,6 +383,8 @@ class Mesh2DApplyTarget(MeshApplyTarget):
                 # ONE device→host pull for the chunk's δ pytree; the
                 # record encoder's host-side break-even ladder runs on
                 # numpy, exactly the 1-D path
+                # transfer-ok: one bounded fixed-K pull per chunk —
+                # same sanction as the 1-D ingest path
                 payload = jax.device_get(payload)
                 self._append_delta_record(pre_vv, payload, None)
             else:

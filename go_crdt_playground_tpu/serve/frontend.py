@@ -645,6 +645,9 @@ class ServeFrontend:
 
     # -- router-epoch fence (router HA, DESIGN.md §22) ----------------------
 
+    # fence-ok: this verb IS the router-epoch fence mechanism — it
+    # adjudicates claims persist-then-adopt and must answer on a
+    # deposed member so the member can learn its own deposition
     def _handle_ring_sync(self, session: Session, body: bytes) -> bool:
         """Adjudicate a router-epoch announcement (or serve a pure
         read).  A claim ABOVE the recorded maximum is adopted and
@@ -714,6 +717,10 @@ class ServeFrontend:
     WAL_SYNC_MAX_RECORDS = 256
     WAL_SYNC_MAX_BYTES = 1 << 20
 
+    # fence-ok: this verb IS the shard-epoch fence mechanism — it
+    # adjudicates standby claims persist-before-ack, and the tail read
+    # must keep serving on a deposed member so a lagging standby can
+    # finish catching up before arbitration
     def _handle_wal_sync(self, session: Session, body: bytes) -> bool:
         """Serve one standby tail poll / catch-up / epoch claim
         (serve/protocol.py MSG_WAL_SYNC).  The ``from_seq`` cursor is

@@ -986,6 +986,10 @@ class ShardRouter:
                        if sid in rt.ring.shards},
         }
 
+    # fence-ok: this verb IS the router-epoch fence mechanism — the
+    # standby's tail read and the promotion's deposition notice both
+    # ride it, and a deposed primary must keep answering so it can
+    # learn (and persist) its own deposition
     def _handle_ring_sync(self, session: Session, body: bytes) -> bool:
         """Serve the tail read / adjudicate an epoch claim.  A claim
         above everything seen is NOTED (self-fence: this router stops

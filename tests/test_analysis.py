@@ -739,6 +739,170 @@ def test_thread_shadow_finds_planted_offenders(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+def test_source_loader_caches_and_bypasses_for_planted():
+    """One parse per file per gate run — and planted text (the
+    sources= injection every ladder pass supports) must neither read
+    nor poison the cache."""
+    import os
+
+    from go_crdt_playground_tpu.analysis.__main__ import PKG_ROOT
+    from go_crdt_playground_tpu.analysis.loader import SourceLoader
+
+    loader = SourceLoader()
+    p = os.path.join(PKG_ROOT, "utils", "wal.py")
+    a = loader.load(p)
+    b = loader.load(p)
+    assert a.tree is b.tree
+    assert loader.stats() == {"files": 1, "hits": 1, "misses": 1}
+    planted = loader.load(p, "x = 1\n")
+    assert planted.source == "x = 1\n"
+    assert loader.load(p).tree is a.tree, \
+        "planted text must not replace the on-disk parse"
+    assert loader.stats()["files"] == 1
+
+
+def test_epoch_order_swapped_twin_detected():
+    """E001 planted violation: announce before persist — the exact
+    ordering the promotion spine forbids."""
+    from go_crdt_playground_tpu.analysis import epoch_order
+    from go_crdt_playground_tpu.analysis.epoch_order import OrderSpec
+
+    src = (
+        "class Standby:\n"
+        "    def promote(self):\n"
+        "        self.announce_epoch()\n"
+        "        persist_router_epoch(self.dir, 1, 'sb')\n"
+        "        self.serve()\n"
+    )
+    spec = OrderSpec("twin", "twin.py", "Standby.promote",
+                     before=("persist_router_epoch",),
+                     after=("announce_epoch", "serve"))
+    f, s = epoch_order.analyze("/nowhere", specs=(spec,),
+                               sources={"twin.py": src})
+    assert len(f) == 1 and f[0].code == "E001", f
+    assert "announce_epoch" in f[0].symbol
+    assert s["ordered_points"] == 2  # serve() (dominated) was checked
+
+
+def test_epoch_order_vanished_function_is_loud():
+    """A registered promotion path that got renamed away must fail the
+    gate, not silently un-check the contract."""
+    from go_crdt_playground_tpu.analysis import epoch_order
+    from go_crdt_playground_tpu.analysis.epoch_order import OrderSpec
+
+    spec = OrderSpec("gone", "twin.py", "Standby.promote",
+                     before=("persist",), after=("serve",))
+    f, _ = epoch_order.analyze("/nowhere", specs=(spec,),
+                               sources={"twin.py": "x = 1\n"})
+    assert len(f) == 1 and f[0].code == "E001"
+    assert "no longer exists" in f[0].message
+
+
+def test_fence_coverage_unfenced_verb_detected():
+    """E002 planted violation: a write-verb handler that consults no
+    fence predicate and carries no fence-ok annotation."""
+    from go_crdt_playground_tpu.analysis import fence_coverage
+    from go_crdt_playground_tpu.analysis.fence_coverage import FenceSpec
+
+    src = (
+        "class FE:\n"
+        "    def _dispatch(self, t, body):\n"
+        "        if t == MSG_OP:\n"
+        "            return self._handle_op(body)\n"
+        "        if t == MSG_GC:\n"
+        "            return self._handle_gc(body)\n"
+        "    def _handle_op(self, body):\n"
+        "        if self.shard_deposed():\n"
+        "            return None\n"
+        "        return 1\n"
+        "    def _handle_gc(self, body):\n"
+        "        return 2\n"
+    )
+    spec = FenceSpec("fe", "fe.py", "FE._dispatch",
+                     write_verbs=("MSG_OP", "MSG_GC"),
+                     predicates=("shard_deposed",))
+    f, s = fence_coverage.analyze("/nowhere", specs=(spec,),
+                                  sources={"fe.py": src})
+    assert len(f) == 1 and f[0].code == "E002", f
+    assert "MSG_GC" in f[0].symbol
+    assert s["covered"] == 1  # MSG_OP passed
+
+
+def test_fence_coverage_stale_annotation_detected():
+    """A fence-ok on a handler that DOES consult the predicate is a
+    stale annotation and fails the gate — an annotation that can never
+    matter proves nothing."""
+    from go_crdt_playground_tpu.analysis import fence_coverage
+    from go_crdt_playground_tpu.analysis.fence_coverage import FenceSpec
+
+    src = (
+        "class FE:\n"
+        "    def _dispatch(self, t, body):\n"
+        "        if t == MSG_OP:\n"
+        "            return self._handle_op(body)\n"
+        "    # fence-ok: stale — the handler fences below\n"
+        "    def _handle_op(self, body):\n"
+        "        if self.shard_deposed():\n"
+        "            return None\n"
+        "        return 1\n"
+    )
+    spec = FenceSpec("fe", "fe.py", "FE._dispatch",
+                     write_verbs=("MSG_OP",),
+                     predicates=("shard_deposed",))
+    f, _ = fence_coverage.analyze("/nowhere", specs=(spec,),
+                                  sources={"fe.py": src})
+    assert len(f) == 1 and f[0].code == "E002"
+    assert "stale fence-ok" in f[0].message
+
+
+def test_transfer_under_lock_detected_and_annotation_clears():
+    """D002 planted violation: a blocking device_get inside a
+    with-lock block; the transfer-ok twin passes."""
+    from go_crdt_playground_tpu.analysis import transfer_lock
+
+    src = (
+        "import jax\n"
+        "class T:\n"
+        "    def pull(self):\n"
+        "        with self._lock:\n"
+        "            x = jax.device_get(self._state)\n"
+        "        return x\n"
+    )
+    f, s = transfer_lock.analyze_paths(["t.py"],
+                                       sources={"t.py": src})
+    assert len(f) == 1 and f[0].code == "D002", f
+    assert s["lock_held"] == 1 and s["transfer_ok"] == 0
+    ok = src.replace(
+        "            x = jax.device_get(self._state)",
+        "            # transfer-ok: one bounded pull\n"
+        "            x = jax.device_get(self._state)")
+    f2, s2 = transfer_lock.analyze_paths(["t.py"],
+                                         sources={"t.py": ok})
+    assert not f2 and s2["transfer_ok"] == 1
+
+
+def test_transfer_lock_fixpoint_reaches_called_helper():
+    """The lock context propagates through the call graph: a helper
+    that pulls, called from a with-lock block, is flagged even though
+    it contains no lock itself (the framing.py shape)."""
+    from go_crdt_playground_tpu.analysis import transfer_lock
+
+    src = (
+        "import jax\n"
+        "def encode(state):\n"
+        "    return jax.device_get(state)\n"
+        "class T:\n"
+        "    def append(self):\n"
+        "        with self._lock:\n"
+        "            return encode(self._state)\n"
+    )
+    f, s = transfer_lock.analyze_paths(["t.py"],
+                                       sources={"t.py": src})
+    assert len(f) == 1 and f[0].code == "D002", f
+    assert f[0].symbol == "encode"
+    assert s["lock_context_fns"] >= 1
+
+
 def test_gate_fast(tmp_path):
     """The tier-1 hook: the full --fast gate must exit 0 on this tree
     and cover every registered pass in ANALYSIS_REPORT.json
@@ -849,6 +1013,38 @@ def test_gate_fast(tmp_path):
     # matches the registered pass list
     rf = report["passes"]["report_freshness"]["stats"]
     assert set(rf["registered"]) == set(report["passes"]), rf
+    # the protocol verification ladder (the verification-ladder ISSUE):
+    # E001 checked every registered promotion spine, E002 resolved
+    # every registered write verb, D002 swept the transfer sites, and
+    # the model checker exhausted all three protocol models
+    assert {"epoch_order", "fence_coverage", "transfer_lock",
+            "protomodel"} <= set(report["passes"])
+    eo = report["passes"]["epoch_order"]["stats"]
+    assert eo["specs"] >= 4 and eo["ordered_points"] >= 10, eo
+    fc = report["passes"]["fence_coverage"]["stats"]
+    assert fc["write_verbs"] >= 9 and fc["covered"] >= 6, fc
+    # exactly the adjudication verbs carry fence-ok (frontend
+    # RING_SYNC + WAL_SYNC, router RING_SYNC) — a fourth would mean an
+    # unfenced write verb was annotated away instead of fenced
+    assert fc["fence_ok"] == 3, fc
+    tl = report["passes"]["transfer_lock"]["stats"]
+    assert tl["transfer_calls"] >= 5 and tl["lock_held"] >= 5, tl
+    assert tl["transfer_ok"] == tl["lock_held"], tl
+    pm = report["passes"]["protomodel"]["stats"]
+    assert set(pm["models"]) == {"router_ha", "shard_repl",
+                                 "handoff"}, pm
+    for name, m in pm["models"].items():
+        assert m["complete"], (name, m)  # exhausted, not capped
+        assert m["violations"] == 0, (name, m)
+        assert m["states"] >= 10, (name, m)
+    assert pm["fresh"] == pm["mirrored_symbols"] >= 10, pm
+    # run metadata: wall time + shared-parse-cache stats are recorded
+    # top-level (meta is not a pass — rf["registered"] above proved
+    # the pass list itself is unpolluted)
+    meta = report["meta"]
+    assert meta["fast"] is True and meta["wall_time_s"] > 0, meta
+    assert meta["parse_cache"]["hits"] > meta["parse_cache"]["files"], \
+        meta  # the cache actually deduped re-parses across passes
 
 
 def test_report_shape_roundtrips(tmp_path):

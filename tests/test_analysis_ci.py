@@ -63,6 +63,58 @@ def test_ruff_baseline_clean():
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+def test_gate_json_summary_contract(tmp_path):
+    """--json prints ONE machine-readable line (CI parses it) with the
+    same exit-code contract as the human mode: 0 iff no ERROR
+    finding.  Both directions are exercised — the clean tree, and a
+    run whose committed-report check is pointed at a stale artifact."""
+    out = str(tmp_path / "rep.json")
+    stale = tmp_path / "stale_committed.json"
+    stale.write_text(json.dumps({"passes": {"lockdiscipline": {}}}))
+    base = [sys.executable, "-m", "go_crdt_playground_tpu.analysis",
+            "--fast", "--skip-runtime", "--json", "--out", out]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    ok = subprocess.run(base, cwd=REPO, capture_output=True, text=True,
+                        timeout=600, env=env)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    lines = [ln for ln in ok.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, ok.stdout  # one summary line, no prose
+    summary = json.loads(lines[0])
+    assert summary["ok"] is True and summary["errors"] == 0
+    assert summary["model_states"] > 0
+    assert summary["out"] == out
+    assert "protomodel" in summary["passes"]
+
+    bad = subprocess.run(base + ["--committed-report", str(stale)],
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=600, env=env)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    summary = json.loads(bad.stdout.strip().splitlines()[-1])
+    assert summary["ok"] is False and summary["errors"] >= 1
+
+
+def test_gate_fast_stays_under_budget(tmp_path):
+    """The --fast gate must stay inside its recorded wall-time
+    envelope (meta.fast_budget_s): tier-1 runs it on every push, so a
+    pass going quadratic — or a model scope exploding past small-scope
+    exhaustiveness — shows up here as a hard failure, not as slow
+    drift nobody bisects."""
+    from go_crdt_playground_tpu.analysis.__main__ import (FAST_BUDGET_S,
+                                                          main)
+
+    out = str(tmp_path / "rep.json")
+    rc = main(["--fast", "--out", out])
+    assert rc == 0
+    with open(out) as f:
+        meta = json.load(f)["meta"]
+    assert meta["fast_budget_s"] == FAST_BUDGET_S
+    assert meta["wall_time_s"] < FAST_BUDGET_S, (
+        f"--fast gate took {meta['wall_time_s']}s, budget "
+        f"{FAST_BUDGET_S}s — a pass regressed its complexity or a "
+        "model scope grew; shrink it or justify a new budget")
+
+
 def test_tools_analyze_wrapper(tmp_path):
     """The repo-root wrapper must produce the same report the module
     CLI does, defaulting the artifact next to the other curves when

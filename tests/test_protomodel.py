@@ -1,0 +1,177 @@
+"""The protocol model checker (analysis/protomodel.py, DESIGN.md §26).
+
+Two halves, the gate suite's usual shape: the REAL models verify
+clean over their exhaustive state graphs, and every bug-flagged twin
+is caught with a concrete counterexample schedule — an explorer that
+cannot find a planted two-writers run would prove nothing about the
+absence of real ones.
+"""
+
+import os
+
+from go_crdt_playground_tpu.analysis import protomodel
+from go_crdt_playground_tpu.analysis.protomodel import (HandoffModel,
+                                                        MirrorSpec,
+                                                        RouterHAModel,
+                                                        ShardReplModel,
+                                                        explore)
+
+PKG_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "go_crdt_playground_tpu")
+
+
+# ---------------------------------------------------------------------------
+# the explorer itself
+# ---------------------------------------------------------------------------
+
+
+class _Diamond:
+    """Two commuting steps: four states, four edges, each state once."""
+
+    def initial(self):
+        return {"a": 0, "b": 0}
+
+    def actions(self, st):
+        out = []
+        if st["a"] == 0:
+            out.append(("a", {**st, "a": 1}))
+        if st["b"] == 0:
+            out.append(("b", {**st, "b": 1}))
+        return out
+
+    def invariants(self, prev, label, st):
+        return []
+
+
+def test_explorer_dedups_interleavings():
+    r = explore(_Diamond())
+    assert r.states == 4
+    assert r.transitions == 4  # a;b and b;a converge, edges all walked
+    assert r.complete and not r.violations
+
+
+def test_explorer_reports_shortest_trace():
+    class Line:
+        def initial(self):
+            return {"n": 0}
+
+        def actions(self, st):
+            return ([("inc", {"n": st["n"] + 1})]
+                    if st["n"] < 5 else [])
+
+        def invariants(self, prev, label, st):
+            return ["boom"] if st["n"] == 3 else []
+
+    r = explore(Line())
+    assert len(r.violations) == 1
+    assert r.violations[0].trace == ("inc", "inc", "inc")
+
+
+def test_state_cap_is_loud_not_silent():
+    """A capped exploration must say so — 'verified' may only mean
+    exhausted."""
+    r = explore(ShardReplModel(), max_states=20)
+    assert not r.complete
+    f, s = protomodel.analyze(
+        PKG_ROOT, models=(("shard_repl", ShardReplModel),),
+        mirrors=(), max_states=20)
+    assert any(x.code == "E004" and "cap" in x.message for x in f)
+    assert s["models"]["shard_repl"]["complete"] is False
+
+
+# ---------------------------------------------------------------------------
+# the real protocols verify clean, exhaustively
+# ---------------------------------------------------------------------------
+
+
+def test_real_models_exhaust_clean():
+    for factory in (RouterHAModel, ShardReplModel, HandoffModel):
+        r = explore(factory())
+        assert r.complete, factory
+        assert r.violations == (), (factory, r.violations)
+        assert r.states >= 10 and r.transitions >= r.states, (factory, r)
+
+
+# ---------------------------------------------------------------------------
+# every bug twin is caught with a concrete schedule
+# ---------------------------------------------------------------------------
+
+
+def test_router_ha_announce_before_persist_caught():
+    """The E001 bug class, end-to-end in the checker: announcing the
+    epoch before it is durable lets a crash re-promote at the SAME
+    epoch — two incarnations, one adjudicated epoch."""
+    r = explore(RouterHAModel("announce_before_persist"))
+    v = next(x for x in r.violations
+             if "epoch-uniqueness" in x.message)
+    # the counterexample is the real schedule: announce, die before
+    # persist, re-promote
+    assert "s:crash" in v.trace
+    assert v.trace.index("s:announce") < v.trace.index("s:crash")
+    assert v.trace.count("s:claim") == 2
+
+
+def test_shard_repl_ack_without_coverage_caught():
+    """Dropping the semi-sync gate's coverage condition loses acked
+    ops across a crash+promote — the exact loss the gate prevents."""
+    r = explore(ShardReplModel("ack_without_coverage"))
+    v = next(x for x in r.violations if "acked-op-loss" in x.message)
+    assert "p:ack" in v.trace and "s:serve" in v.trace
+
+
+def test_handoff_swap_before_persist_caught():
+    """Swapping the in-memory ring before the COMMITTED record is
+    durable both breaks swap-durability and lets the abort arm write
+    ABORTED for a ring that irreversibly swapped."""
+    r = explore(HandoffModel("swap_before_persist"))
+    heads = {v.message.split(":")[0] for v in r.violations}
+    assert "swap-before-durable" in heads, heads
+    assert "abort-inconsistency" in heads, heads
+
+
+def test_handoff_fence_never_blocks_reads():
+    r = explore(HandoffModel("fence_blocks_reads"))
+    assert any("fence-blocks-reads" in v.message for v in r.violations)
+
+
+def test_gate_pass_fails_on_buggy_model():
+    """E004 through the gate surface (analyze), not just explore():
+    the injectable models registry is how tests prove the pass can
+    fail."""
+    f, s = protomodel.analyze(
+        PKG_ROOT,
+        models=(("router_ha",
+                 lambda: RouterHAModel("announce_before_persist")),),
+        mirrors=())
+    assert any(x.code == "E004" for x in f), f
+    assert s["models"]["router_ha"]["violations"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# E003: model freshness
+# ---------------------------------------------------------------------------
+
+
+def test_mirrors_fresh_against_tree():
+    f, s = protomodel.check_freshness(PKG_ROOT)
+    assert not f, [x.render() for x in f]
+    assert s["fresh"] == s["mirrored_symbols"] >= 10
+
+
+def test_stale_mirror_hash_detected():
+    bad = (MirrorSpec("router_ha", "shard/ha.py",
+                      "RouterStandby._promote_locked",
+                      "deadbeefdeadbeef"),)
+    f, _ = protomodel.check_freshness(PKG_ROOT, mirrors=bad)
+    assert len(f) == 1 and f[0].code == "E003"
+    assert "stale" in f[0].message
+
+
+def test_vanished_mirror_symbol_detected():
+    bad = (MirrorSpec("router_ha", "shard/ha.py",
+                      "RouterStandby._promote_differently",
+                      "deadbeefdeadbeef"),)
+    f, _ = protomodel.check_freshness(PKG_ROOT, mirrors=bad)
+    assert len(f) == 1 and f[0].code == "E003"
+    assert "no longer exists" in f[0].message
